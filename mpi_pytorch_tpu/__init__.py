@@ -1,0 +1,14 @@
+"""mpi_pytorch_tpu — a TPU-native (JAX/XLA/pjit/Pallas) framework with the
+capabilities of erick093/MPI_Pytorch: data-parallel CNN training over a device
+mesh, a seven-architecture Flax model zoo, epoch checkpointing/resume, and a
+batched inference pipeline — re-designed TPU-first rather than ported.
+
+The name preserves the reference's identity; nothing in here imports mpi4py,
+torch, or CUDA.
+"""
+
+__version__ = "0.1.0"
+
+from mpi_pytorch_tpu.config import Config, MeshConfig, parse_config
+
+__all__ = ["Config", "MeshConfig", "parse_config", "__version__"]

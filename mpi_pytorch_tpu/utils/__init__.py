@@ -1,0 +1,3 @@
+from mpi_pytorch_tpu.utils.logging import MetricsWriter, init_logger, process_index
+
+__all__ = ["MetricsWriter", "init_logger", "process_index"]

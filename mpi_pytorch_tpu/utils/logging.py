@@ -1,0 +1,77 @@
+"""Process-tagged logging, shared by the train and eval drivers.
+
+The reference duplicates an ``init_logger()`` in both entry points
+(``main.py:22-41``, ``evaluation_pipeline.py:19-38``): a rank-tagged Python
+logger with dual stream+file handlers. This is the single shared equivalent,
+tagged with ``jax.process_index()`` instead of an MPI rank, plus a structured
+JSONL metrics writer the reference lacks.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from typing import Any, Mapping
+
+
+def process_index() -> int:
+    # Resolved lazily so importing this module never forces jax initialization.
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def init_logger(name: str = "MPT", log_file: str | None = "training.log",
+                level: int = logging.INFO) -> logging.Logger:
+    """Rank-tagged logger with stream+file handlers (parity: ``main.py:22-41``)."""
+    rank = process_index()
+    logger = logging.getLogger(f"{name}_R{rank}")
+    logger.setLevel(level)
+    logger.propagate = False
+    if logger.handlers:  # idempotent re-init, unlike the reference
+        return logger
+
+    fmt = logging.Formatter(
+        "%(asctime)s %(name)s %(levelname)s: %(message)s", datefmt="%Y-%m-%d %H:%M:%S"
+    )
+    sh = logging.StreamHandler(sys.stdout)
+    sh.setFormatter(fmt)
+    logger.addHandler(sh)
+    if log_file:
+        os.makedirs(os.path.dirname(log_file) or ".", exist_ok=True)
+        fh = logging.FileHandler(log_file)
+        fh.setFormatter(fmt)
+        logger.addHandler(fh)
+    logger.info("Logger Initialized (process %d)", rank)
+    return logger
+
+
+class MetricsWriter:
+    """Structured JSONL metrics (throughput, loss, MFU) — SURVEY §5 observability.
+
+    Only process 0 writes, mirroring the reference's rank-0-only result
+    reporting (``main.py:173-185``).
+    """
+
+    def __init__(self, path: str | None):
+        self._fh = None
+        if path and process_index() == 0:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._fh = open(path, "a", buffering=1)
+
+    def write(self, record: Mapping[str, Any]) -> None:
+        if self._fh is None:
+            return
+        rec = {"ts": time.time(), **record}
+        self._fh.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

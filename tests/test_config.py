@@ -1,0 +1,47 @@
+import pytest
+
+from mpi_pytorch_tpu.config import Config, parse_config
+
+
+def test_defaults_mirror_reference_utils():
+    # reference utils.py:4-45
+    cfg = Config()
+    assert cfg.model_name == "resnet18"
+    assert cfg.num_classes == 64500
+    assert cfg.batch_size == 128
+    assert cfg.learning_rate == 4e-4
+    assert cfg.num_epochs == 10
+    assert cfg.width == cfg.height == 128
+    assert cfg.debug is True
+    assert cfg.validate is True
+    assert cfg.from_checkpoint is False
+    assert cfg.feature_extract is False
+
+
+def test_cli_overrides():
+    cfg = parse_config(["--model-name", "resnet34", "--batch-size", "32", "--debug", "false"])
+    assert cfg.model_name == "resnet34"
+    assert cfg.batch_size == 32
+    assert cfg.debug is False
+
+
+def test_invalid_model_raises():
+    # reference models.py:97-99 calls exit(); we raise instead
+    with pytest.raises(ValueError, match="unsupported model"):
+        parse_config(["--model-name", "resnet50"])
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("MPT_BATCH_SIZE", "16")
+    assert parse_config([]).batch_size == 16
+
+
+def test_inception_image_size():
+    cfg = parse_config(["--model-name", "inception_v3"])
+    assert cfg.image_size == (299, 299)
+    assert parse_config([]).image_size == (128, 128)
+
+
+def test_mesh_override():
+    cfg = parse_config(["--mesh.model-parallel", "4"])
+    assert cfg.mesh.model_parallel == 4

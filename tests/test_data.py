@@ -1,0 +1,95 @@
+import numpy as np
+import pytest
+
+from mpi_pytorch_tpu.config import Config
+from mpi_pytorch_tpu.data import DataLoader, load_manifests, normalize_image, synthetic_image
+from mpi_pytorch_tpu.data.manifest import Manifest
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    c = Config()
+    c.test_csv = "/root/repo/data/test_sample.csv"
+    c.train_csv = "/root/repo/data/train_sample.csv"
+    c.debug = True
+    return c
+
+
+@pytest.fixture(scope="module")
+def manifests(cfg):
+    return load_manifests(cfg)
+
+
+def test_debug_sampling_semantics(manifests):
+    # main.py:77-79: 1000-row sample seed 0, 80/20 split
+    train, test = manifests
+    assert len(train) == 800
+    assert len(test) == 200
+
+
+def test_sharding_matches_array_split(manifests):
+    train, _ = manifests
+    shards = [train.shard(3, i) for i in range(3)]
+    sizes = [len(s) for s in shards]
+    expected = [len(a) for a in np.array_split(np.arange(len(train)), 3)]
+    assert sizes == expected
+    # shards partition the manifest without overlap
+    all_files = [f for s in shards for f in s.filenames]
+    assert all_files == list(train.filenames)
+
+
+def test_labels_fit_head(manifests):
+    train, test = manifests
+    assert train.labels.max() < 64500  # utils.py:39 head size
+    assert train.labels.min() >= 0
+
+
+def test_normalize_matches_torch_semantics():
+    # transforms.Normalize((0.485,...),(0.229,...)) — main.py:65
+    img = np.full((4, 4, 3), 0.5, dtype=np.float32)
+    out = normalize_image(img)
+    expected = (0.5 - np.array([0.485, 0.456, 0.406])) / np.array([0.229, 0.224, 0.225])
+    np.testing.assert_allclose(out[0, 0], expected, rtol=1e-5)
+
+
+def test_synthetic_deterministic():
+    a = synthetic_image(7, (16, 16))
+    b = synthetic_image(7, (16, 16))
+    c = synthetic_image(8, (16, 16))
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    assert a.shape == (16, 16, 3)
+    assert a.min() >= 0.0 and a.max() <= 1.0
+
+
+def _tiny_manifest(n=20, classes=4):
+    labels = np.arange(n, dtype=np.int32) % classes
+    return Manifest(
+        filenames=tuple(f"img_{i}.jpg" for i in range(n)),
+        labels=labels,
+        category_ids=labels.astype(np.int64),
+        img_dir="unused",
+    )
+
+
+def test_loader_shapes_and_determinism():
+    m = _tiny_manifest()
+    dl = DataLoader(m, batch_size=8, image_size=(32, 32), synthetic=True, seed=3)
+    batches = list(dl.epoch(0))
+    assert len(batches) == 2  # drop_remainder: 20 // 8
+    imgs, labels = batches[0]
+    assert imgs.shape == (8, 32, 32, 3) and imgs.dtype == np.float32
+    assert labels.shape == (8,) and labels.dtype == np.int32
+    # same (seed, epoch) → same order; different epoch → different order
+    again = list(dl.epoch(0))
+    np.testing.assert_array_equal(batches[0][1], again[0][1])
+    other = list(dl.epoch(1))
+    assert not all(np.array_equal(b[1], o[1]) for b, o in zip(batches, other))
+
+
+def test_loader_no_drop_remainder():
+    m = _tiny_manifest(n=10)
+    dl = DataLoader(m, batch_size=8, image_size=(8, 8), synthetic=True, drop_remainder=False,
+                    shuffle=False)
+    batches = list(dl.epoch(0))
+    assert [b[0].shape[0] for b in batches] == [8, 2]
