@@ -1,0 +1,19 @@
+from mpi_pytorch_tpu.models.alexnet import AlexNet, alexnet
+from mpi_pytorch_tpu.models.densenet import DenseNet, densenet121
+from mpi_pytorch_tpu.models.inception import InceptionV3, inception_v3
+from mpi_pytorch_tpu.models.registry import (
+    ModelBundle,
+    available_models,
+    create_model_bundle,
+    init_variables,
+    initialize_model,
+)
+from mpi_pytorch_tpu.models.resnet import ResNet, resnet18, resnet34
+from mpi_pytorch_tpu.models.squeezenet import SqueezeNet, squeezenet1_0
+from mpi_pytorch_tpu.models.vgg import VGG, vgg11_bn
+
+__all__ = [
+    "AlexNet", "DenseNet", "InceptionV3", "ModelBundle", "ResNet", "SqueezeNet", "VGG",
+    "alexnet", "available_models", "create_model_bundle", "densenet121", "inception_v3",
+    "init_variables", "initialize_model", "resnet18", "resnet34", "squeezenet1_0", "vgg11_bn",
+]

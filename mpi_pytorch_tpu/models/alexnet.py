@@ -1,0 +1,51 @@
+"""AlexNet in Flax (NHWC). Parity with the reference's torchvision alexnet
+factory (``models.py:47-54``): five-conv feature stack, adaptive 6×6 pool,
+4096-4096-num_classes classifier with dropout."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mpi_pytorch_tpu.models.common import adaptive_avg_pool, max_pool
+
+
+class AlexNet(nn.Module):
+    num_classes: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        conv = lambda f, k, s, p, name: nn.Conv(
+            f, (k, k), strides=(s, s), padding=p,
+            dtype=self.dtype, param_dtype=self.param_dtype, name=name,
+        )
+        x = nn.relu(conv(64, 11, 4, 2, "conv1")(x))
+        x = max_pool(x, 3, 2)
+        x = nn.relu(conv(192, 5, 1, 2, "conv2")(x))
+        x = max_pool(x, 3, 2)
+        x = nn.relu(conv(384, 3, 1, 1, "conv3")(x))
+        x = nn.relu(conv(256, 3, 1, 1, "conv4")(x))
+        x = nn.relu(conv(256, 3, 1, 1, "conv5")(x))
+        x = max_pool(x, 3, 2)
+
+        x = adaptive_avg_pool(x, (6, 6))
+        x = x.reshape(x.shape[0], -1)
+
+        dense = lambda f, name: nn.Dense(
+            f, dtype=self.dtype, param_dtype=self.param_dtype, name=name
+        )
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(dense(4096, "fc1")(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(dense(4096, "fc2")(x))
+        x = x.astype(jnp.float32)
+        return nn.Dense(self.num_classes, param_dtype=self.param_dtype, name="head")(x)
+
+
+def alexnet(num_classes: int, **kw: Any) -> AlexNet:
+    return AlexNet(num_classes=num_classes, **kw)

@@ -1,0 +1,103 @@
+"""Shared building blocks for the Flax CNN zoo.
+
+All models are NHWC (TPU-native layout: channels last keeps the lane dimension
+dense for the VPU/MXU), take a ``train`` flag for BatchNorm/Dropout mode, and
+thread ``dtype`` (compute, bfloat16 by default on TPU) separately from
+``param_dtype`` (float32 master params).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+# torch BatchNorm defaults: eps=1e-5, momentum=0.1 (flax momentum = 1-0.1).
+BN_MOMENTUM = 0.9
+BN_EPS = 1e-5
+
+
+def batch_norm(
+    name: str | None = None,
+    *,
+    dtype: Dtype = jnp.float32,
+    axis_name: str | None = None,
+) -> nn.BatchNorm:
+    """BatchNorm matching torch defaults. ``axis_name=None`` keeps per-replica
+    local batch statistics — the reference's data-parallel semantics (only
+    grads are synced, ``mpi_tools.py:30-37``; SURVEY §7 'BatchNorm under DP').
+    Pass the mesh data axis name to opt into sync-BN."""
+    return nn.BatchNorm(
+        use_running_average=None,  # caller passes via __call__
+        momentum=BN_MOMENTUM,
+        epsilon=BN_EPS,
+        dtype=dtype,
+        axis_name=axis_name,
+        name=name,
+    )
+
+
+def max_pool(x: jnp.ndarray, window: int, stride: int, padding: Any = "VALID") -> jnp.ndarray:
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    return nn.max_pool(x, (window, window), strides=(stride, stride), padding=padding)
+
+
+def adaptive_avg_pool(x: jnp.ndarray, out_hw: tuple[int, int]) -> jnp.ndarray:
+    """torch AdaptiveAvgPool2d for static input shapes.
+
+    Output cell (i, j) averages rows [floor(i*H/th), ceil((i+1)*H/th)) — the
+    exact torch window algorithm. Shapes are static under jit, so the window
+    arithmetic unrolls at trace time into th+tw strided slices; XLA fuses the
+    means. Separable because the window bounds factor by axis.
+    """
+    th, tw = out_hw
+    h, w = x.shape[1], x.shape[2]
+    if h == th and w == tw:
+        return x
+    if h % th == 0 and w % tw == 0:
+        # Fast path: equal windows → single reshape-mean (the common case).
+        x = x.reshape(x.shape[0], th, h // th, tw, w // tw, x.shape[3])
+        return x.mean(axis=(2, 4))
+    rows = [
+        x[:, (i * h) // th : -(-((i + 1) * h) // th), :, :].mean(axis=1, keepdims=True)
+        for i in range(th)
+    ]
+    x = jnp.concatenate(rows, axis=1)
+    cols = [
+        x[:, :, (j * w) // tw : -(-((j + 1) * w) // tw), :].mean(axis=2, keepdims=True)
+        for j in range(tw)
+    ]
+    return jnp.concatenate(cols, axis=2)
+
+
+def global_avg_pool(x: jnp.ndarray) -> jnp.ndarray:
+    return x.mean(axis=(1, 2))
+
+
+class Classifier(nn.Module):
+    """Final dense head. Kept as its own module so (a) `feature_extract`
+    freezing can target the `head` subtree by name across every architecture
+    (parity: the reference swaps/unfreezes exactly this layer,
+    ``models.py:36,44,53,62,80``), and (b) tensor-parallel sharding rules can
+    match the 64 500-wide kernel by path (`.../head/kernel`)."""
+
+    num_classes: int
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        return nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype, name="head"
+        )(x)
+
+
+def head_filter(path: Sequence[str]) -> bool:
+    """True for params belonging to a classification head — the subtree that
+    stays trainable under feature_extract (reference ``models.py:5-13`` +
+    head swap)."""
+    return any(p in ("head", "aux_head") for p in path)

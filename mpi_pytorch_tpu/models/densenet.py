@@ -1,0 +1,115 @@
+"""DenseNet-121 in Flax (NHWC). Parity with the reference's torchvision
+densenet121 factory (``models.py:74-81``): growth 32, block config
+(6, 12, 24, 16), BN-ReLU-Conv bottleneck layers with dense concatenation.
+
+TPU note: the dense-block concatenations are the HBM-bandwidth-heavy part of
+this zoo (BASELINE.json calls densenet 'concat-heavy'); keeping NHWC means
+every concat is on the minor-most lane axis, which XLA fuses into the
+consuming conv without a relayout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mpi_pytorch_tpu.models.common import batch_norm, global_avg_pool, max_pool
+
+
+class DenseLayer(nn.Module):
+    growth_rate: int
+    bn_size: int = 4
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        y = batch_norm("norm1", dtype=self.dtype, axis_name=self.bn_axis_name)(
+            x, use_running_average=not train
+        )
+        y = nn.relu(y)
+        y = nn.Conv(
+            self.bn_size * self.growth_rate, (1, 1), use_bias=False,
+            dtype=self.dtype, param_dtype=self.param_dtype, name="conv1",
+        )(y)
+        y = batch_norm("norm2", dtype=self.dtype, axis_name=self.bn_axis_name)(
+            y, use_running_average=not train
+        )
+        y = nn.relu(y)
+        y = nn.Conv(
+            self.growth_rate, (3, 3), padding=1, use_bias=False,
+            dtype=self.dtype, param_dtype=self.param_dtype, name="conv2",
+        )(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class Transition(nn.Module):
+    features: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        x = batch_norm("norm", dtype=self.dtype, axis_name=self.bn_axis_name)(
+            x, use_running_average=not train
+        )
+        x = nn.relu(x)
+        x = nn.Conv(
+            self.features, (1, 1), use_bias=False,
+            dtype=self.dtype, param_dtype=self.param_dtype, name="conv",
+        )(x)
+        return nn.avg_pool(x, (2, 2), strides=(2, 2))
+
+
+class DenseNet(nn.Module):
+    block_config: Sequence[int]
+    num_classes: int
+    growth_rate: int = 32
+    num_init_features: int = 64
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = nn.Conv(
+            self.num_init_features, (7, 7), strides=(2, 2), padding=3, use_bias=False,
+            dtype=self.dtype, param_dtype=self.param_dtype, name="conv0",
+        )(x)
+        x = batch_norm("norm0", dtype=self.dtype, axis_name=self.bn_axis_name)(
+            x, use_running_average=not train
+        )
+        x = nn.relu(x)
+        x = max_pool(x, 3, 2, padding=1)
+
+        features = self.num_init_features
+        for i, n_layers in enumerate(self.block_config):
+            for j in range(n_layers):
+                x = DenseLayer(
+                    growth_rate=self.growth_rate, dtype=self.dtype,
+                    param_dtype=self.param_dtype, bn_axis_name=self.bn_axis_name,
+                    name=f"denseblock{i + 1}_layer{j + 1}",
+                )(x, train)
+            features += n_layers * self.growth_rate
+            if i != len(self.block_config) - 1:
+                features //= 2
+                x = Transition(
+                    features=features, dtype=self.dtype, param_dtype=self.param_dtype,
+                    bn_axis_name=self.bn_axis_name, name=f"transition{i + 1}",
+                )(x, train)
+
+        x = batch_norm("norm5", dtype=self.dtype, axis_name=self.bn_axis_name)(
+            x, use_running_average=not train
+        )
+        x = nn.relu(x)
+        x = global_avg_pool(x)
+        x = x.astype(jnp.float32)
+        return nn.Dense(self.num_classes, param_dtype=self.param_dtype, name="head")(x)
+
+
+def densenet121(num_classes: int, **kw: Any) -> DenseNet:
+    return DenseNet(block_config=(6, 12, 24, 16), num_classes=num_classes, **kw)

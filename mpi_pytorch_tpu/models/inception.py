@@ -1,0 +1,250 @@
+"""Inception-v3 in Flax (NHWC), with auxiliary logits handled *correctly*.
+
+Parity with the reference's torchvision inception_v3 factory
+(``models.py:83-95``), which replaces both ``AuxLogits.fc`` and ``fc``
+(``models.py:90-94``) — but whose training path is latently broken: the
+reference feeds 128×128 inputs (needs ≥299) and never unpacks the
+``(logits, aux_logits)`` train-mode output (``main.py:149-150``; SURVEY §3
+quirks). Here inception runs at 299×299 and the train step applies the
+standard 0.4-weighted aux loss (see ``ops/losses.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mpi_pytorch_tpu.models.common import adaptive_avg_pool, global_avg_pool, max_pool
+
+
+class BasicConv(nn.Module):
+    """Conv + BN(eps=1e-3, as in torchvision inception) + ReLU."""
+
+    features: int
+    kernel: tuple[int, int]
+    stride: int = 1
+    padding: Any = 0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        pad = self.padding
+        if isinstance(pad, int):
+            pad = [(pad, pad), (pad, pad)]
+        elif isinstance(pad, tuple):
+            pad = [(pad[0], pad[0]), (pad[1], pad[1])]
+        x = nn.Conv(
+            self.features, self.kernel, strides=(self.stride, self.stride), padding=pad,
+            use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype, name="conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-3,
+            dtype=self.dtype, axis_name=self.bn_axis_name, name="bn",
+        )(x)
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: str | None = None
+
+    def _c(self, f, k, s=1, p=0, name=None):
+        return BasicConv(f, k if isinstance(k, tuple) else (k, k), s, p,
+                         dtype=self.dtype, param_dtype=self.param_dtype,
+                         bn_axis_name=self.bn_axis_name, name=name)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        b1 = self._c(64, 1, name="branch1x1")(x, train)
+        b5 = self._c(48, 1, name="branch5x5_1")(x, train)
+        b5 = self._c(64, 5, p=2, name="branch5x5_2")(b5, train)
+        b3 = self._c(64, 1, name="branch3x3dbl_1")(x, train)
+        b3 = self._c(96, 3, p=1, name="branch3x3dbl_2")(b3, train)
+        b3 = self._c(96, 3, p=1, name="branch3x3dbl_3")(b3, train)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=[(1, 1), (1, 1)])
+        bp = self._c(self.pool_features, 1, name="branch_pool")(bp, train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: str | None = None
+
+    def _c(self, f, k, s=1, p=0, name=None):
+        return BasicConv(f, k if isinstance(k, tuple) else (k, k), s, p,
+                         dtype=self.dtype, param_dtype=self.param_dtype,
+                         bn_axis_name=self.bn_axis_name, name=name)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        b3 = self._c(384, 3, s=2, name="branch3x3")(x, train)
+        bd = self._c(64, 1, name="branch3x3dbl_1")(x, train)
+        bd = self._c(96, 3, p=1, name="branch3x3dbl_2")(bd, train)
+        bd = self._c(96, 3, s=2, name="branch3x3dbl_3")(bd, train)
+        bp = max_pool(x, 3, 2)
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: str | None = None
+
+    def _c(self, f, k, s=1, p=0, name=None):
+        return BasicConv(f, k if isinstance(k, tuple) else (k, k), s, p,
+                         dtype=self.dtype, param_dtype=self.param_dtype,
+                         bn_axis_name=self.bn_axis_name, name=name)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        c7 = self.channels_7x7
+        b1 = self._c(192, 1, name="branch1x1")(x, train)
+        b7 = self._c(c7, 1, name="branch7x7_1")(x, train)
+        b7 = self._c(c7, (1, 7), p=(0, 3), name="branch7x7_2")(b7, train)
+        b7 = self._c(192, (7, 1), p=(3, 0), name="branch7x7_3")(b7, train)
+        bd = self._c(c7, 1, name="branch7x7dbl_1")(x, train)
+        bd = self._c(c7, (7, 1), p=(3, 0), name="branch7x7dbl_2")(bd, train)
+        bd = self._c(c7, (1, 7), p=(0, 3), name="branch7x7dbl_3")(bd, train)
+        bd = self._c(c7, (7, 1), p=(3, 0), name="branch7x7dbl_4")(bd, train)
+        bd = self._c(192, (1, 7), p=(0, 3), name="branch7x7dbl_5")(bd, train)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=[(1, 1), (1, 1)])
+        bp = self._c(192, 1, name="branch_pool")(bp, train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: str | None = None
+
+    def _c(self, f, k, s=1, p=0, name=None):
+        return BasicConv(f, k if isinstance(k, tuple) else (k, k), s, p,
+                         dtype=self.dtype, param_dtype=self.param_dtype,
+                         bn_axis_name=self.bn_axis_name, name=name)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        b3 = self._c(192, 1, name="branch3x3_1")(x, train)
+        b3 = self._c(320, 3, s=2, name="branch3x3_2")(b3, train)
+        b7 = self._c(192, 1, name="branch7x7x3_1")(x, train)
+        b7 = self._c(192, (1, 7), p=(0, 3), name="branch7x7x3_2")(b7, train)
+        b7 = self._c(192, (7, 1), p=(3, 0), name="branch7x7x3_3")(b7, train)
+        b7 = self._c(192, 3, s=2, name="branch7x7x3_4")(b7, train)
+        bp = max_pool(x, 3, 2)
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: str | None = None
+
+    def _c(self, f, k, s=1, p=0, name=None):
+        return BasicConv(f, k if isinstance(k, tuple) else (k, k), s, p,
+                         dtype=self.dtype, param_dtype=self.param_dtype,
+                         bn_axis_name=self.bn_axis_name, name=name)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        b1 = self._c(320, 1, name="branch1x1")(x, train)
+        b3 = self._c(384, 1, name="branch3x3_1")(x, train)
+        b3 = jnp.concatenate(
+            [
+                self._c(384, (1, 3), p=(0, 1), name="branch3x3_2a")(b3, train),
+                self._c(384, (3, 1), p=(1, 0), name="branch3x3_2b")(b3, train),
+            ],
+            axis=-1,
+        )
+        bd = self._c(448, 1, name="branch3x3dbl_1")(x, train)
+        bd = self._c(384, 3, p=1, name="branch3x3dbl_2")(bd, train)
+        bd = jnp.concatenate(
+            [
+                self._c(384, (1, 3), p=(0, 1), name="branch3x3dbl_3a")(bd, train),
+                self._c(384, (3, 1), p=(1, 0), name="branch3x3dbl_3b")(bd, train),
+            ],
+            axis=-1,
+        )
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=[(1, 1), (1, 1)])
+        bp = self._c(192, 1, name="branch_pool")(bp, train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionAux(nn.Module):
+    """Aux classifier; its Dense is named ``aux_head`` so feature_extract and
+    the head-replacement semantics cover it (reference ``models.py:90-91``)."""
+
+    num_classes: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3))
+        x = BasicConv(128, (1, 1), dtype=self.dtype, param_dtype=self.param_dtype,
+                      bn_axis_name=self.bn_axis_name, name="conv0")(x, train)
+        x = BasicConv(768, (5, 5), dtype=self.dtype, param_dtype=self.param_dtype,
+                      bn_axis_name=self.bn_axis_name, name="conv1")(x, train)
+        x = adaptive_avg_pool(x, (1, 1)).reshape(x.shape[0], -1)
+        x = x.astype(jnp.float32)
+        return nn.Dense(self.num_classes, param_dtype=self.param_dtype, name="aux_head")(x)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int
+    aux_logits: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    dropout_rate: float = 0.5
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False):
+        kw = dict(dtype=self.dtype, param_dtype=self.param_dtype,
+                  bn_axis_name=self.bn_axis_name)
+        c = lambda f, k, s=1, p=0, name=None: BasicConv(
+            f, k if isinstance(k, tuple) else (k, k), s, p, name=name, **kw
+        )
+        x = c(32, 3, s=2, name="Conv2d_1a_3x3")(x, train)
+        x = c(32, 3, name="Conv2d_2a_3x3")(x, train)
+        x = c(64, 3, p=1, name="Conv2d_2b_3x3")(x, train)
+        x = max_pool(x, 3, 2)
+        x = c(80, 1, name="Conv2d_3b_1x1")(x, train)
+        x = c(192, 3, name="Conv2d_4a_3x3")(x, train)
+        x = max_pool(x, 3, 2)
+        x = InceptionA(pool_features=32, name="Mixed_5b", **kw)(x, train)
+        x = InceptionA(pool_features=64, name="Mixed_5c", **kw)(x, train)
+        x = InceptionA(pool_features=64, name="Mixed_5d", **kw)(x, train)
+        x = InceptionB(name="Mixed_6a", **kw)(x, train)
+        x = InceptionC(channels_7x7=128, name="Mixed_6b", **kw)(x, train)
+        x = InceptionC(channels_7x7=160, name="Mixed_6c", **kw)(x, train)
+        x = InceptionC(channels_7x7=160, name="Mixed_6d", **kw)(x, train)
+        x = InceptionC(channels_7x7=192, name="Mixed_6e", **kw)(x, train)
+
+        aux = None
+        if self.aux_logits and train:
+            aux = InceptionAux(self.num_classes, name="AuxLogits", **kw)(x, train)
+
+        x = InceptionD(name="Mixed_7a", **kw)(x, train)
+        x = InceptionE(name="Mixed_7b", **kw)(x, train)
+        x = InceptionE(name="Mixed_7c", **kw)(x, train)
+
+        x = global_avg_pool(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = x.astype(jnp.float32)
+        logits = nn.Dense(self.num_classes, param_dtype=self.param_dtype, name="head")(x)
+        if aux is not None:
+            return logits, aux
+        return logits
+
+
+def inception_v3(num_classes: int, **kw: Any) -> InceptionV3:
+    return InceptionV3(num_classes=num_classes, **kw)

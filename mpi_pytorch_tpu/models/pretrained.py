@@ -1,0 +1,44 @@
+"""Pretrained-weight loading (the ``use_pretrained`` path).
+
+The reference downloads torchvision ImageNet weights (``models.py:33`` etc.).
+This environment has no torchvision and no network egress, so pretrained means
+"load a converted checkpoint from ``pretrained_dir``" produced offline by
+``tools/convert_torchvision.py`` (which maps a torchvision state_dict onto
+this zoo's param tree). The backbone loads; the ``num_classes`` head keeps its
+fresh initialization — exactly the reference's head-replacement semantics
+(``models.py:36`` and friends).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+from flax import serialization
+
+from mpi_pytorch_tpu.models.common import head_filter
+
+
+def pretrained_path(model_name: str, pretrained_dir: str) -> str:
+    return os.path.join(pretrained_dir, f"{model_name}.msgpack")
+
+
+def load_pretrained(model_name: str, variables: dict, pretrained_dir: str) -> dict:
+    """Overlay converted backbone weights onto freshly-initialized variables,
+    keeping the head's fresh init (head shape depends on num_classes)."""
+    path = pretrained_path(model_name, pretrained_dir)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"use_pretrained=True but no converted weights at {path}. Run "
+            "tools/convert_torchvision.py on a machine with torchvision, or set "
+            "use_pretrained=False (random init)."
+        )
+    with open(path, "rb") as f:
+        loaded = serialization.from_bytes(variables, f.read())
+
+    def overlay(path_keys, fresh, pre) -> Any:
+        keys = [getattr(k, "key", str(k)) for k in path_keys]
+        return fresh if head_filter(keys) else pre
+
+    return jax.tree_util.tree_map_with_path(overlay, variables, loaded)

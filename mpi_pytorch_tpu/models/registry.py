@@ -1,0 +1,137 @@
+"""Model factory — parity with the reference's ``initialize_model``
+(``models.py:16-101``): dispatch on an architecture name, build the network
+with a ``num_classes`` head, optionally freeze everything but the head
+(``feature_extract``), optionally load pretrained weights; return
+``(model, input_size)``.
+
+Differences by design:
+- invalid names raise ``ValueError`` instead of ``exit()`` (``models.py:97-99``);
+- ``use_pretrained`` loads converted-from-torchvision weights from disk when
+  available (tools/convert_torchvision.py) instead of downloading — this
+  environment has no torchvision and no egress;
+- ``feature_extract`` returns a *trainable-parameter mask* (params are
+  immutable pytrees here; freezing is an optimizer property — see
+  ``train/step.py`` optax masking — not a mutable ``requires_grad`` flag).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mpi_pytorch_tpu.models.alexnet import alexnet
+from mpi_pytorch_tpu.models.common import head_filter
+from mpi_pytorch_tpu.models.densenet import densenet121
+from mpi_pytorch_tpu.models.inception import inception_v3
+from mpi_pytorch_tpu.models.resnet import resnet18, resnet34
+from mpi_pytorch_tpu.models.squeezenet import squeezenet1_0
+from mpi_pytorch_tpu.models.vgg import vgg11_bn
+
+# name → (factory, canonical input size). Input sizes mirror models.py
+# (:37,:45,:54,:63,:72,:81,:95); as in the reference they are advisory — the
+# config's resize wins (main.py:64) — except inception which truly needs 299.
+_REGISTRY: dict[str, tuple[Callable[..., nn.Module], int]] = {
+    "resnet18": (resnet18, 224),
+    "resnet34": (resnet34, 128),
+    "alexnet": (alexnet, 224),
+    "vgg11_bn": (vgg11_bn, 224),
+    "squeezenet1_0": (squeezenet1_0, 224),
+    "densenet121": (densenet121, 224),
+    "inception_v3": (inception_v3, 299),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelBundle:
+    """Everything the training/eval drivers need to know about a model."""
+
+    model: nn.Module
+    input_size: int
+    name: str
+    has_aux_logits: bool
+    trainable_mask: Any | None  # pytree of bools over params; None = all trainable
+
+
+def available_models() -> tuple[str, ...]:
+    return tuple(_REGISTRY)
+
+
+def initialize_model(
+    model_name: str,
+    num_classes: int,
+    feature_extract: bool = False,
+    use_pretrained: bool = False,
+    *,
+    dtype: Any = jnp.float32,
+    param_dtype: Any = jnp.float32,
+    bn_axis_name: str | None = None,
+    pretrained_dir: str = "pretrained",
+) -> tuple[nn.Module, int]:
+    """Reference-parity signature (``models.py:16``): returns (model, input_size)."""
+    if model_name not in _REGISTRY:
+        raise ValueError(
+            f"unsupported model {model_name!r}; expected one of {tuple(_REGISTRY)}"
+        )
+    factory, input_size = _REGISTRY[model_name]
+    kw: dict[str, Any] = dict(dtype=dtype, param_dtype=param_dtype)
+    if model_name not in ("alexnet", "squeezenet1_0"):  # the BN-free architectures
+        kw["bn_axis_name"] = bn_axis_name
+    model = factory(num_classes, **kw)
+    return model, input_size
+
+
+def init_variables(
+    model: nn.Module, input_size: int, rng: jax.Array, batch_size: int = 1
+) -> dict:
+    """Initialize params + batch_stats. Uses train=True so architectures with
+    train-only submodules (inception aux head) create their full param set."""
+    dummy = jnp.zeros((batch_size, input_size, input_size, 3), jnp.float32)
+    p_rng, d_rng = jax.random.split(rng)
+    return model.init({"params": p_rng, "dropout": d_rng}, dummy, train=True)
+
+
+def create_model_bundle(
+    model_name: str,
+    num_classes: int,
+    feature_extract: bool = False,
+    use_pretrained: bool = False,
+    *,
+    rng: jax.Array | None = None,
+    image_size: int | None = None,
+    dtype: Any = jnp.float32,
+    param_dtype: Any = jnp.float32,
+    bn_axis_name: str | None = None,
+    pretrained_dir: str = "pretrained",
+) -> tuple[ModelBundle, dict]:
+    """Full-fat factory: returns the bundle plus initialized variables."""
+    model, canonical = initialize_model(
+        model_name, num_classes, feature_extract, use_pretrained,
+        dtype=dtype, param_dtype=param_dtype, bn_axis_name=bn_axis_name,
+    )
+    size = image_size or (299 if model_name == "inception_v3" else 128)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    variables = init_variables(model, size, rng)
+
+    if use_pretrained:
+        from mpi_pytorch_tpu.models.pretrained import load_pretrained
+
+        variables = load_pretrained(model_name, variables, pretrained_dir)
+
+    mask = None
+    if feature_extract:
+        mask = jax.tree_util.tree_map_with_path(
+            lambda path, _: head_filter([getattr(k, "key", str(k)) for k in path]),
+            variables["params"],
+        )
+    bundle = ModelBundle(
+        model=model,
+        input_size=size,
+        name=model_name,
+        has_aux_logits=(model_name == "inception_v3"),
+        trainable_mask=mask,
+    )
+    return bundle, variables

@@ -1,0 +1,95 @@
+"""ResNet-18/34 in Flax (NHWC, TPU-native).
+
+Capability parity with the reference's torchvision resnet18/34 factories
+(``models.py:30-45``): same architecture family (BasicBlock stacks [2,2,2,2] /
+[3,4,6,3]), same replaceable ``num_classes`` head. Built from scratch against
+the ResNet paper topology; parameter names are chosen so a torchvision
+state_dict maps 1:1 for the optional pretrained-weight converter
+(tools/convert_torchvision.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mpi_pytorch_tpu.models.common import batch_norm, global_avg_pool, max_pool
+
+
+class BasicBlock(nn.Module):
+    features: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        conv = lambda f, s, name: nn.Conv(
+            f, (3, 3), strides=(s, s), padding=1, use_bias=False,
+            dtype=self.dtype, param_dtype=self.param_dtype, name=name,
+        )
+        bn = lambda name: batch_norm(name, dtype=self.dtype, axis_name=self.bn_axis_name)
+
+        residual = x
+        y = conv(self.features, self.stride, "conv1")(x)
+        y = bn("bn1")(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = conv(self.features, 1, "conv2")(y)
+        y = bn("bn2")(y, use_running_average=not train)
+
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.features, (1, 1), strides=(self.stride, self.stride), use_bias=False,
+                dtype=self.dtype, param_dtype=self.param_dtype, name="downsample_conv",
+            )(x)
+            residual = batch_norm("downsample_bn", dtype=self.dtype, axis_name=self.bn_axis_name)(
+                residual, use_running_average=not train
+            )
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = nn.Conv(
+            64, (7, 7), strides=(2, 2), padding=3, use_bias=False,
+            dtype=self.dtype, param_dtype=self.param_dtype, name="conv1",
+        )(x)
+        x = batch_norm("bn1", dtype=self.dtype, axis_name=self.bn_axis_name)(
+            x, use_running_average=not train
+        )
+        x = nn.relu(x)
+        x = max_pool(x, 3, 2, padding=1)
+
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                stride = 2 if stage > 0 and block == 0 else 1
+                x = BasicBlock(
+                    features=64 * 2**stage,
+                    stride=stride,
+                    dtype=self.dtype,
+                    param_dtype=self.param_dtype,
+                    bn_axis_name=self.bn_axis_name,
+                    name=f"layer{stage + 1}_{block}",
+                )(x, train)
+
+        x = global_avg_pool(x)
+        x = x.astype(jnp.float32)  # logits head in float32 for a stable softmax
+        return nn.Dense(self.num_classes, param_dtype=self.param_dtype, name="head")(x)
+
+
+def resnet18(num_classes: int, **kw: Any) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), num_classes=num_classes, **kw)
+
+
+def resnet34(num_classes: int, **kw: Any) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, **kw)

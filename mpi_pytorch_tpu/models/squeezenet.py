@@ -1,0 +1,71 @@
+"""SqueezeNet 1.0 in Flax (NHWC). Parity with the reference's torchvision
+squeezenet1_0 factory (``models.py:65-72``) — including the 1×1-Conv
+classification head (``models.py:70``), the one zoo member whose head is a
+conv rather than a dense layer."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mpi_pytorch_tpu.models.common import global_avg_pool, max_pool
+
+
+class Fire(nn.Module):
+    squeeze: int
+    expand1x1: int
+    expand3x3: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        conv = lambda f, k, p, name: nn.Conv(
+            f, (k, k), padding=p, dtype=self.dtype, param_dtype=self.param_dtype, name=name
+        )
+        s = nn.relu(conv(self.squeeze, 1, 0, "squeeze")(x))
+        e1 = nn.relu(conv(self.expand1x1, 1, 0, "expand1x1")(s))
+        e3 = nn.relu(conv(self.expand3x3, 3, 1, "expand3x3")(s))
+        return jnp.concatenate([e1, e3], axis=-1)
+
+
+class SqueezeNet(nn.Module):
+    num_classes: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        fire = lambda s, e1, e3, name: Fire(
+            s, e1, e3, dtype=self.dtype, param_dtype=self.param_dtype, name=name
+        )
+        x = nn.Conv(
+            96, (7, 7), strides=(2, 2), dtype=self.dtype, param_dtype=self.param_dtype,
+            name="conv1",
+        )(x)
+        x = nn.relu(x)
+        x = max_pool(x, 3, 2)
+        x = fire(16, 64, 64, "fire2")(x)
+        x = fire(16, 64, 64, "fire3")(x)
+        x = fire(32, 128, 128, "fire4")(x)
+        x = max_pool(x, 3, 2)
+        x = fire(32, 128, 128, "fire5")(x)
+        x = fire(48, 192, 192, "fire6")(x)
+        x = fire(48, 192, 192, "fire7")(x)
+        x = fire(64, 256, 256, "fire8")(x)
+        x = max_pool(x, 3, 2)
+        x = fire(64, 256, 256, "fire9")(x)
+
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        # 1×1 conv head (reference models.py:70), then global average pool.
+        x = nn.Conv(self.num_classes, (1, 1), param_dtype=self.param_dtype,
+                    dtype=jnp.float32, name="head")(x.astype(jnp.float32))
+        x = nn.relu(x)
+        return global_avg_pool(x)
+
+
+def squeezenet1_0(num_classes: int, **kw: Any) -> SqueezeNet:
+    return SqueezeNet(num_classes=num_classes, **kw)

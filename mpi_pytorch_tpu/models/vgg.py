@@ -1,0 +1,57 @@
+"""VGG-11 with BatchNorm in Flax (NHWC). Parity with the reference's
+torchvision vgg11_bn factory (``models.py:56-63``)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mpi_pytorch_tpu.models.common import adaptive_avg_pool, batch_norm, max_pool
+
+# 'M' = 2×2 maxpool; numbers = conv3x3 output channels (VGG-A configuration).
+VGG11_CFG: Sequence[Any] = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M")
+
+
+class VGG(nn.Module):
+    cfg: Sequence[Any]
+    num_classes: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    dropout_rate: float = 0.5
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        conv_i = 0
+        for v in self.cfg:
+            if v == "M":
+                x = max_pool(x, 2, 2)
+                continue
+            x = nn.Conv(
+                v, (3, 3), padding=1, use_bias=False,
+                dtype=self.dtype, param_dtype=self.param_dtype, name=f"conv{conv_i}",
+            )(x)
+            x = batch_norm(f"bn{conv_i}", dtype=self.dtype, axis_name=self.bn_axis_name)(
+                x, use_running_average=not train
+            )
+            x = nn.relu(x)
+            conv_i += 1
+
+        x = adaptive_avg_pool(x, (7, 7))
+        x = x.reshape(x.shape[0], -1)
+
+        dense = lambda f, name: nn.Dense(
+            f, dtype=self.dtype, param_dtype=self.param_dtype, name=name
+        )
+        x = nn.relu(dense(4096, "fc1")(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(dense(4096, "fc2")(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = x.astype(jnp.float32)
+        return nn.Dense(self.num_classes, param_dtype=self.param_dtype, name="head")(x)
+
+
+def vgg11_bn(num_classes: int, **kw: Any) -> VGG:
+    return VGG(cfg=VGG11_CFG, num_classes=num_classes, **kw)

@@ -1,0 +1,8 @@
+from mpi_pytorch_tpu.ops.losses import (
+    AUX_LOSS_WEIGHT,
+    accuracy_count,
+    classification_loss,
+    cross_entropy,
+)
+
+__all__ = ["AUX_LOSS_WEIGHT", "accuracy_count", "classification_loss", "cross_entropy"]

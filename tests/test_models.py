@@ -1,0 +1,126 @@
+"""Model zoo unit tests (SURVEY §4 item 1): per-architecture output shapes,
+param counts vs the known torchvision totals (same topology ⇒ same count),
+aux-logits behavior, and feature_extract masking."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_pytorch_tpu.models import create_model_bundle, initialize_model
+from mpi_pytorch_tpu.models.registry import init_variables
+
+NUM_CLASSES = 10
+BATCH = 2
+
+# torchvision parameter totals at num_classes=10 (fc/conv head resized):
+# computed from the published architectures (backbone + head(in_features → 10)).
+EXPECTED_PARAMS = {
+    # resnet18: 11,176,512 backbone + 512*10+10 head
+    "resnet18": 11_181_642,
+    # resnet34: 21,284,672 backbone + 512*10+10
+    "resnet34": 21_289_802,
+    # alexnet: 2,469,696 features + 54,534,144 fc1/fc2 + 4096*10+10
+    "alexnet": 57_044_810,
+    # vgg11_bn (features use_bias=False variant differs from torchvision; checked structurally)
+    "vgg11_bn": None,
+    # squeezenet1_0: 735,424 backbone + (512*10+10) 1x1-conv head
+    "squeezenet1_0": 740_554,
+    # densenet121: 6,953,856 backbone + 1024*10+10
+    "densenet121": 6_964_106,
+    # inception_v3: aux-full model
+    "inception_v3": None,
+}
+
+ARCHS = list(EXPECTED_PARAMS)
+
+
+def _count(tree):
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(tree))
+
+
+@pytest.fixture(scope="module")
+def bundles():
+    out = {}
+    for name in ARCHS:
+        size = 75 if name == "inception_v3" else 64  # small for test speed; 75 ≥ aux pool needs
+        if name == "inception_v3":
+            size = 299  # aux pooling path needs the real spatial dims
+        bundle, variables = create_model_bundle(
+            name, NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=size
+        )
+        out[name] = (bundle, variables)
+    return out
+
+
+@pytest.mark.parametrize("name", ARCHS)
+def test_forward_shapes(bundles, name):
+    bundle, variables = bundles[name]
+    x = jnp.zeros((BATCH, bundle.input_size, bundle.input_size, 3), jnp.float32)
+    # eval mode: single logits tensor for every arch, incl. inception
+    logits = bundle.model.apply(variables, x, train=False)
+    assert logits.shape == (BATCH, NUM_CLASSES)
+    assert logits.dtype == jnp.float32
+
+
+@pytest.mark.parametrize("name", ARCHS)
+def test_train_mode_runs(bundles, name):
+    bundle, variables = bundles[name]
+    x = jnp.ones((BATCH, bundle.input_size, bundle.input_size, 3), jnp.float32)
+    out, mutated = bundle.model.apply(
+        variables, x, train=True,
+        rngs={"dropout": jax.random.PRNGKey(1)},
+        mutable=["batch_stats"] if "batch_stats" in variables else [],
+    )
+    if bundle.has_aux_logits:
+        logits, aux = out
+        assert logits.shape == aux.shape == (BATCH, NUM_CLASSES)
+    else:
+        assert out.shape == (BATCH, NUM_CLASSES)
+    if "batch_stats" in variables:
+        # BN running stats actually update in train mode
+        before = jax.tree_util.tree_leaves(variables["batch_stats"])
+        after = jax.tree_util.tree_leaves(mutated["batch_stats"])
+        assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+
+@pytest.mark.parametrize("name", [n for n, v in EXPECTED_PARAMS.items() if v is not None])
+def test_param_counts_match_torchvision(bundles, name):
+    _, variables = bundles[name]
+    assert _count(variables["params"]) == EXPECTED_PARAMS[name]
+
+
+def test_invalid_name_raises():
+    with pytest.raises(ValueError, match="unsupported model"):
+        initialize_model("resnet50", 10)
+
+
+def test_feature_extract_mask_covers_only_head(bundles):
+    bundle, variables = create_model_bundle(
+        "resnet18", NUM_CLASSES, feature_extract=True, rng=jax.random.PRNGKey(0), image_size=64
+    )
+    mask = bundle.trainable_mask
+    leaves = jax.tree_util.tree_flatten_with_path(mask)[0]
+    trainable = [p for p, v in leaves if v]
+    frozen = [p for p, v in leaves if not v]
+    assert len(trainable) == 2  # head kernel + bias
+    assert all("head" in str(p) for p in trainable)
+    assert len(frozen) > 50
+
+
+def test_inception_aux_mask():
+    bundle, variables = create_model_bundle(
+        "inception_v3", NUM_CLASSES, feature_extract=True,
+        rng=jax.random.PRNGKey(0), image_size=299,
+    )
+    leaves = jax.tree_util.tree_flatten_with_path(bundle.trainable_mask)[0]
+    trainable = [str(p) for p, v in leaves if v]
+    # both fc and AuxLogits.fc stay trainable (reference models.py:90-94)
+    assert any("aux_head" in p for p in trainable)
+    assert any("'head'" in p for p in trainable)
+
+
+def test_bn_free_alexnet_has_no_batch_stats():
+    model, _ = initialize_model("alexnet", NUM_CLASSES)
+    variables = init_variables(model, 64, jax.random.PRNGKey(0))
+    assert "batch_stats" not in variables
