@@ -91,6 +91,10 @@ class Config:
     compute_dtype: str = "bfloat16"  # MXU-native; params stay float32
     param_dtype: str = "float32"
     sync_batchnorm: bool = False  # reference keeps per-rank local BN stats (SURVEY §7)
+    # spmd_mode=True uses the shard_map step with explicit collectives and
+    # per-shard local BN — exact reference DP semantics; default is the
+    # compiler-partitioned jit step (global-batch BN, supports TP head).
+    spmd_mode: bool = False
 
     # --- input pipeline ---
     shuffle: bool = True
@@ -128,6 +132,12 @@ class Config:
             raise ValueError(f"learning_rate must be > 0, got {self.learning_rate}")
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"compute_dtype must be float32|bfloat16, got {self.compute_dtype}")
+        if self.spmd_mode and self.mesh.model_parallel > 1:
+            raise ValueError(
+                "spmd_mode is pure data-parallel (reference-parity shard_map step); "
+                "its replicated in/out specs would silently gather the TP-sharded "
+                "head. Use the default auto mode for mesh.model_parallel > 1."
+            )
         self.mesh.validate()
 
     @property

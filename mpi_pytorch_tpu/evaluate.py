@@ -1,0 +1,91 @@
+"""Inference/evaluation driver — the TPU-native ``evaluation_pipeline.py``.
+
+The reference runs inference as a 4-stage MPI pipeline (``evaluation_pipeline
+.py:162-199``): rank 0 reads images and streams them to rank 1 (resize), then
+rank 2 (normalize), then a randomly-assigned predictor rank ≥3 runs a
+single-image forward (``:149-158``), and a final ``comm.reduce`` sums
+per-predictor accuracies (``:196``).
+
+Here the same four capabilities collapse into a batched dataflow (the
+BASELINE.json north star):
+
+| reference stage (rank)            | here                                    |
+|-----------------------------------|-----------------------------------------|
+| read_images (rank 0, ``:53-71``)  | DataLoader worker threads (PIL decode)  |
+| resize_images (rank 1, ``:74-96``)| same workers — decode+resize fused      |
+| preprocess_image (rank 2,``:99-129``)| same workers — normalize fused       |
+| predict (ranks ≥3, ``:132-159``)  | one jitted batched forward over all chips|
+| reduce(acc, SUM) (``:196``)       | on-device sum via the sharded eval step |
+
+The stage *overlap* the MPI pipeline bought with dedicated ranks is provided
+by the loader's thread pool + prefetch queue; the random image→predictor
+routing (``:178``) is just batch sharding over the ``data`` mesh axis; the
+per-image ``model(image[None])`` forward becomes a full-batch MXU matmul.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+
+from mpi_pytorch_tpu import checkpoint as ckpt
+from mpi_pytorch_tpu.config import Config, parse_config
+from mpi_pytorch_tpu.data import load_manifests
+from mpi_pytorch_tpu.train.trainer import build_training, evaluate_manifest
+from mpi_pytorch_tpu.utils.logging import MetricsWriter, init_logger
+
+
+@dataclass
+class EvalSummary:
+    accuracy: float
+    mean_loss: float
+    num_images: int
+    wall_s: float
+    images_per_sec: float
+
+
+def evaluate(cfg: Config) -> EvalSummary:
+    logger = init_logger("MPT_EVAL", cfg.eval_log_file)
+    mesh, bundle, state, (train_manifest, test_manifest, _) = build_training(cfg)
+
+    latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
+    if latest:
+        # ≙ predictor ranks loading the trained checkpoint
+        # (evaluation_pipeline.py:142-144).
+        state, epoch, loss = ckpt.load_checkpoint(latest, state)
+        logger.info("loaded checkpoint %s (epoch %d)", latest, epoch)
+    else:
+        logger.info("no checkpoint in %s — evaluating fresh init", cfg.checkpoint_dir)
+
+    from mpi_pytorch_tpu.train.step import place_state_on_mesh
+
+    state = place_state_on_mesh(state, mesh)
+
+    t0 = time.perf_counter()
+    acc, mean_loss = evaluate_manifest(cfg, state, mesh, test_manifest)
+    wall = time.perf_counter() - t0
+    n = len(test_manifest)
+    # ≙ rank-0 final accuracy log (evaluation_pipeline.py:198-199)
+    logger.info("Accuracy of the network: %.4f (%d images, %.2f s)", acc, n, wall)
+    writer = MetricsWriter("metrics.jsonl")
+    writer.write(
+        {"kind": "eval", "accuracy": acc, "loss": mean_loss, "images": n, "time_s": wall}
+    )
+    writer.close()
+    return EvalSummary(
+        accuracy=acc,
+        mean_loss=mean_loss,
+        num_images=n,
+        wall_s=wall,
+        images_per_sec=n / wall if wall > 0 else 0.0,
+    )
+
+
+def main(argv=None) -> EvalSummary:
+    return evaluate(parse_config(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""Device mesh construction and sharding rules.
+
+The reference's process model — N MPI ranks, each a full model replica
+(``main.py:16-18``) — becomes one global ``jax.sharding.Mesh`` with a
+``data`` axis (DP, ≙ MPI ranks) and a ``model`` axis (TP). The reference has
+no tensor parallelism (SURVEY §2c), but its 64 500-class head is the one
+layer where sharding matters (512×64500 ≈ 33 M params for resnet18, ~25% of
+the model): the ``model`` axis column-shards exactly that head, as a config
+change (``--mesh.model-parallel N``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mpi_pytorch_tpu.config import MeshConfig
+
+
+def create_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
+    """Build a (data, model) mesh over all devices (or the given ones)."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    mp = cfg.model_parallel
+    if n % mp != 0:
+        raise ValueError(f"{n} devices not divisible by model_parallel={mp}")
+    dp = cfg.data_parallel if cfg.data_parallel > 0 else n // mp
+    if dp * mp != n:
+        raise ValueError(f"data_parallel×model_parallel = {dp}×{mp} != {n} devices")
+    arr = np.asarray(devices).reshape(dp, mp)
+    return Mesh(arr, (cfg.data_axis, cfg.model_axis))
+
+
+def batch_spec(mesh: Mesh) -> P:
+    """Batch axis sharded over data; feature axes replicated."""
+    return P(mesh.axis_names[0])
+
+
+def is_head_kernel(path_keys: tuple) -> tuple[bool, bool]:
+    """(is_head_param, is_kernel) for a param path. Head layers are named
+    ``head``/``aux_head`` across the whole zoo (models/common.py)."""
+    keys = [str(getattr(k, "key", k)) for k in path_keys]
+    is_head = any(k in ("head", "aux_head") for k in keys)
+    return is_head, keys[-1] == "kernel"
+
+
+def param_specs(params: Any, mesh: Mesh) -> Any:
+    """PartitionSpecs for a param tree: classifier-head kernels column-sharded
+    over the ``model`` axis (Megatron-style vocab-parallel classifier), head
+    bias sharded likewise, everything else replicated (pure DP)."""
+    model_axis = mesh.axis_names[1]
+
+    def spec(path, leaf):
+        is_head, is_kernel = is_head_kernel(path)
+        if not is_head or mesh.shape[model_axis] == 1:
+            return P()
+        if is_kernel:
+            # Dense kernel [in, out] or 1×1-conv kernel [kh, kw, in, out]:
+            # shard the output (class) dim, provided it divides evenly.
+            if leaf.shape[-1] % mesh.shape[model_axis] == 0:
+                return P(*([None] * (leaf.ndim - 1) + [model_axis]))
+            return P()
+        if leaf.ndim == 1 and leaf.shape[0] % mesh.shape[model_axis] == 0:
+            return P(model_axis)  # bias over classes
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def named_shardings(tree_specs: Any, mesh: Mesh) -> Any:
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), tree_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch: tuple, mesh: Mesh) -> tuple:
+    """Place a host batch onto the mesh, batch axis over ``data`` — the
+    scatter step (``main.py:91``) as a pure device_put."""
+    data_axis = mesh.axis_names[0]
+
+    def put(x):
+        spec = P(data_axis, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, batch)
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    return int(math.ceil(n / k) * k)

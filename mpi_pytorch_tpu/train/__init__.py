@@ -1,0 +1,21 @@
+from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
+from mpi_pytorch_tpu.train.step import (
+    make_eval_step,
+    make_spmd_train_step,
+    make_train_step,
+    place_state_on_mesh,
+)
+from mpi_pytorch_tpu.train.trainer import TrainSummary, build_training, evaluate_manifest, train
+
+__all__ = [
+    "TrainState",
+    "TrainSummary",
+    "build_training",
+    "evaluate_manifest",
+    "make_eval_step",
+    "make_optimizer",
+    "make_spmd_train_step",
+    "make_train_step",
+    "place_state_on_mesh",
+    "train",
+]

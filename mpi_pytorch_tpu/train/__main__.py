@@ -1,0 +1,9 @@
+"""Entry point: ``python -m mpi_pytorch_tpu.train`` — the launch command that
+replaces ``mpiexec -n N python -m mpi4py main.py`` (``README.md:38`` in the
+reference). On a multi-host pod, launch once per host; the mesh spans all
+chips via ``jax.distributed``."""
+
+from mpi_pytorch_tpu.train.trainer import main
+
+if __name__ == "__main__":
+    main()

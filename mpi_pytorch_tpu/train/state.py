@@ -1,0 +1,62 @@
+"""Train state: params + BN running stats + optimizer state + step + rng.
+
+The reference's analogue is the (model, optimizer) pair of torch objects
+(``main.py:121-125``) whose state lives implicitly in mutable modules. Here
+it is one immutable pytree, which is what makes the whole step jittable and
+shardable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: Any
+    batch_stats: Any  # None for BN-free models (alexnet, squeezenet)
+    opt_state: Any
+    rng: jax.Array
+    # static (non-pytree) fields:
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    @classmethod
+    def create(cls, *, apply_fn, variables: dict, tx, rng: jax.Array) -> "TrainState":
+        params = variables["params"]
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=variables.get("batch_stats"),
+            opt_state=tx.init(params),
+            rng=rng,
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+
+    @property
+    def variables(self) -> dict:
+        v = {"params": self.params}
+        if self.batch_stats is not None:
+            v["batch_stats"] = self.batch_stats
+        return v
+
+
+def make_optimizer(
+    learning_rate: float, trainable_mask: Any | None = None
+) -> optax.GradientTransformation:
+    """Adam(lr) (≙ ``main.py:125``). With ``feature_extract``, non-head params
+    get zero updates — the optax expression of ``requires_grad=False``
+    (reference ``models.py:5-13``)."""
+    tx = optax.adam(learning_rate)
+    if trainable_mask is None:
+        return tx
+    labels = jax.tree_util.tree_map(lambda t: "train" if t else "freeze", trainable_mask)
+    return optax.multi_transform(
+        {"train": tx, "freeze": optax.set_to_zero()}, labels
+    )

@@ -1,0 +1,219 @@
+"""The jitted train/eval steps — the heart of the framework.
+
+This single compiled function subsumes reference components #1 (hot loop,
+``main.py:146-155``), #2 (the entire ``mpi_tools.py`` gradient-sync stack),
+and the predict stage of #7 (SURVEY §2a). Two interchangeable SPMD styles:
+
+- **auto** (default): one ``jit`` over the mesh; batch sharded on ``data``,
+  params replicated except the classifier head, which is column-sharded over
+  ``model`` (vocab-parallel, for the 64 500-class head). XLA's partitioner
+  inserts the gradient all-reduce — the compiler-native equivalent of
+  ``mpi_avg_grads`` (``mpi_tools.py:30-37``). BatchNorm sees the global
+  batch (sync-BN semantics).
+
+- **spmd** (reference-parity): ``shard_map`` over the ``data`` axis with
+  *explicit* collectives from ``parallel/collectives.py`` — per-shard forward
+  with **local** BN statistics (exactly the reference's per-rank BN, SURVEY
+  §7 'BatchNorm under DP'), then one fused ``pmean`` over grads. This is the
+  direct structural descendant of ``mpiexec`` + ``mpi_avg_grads``.
+
+Both satisfy: N-shard step == 1-device step on the concatenated batch (up to
+BN-stats bookkeeping); tests/test_parallel.py asserts it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from mpi_pytorch_tpu.ops.losses import accuracy_count, classification_loss
+from mpi_pytorch_tpu.parallel import collectives
+from mpi_pytorch_tpu.parallel.mesh import named_shardings, param_specs
+from mpi_pytorch_tpu.train.state import TrainState
+
+
+def _loss_and_updates(state: TrainState, images, labels, rng):
+    """Shared core: forward (train mode), loss, logits, new batch_stats."""
+
+    def loss_fn(params):
+        variables = {"params": params}
+        # NB: mutable=[] would still make flax return an (out, {}) tuple;
+        # mutable=False is the "plain output" mode for BN-free models.
+        mutable: Any = False
+        if state.batch_stats is not None:
+            variables["batch_stats"] = state.batch_stats
+            mutable = ["batch_stats"]
+        out = state.apply_fn(
+            variables, images, train=True, rngs={"dropout": rng}, mutable=mutable
+        )
+        new_bs = None
+        if mutable:
+            out, updated = out
+            new_bs = updated["batch_stats"]
+        loss = classification_loss(out, labels)
+        logits = out[0] if isinstance(out, tuple) else out
+        return loss, (new_bs, logits)
+
+    (loss, (new_bs, logits)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        state.params
+    )
+    return loss, logits, new_bs, grads
+
+
+def _apply_updates(state: TrainState, grads, new_bs) -> TrainState:
+    updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
+    new_params = optax.apply_updates(state.params, updates)
+    return state.replace(
+        step=state.step + 1,
+        params=new_params,
+        batch_stats=new_bs if state.batch_stats is not None else None,
+        opt_state=new_opt,
+        rng=jax.random.fold_in(state.rng, 1),
+    )
+
+
+# ---------------------------------------------------------------------------
+# auto mode: compiler-partitioned jit
+# ---------------------------------------------------------------------------
+
+
+def make_train_step(compute_dtype=jnp.bfloat16) -> Callable:
+    """Auto-sharded train step: ``jit(step)`` with donated state. Sharding
+    comes from the input arrays' placements (state placed by
+    ``place_state_on_mesh``, batch by ``mesh.shard_batch``)."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: TrainState, batch):
+        images, labels = batch
+        images = images.astype(compute_dtype)
+        rng = jax.random.fold_in(state.rng, state.step)
+        loss, logits, new_bs, grads = _loss_and_updates(state, images, labels, rng)
+        new_state = _apply_updates(state, grads, new_bs)
+        metrics = {
+            "loss": loss,
+            "correct": accuracy_count(logits, labels),
+            "count": jnp.asarray(labels.shape[0], jnp.int32),
+        }
+        return new_state, metrics
+
+    return train_step
+
+
+@functools.lru_cache(maxsize=None)
+def make_eval_step(compute_dtype=jnp.bfloat16) -> Callable:
+    """Batched eval forward (≙ validation loop body ``main.py:173-182`` and
+    the predict stage ``evaluation_pipeline.py:149-158``, batched).
+
+    Memoized so per-epoch validation reuses one jitted function (and its XLA
+    cache) instead of recompiling the forward every epoch."""
+
+    @jax.jit
+    def eval_step(state: TrainState, batch):
+        images, labels = batch
+        # labels < 0 mark padding rows (tail batches padded to a static
+        # shape so XLA never recompiles; see trainer.evaluate_manifest).
+        valid = labels >= 0
+        safe_labels = jnp.maximum(labels, 0)
+        logits = state.apply_fn(state.variables, images.astype(compute_dtype), train=False)
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), safe_labels
+        )
+        return {
+            "loss": jnp.sum(per_ex * valid),
+            "correct": jnp.sum((jnp.argmax(logits, axis=-1) == labels) & valid),
+            "count": jnp.sum(valid.astype(jnp.int32)),
+        }
+
+    return eval_step
+
+
+def place_state_on_mesh(state: TrainState, mesh) -> TrainState:
+    """Device-put the state with DP/TP shardings: head column-sharded over
+    ``model``, everything else replicated. Opt-state mirrors param shardings
+    (Adam moments have the params' tree structure)."""
+    specs = param_specs(state.params, mesh)
+    p_shard = named_shardings(specs, mesh)
+    rep = NamedSharding(mesh, P())
+
+    new_params = jax.tree_util.tree_map(jax.device_put, state.params, p_shard)
+
+    def put_opt_tree(opt_state):
+        # optax states (adam mu/nu) contain params-shaped subtrees plus
+        # scalars; match shardings by (shape, dtype), replicate the rest.
+        shape_map = {}
+        for pl, ps in zip(
+            jax.tree_util.tree_leaves(state.params), jax.tree_util.tree_leaves(p_shard)
+        ):
+            shape_map.setdefault((pl.shape, str(pl.dtype)), ps)
+
+        def put(leaf):
+            if hasattr(leaf, "shape"):
+                return jax.device_put(leaf, shape_map.get((leaf.shape, str(leaf.dtype)), rep))
+            return leaf
+
+        return jax.tree_util.tree_map(put, opt_state)
+
+    return state.replace(
+        params=new_params,
+        batch_stats=jax.device_put(state.batch_stats, rep)
+        if state.batch_stats is not None
+        else None,
+        opt_state=put_opt_tree(state.opt_state),
+        step=jax.device_put(state.step, rep),
+        rng=jax.device_put(state.rng, rep),
+    )
+
+
+# ---------------------------------------------------------------------------
+# spmd mode: shard_map with explicit collectives (reference-parity semantics)
+# ---------------------------------------------------------------------------
+
+
+def make_spmd_train_step(mesh, compute_dtype=jnp.bfloat16) -> Callable:
+    """Reference-parity DP step: shard_map over ``data``; local BN stats;
+    explicit ``avg_grads`` pmean — the literal TPU translation of one
+    training iteration of ``mpiexec -n N python -m mpi4py main.py``."""
+    data_axis = mesh.axis_names[0]
+
+    def per_shard(state: TrainState, batch):
+        images, labels = batch
+        images = images.astype(compute_dtype)
+        # Per-shard rng ≙ each MPI rank's independent dropout stream.
+        rng = jax.random.fold_in(
+            jax.random.fold_in(state.rng, state.step), lax.axis_index(data_axis)
+        )
+        loss, logits, new_bs, grads = _loss_and_updates(state, images, labels, rng)
+
+        # THE line (≙ the entire mpi_avg_grads stack, mpi_tools.py:30-37):
+        grads = collectives.avg_grads(grads, axis=data_axis)
+
+        # Running BN stats: normalization above used LOCAL batch stats
+        # (reference per-rank semantics); the stored running averages are
+        # pmean'd so the replicated state stays consistent across shards
+        # (the reference instead checkpoints rank 0's stats, main.py:162-171).
+        if new_bs is not None:
+            new_bs = collectives.all_reduce(new_bs, "mean", axis=data_axis)
+
+        new_state = _apply_updates(state, grads, new_bs)
+        metrics = {
+            "loss": lax.pmean(loss, data_axis),
+            "correct": lax.psum(accuracy_count(logits, labels), data_axis),
+            "count": lax.psum(jnp.asarray(labels.shape[0], jnp.int32), data_axis),
+        }
+        return new_state, metrics
+
+    sharded = shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(), (P(data_axis), P(data_axis))),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))

@@ -1,0 +1,254 @@
+"""Training driver — the TPU-native ``main.py``.
+
+Structural parity with the reference driver (``main.py:49-189``), stage by
+stage:
+
+| reference (main.py)                         | here                           |
+|---------------------------------------------|--------------------------------|
+| MPI world setup (``:16-18``)                | mesh over all chips            |
+| rank-0 CSV read + scatter (``:73-91``)      | ``load_manifests`` + per-host shard |
+| DataLoader(batch, shuffle) (``:99-102``)    | ``DataLoader`` (prefetching)   |
+| model/opt init (``:121-125``)               | ``create_model_bundle`` + optax|
+| FROM_CHECKPOINT resume (``:127-129``)       | ``latest_checkpoint`` restore  |
+| ``sync_params`` broadcast (``:131``)        | ``place_state_on_mesh``        |
+| epoch loop + ``mpi_avg_grads`` (``:142-160``)| jitted DP step over the mesh  |
+| rank-0 checkpoint (``:162-171``)            | process-0 ``save_checkpoint``  |
+| rank-0 validation (``:173-185``)            | sharded batched eval           |
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_pytorch_tpu import checkpoint as ckpt
+from mpi_pytorch_tpu.config import Config
+from mpi_pytorch_tpu.data import DataLoader, load_manifests
+from mpi_pytorch_tpu.models import create_model_bundle
+from mpi_pytorch_tpu.parallel.mesh import create_mesh, shard_batch
+from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
+from mpi_pytorch_tpu.train.step import (
+    make_eval_step,
+    make_spmd_train_step,
+    make_train_step,
+    place_state_on_mesh,
+)
+from mpi_pytorch_tpu.utils.logging import MetricsWriter, init_logger
+
+
+@dataclass
+class TrainSummary:
+    epochs_run: int = 0
+    final_loss: float = float("nan")
+    val_accuracy: float | None = None
+    epoch_times: list = field(default_factory=list)
+    images_per_sec: float = 0.0
+    checkpoint_path: str | None = None
+    epoch_losses: list = field(default_factory=list)
+
+
+def _dtype(name: str):
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[name]
+
+
+def build_training(cfg: Config, mesh=None):
+    """Construct (mesh, bundle, state, loaders, step fns) for cfg — shared by
+    the trainer, the eval pipeline, and the graft entry points."""
+    mesh = mesh or create_mesh(cfg.mesh)
+    compute_dtype = _dtype(cfg.compute_dtype)
+
+    train_manifest, test_manifest = load_manifests(cfg)
+    # Per-host sharding ≙ rank-0 scatter (main.py:84-91): host p reads only
+    # its own shard; no coordinator, no pickled dataframes over the wire.
+    host_shard = train_manifest.shard(jax.process_count(), jax.process_index())
+
+    if cfg.batch_size % jax.process_count() != 0:
+        raise ValueError(
+            f"global batch {cfg.batch_size} not divisible by {jax.process_count()} hosts"
+        )
+    host_batch = cfg.batch_size // jax.process_count()
+
+    train_loader = DataLoader(
+        host_shard,
+        batch_size=host_batch,
+        image_size=cfg.image_size,
+        shuffle=cfg.shuffle,
+        seed=cfg.seed,
+        drop_remainder=cfg.drop_remainder,
+        synthetic=cfg.synthetic_data,
+        num_workers=cfg.loader_workers,
+        prefetch=cfg.prefetch_batches,
+    )
+
+    bundle, variables = create_model_bundle(
+        cfg.model_name,
+        cfg.num_classes,
+        feature_extract=cfg.feature_extract,
+        use_pretrained=cfg.use_pretrained,
+        rng=jax.random.PRNGKey(cfg.seed),
+        image_size=cfg.image_size[0],
+        dtype=compute_dtype,
+        param_dtype=jnp.float32,
+        # Sync-BN: in spmd mode the axis name must be bound inside shard_map;
+        # in auto mode BN already normalizes over the logical global batch
+        # (the compiler inserts the cross-device mean), so no axis is needed.
+        bn_axis_name=mesh.axis_names[0] if (cfg.sync_batchnorm and cfg.spmd_mode) else None,
+        pretrained_dir=cfg.pretrained_dir,
+    )
+    tx = make_optimizer(cfg.learning_rate, bundle.trainable_mask)
+    state = TrainState.create(
+        apply_fn=bundle.model.apply,
+        variables=variables,
+        tx=tx,
+        rng=jax.random.PRNGKey(cfg.seed + 1),
+    )
+    return mesh, bundle, state, (train_manifest, test_manifest, train_loader)
+
+
+def evaluate_manifest(cfg: Config, state: TrainState, mesh, manifest) -> tuple[float, float]:
+    """Batched sharded eval over a manifest → (accuracy, mean_loss).
+    ≙ the rank-0 validation loop (``main.py:173-185``), but using every chip."""
+    eval_step = make_eval_step(_dtype(cfg.compute_dtype))
+    host_batch = cfg.batch_size // jax.process_count()
+    loader = DataLoader(
+        manifest.shard(jax.process_count(), jax.process_index()),
+        batch_size=host_batch,
+        image_size=cfg.image_size,
+        shuffle=False,
+        drop_remainder=False,
+        synthetic=cfg.synthetic_data,
+        num_workers=cfg.loader_workers,
+        prefetch=cfg.prefetch_batches,
+    )
+    correct = total = 0
+    loss_sum = 0.0
+    for images, labels in loader.epoch(0):
+        n = images.shape[0]
+        if n < host_batch:
+            # Pad the tail to the static batch shape; label -1 marks padding
+            # rows, which the eval step masks out. No recompilation, no
+            # dropped images (the reference's DataLoader keeps tails too).
+            pad = host_batch - n
+            images = np.concatenate([images, np.zeros((pad, *images.shape[1:]), images.dtype)])
+            labels = np.concatenate([labels, np.full(pad, -1, labels.dtype)])
+        m = eval_step(state, shard_batch((images, labels), mesh))
+        correct += int(m["correct"])
+        total += int(m["count"])
+        loss_sum += float(m["loss"])
+    if total == 0:
+        return 0.0, float("nan")
+    return correct / total, loss_sum / total
+
+
+def train(cfg: Config) -> TrainSummary:
+    logger = init_logger("MPT", cfg.log_file)
+    metrics = MetricsWriter("metrics.jsonl")
+    mesh, bundle, state, (train_manifest, test_manifest, loader) = build_training(cfg)
+    logger.info(
+        "world: %d process(es), %d device(s), mesh %s",
+        jax.process_count(), jax.device_count(), dict(mesh.shape),
+    )
+    logger.info(
+        "model %s | %d classes | global batch %d | shard %d images (≙ scatter, main.py:84-91)",
+        cfg.model_name, cfg.num_classes, cfg.batch_size, len(loader.manifest),
+    )
+
+    start_epoch = 0
+    if cfg.from_checkpoint:
+        latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
+        if latest:
+            state, start_epoch, last_loss = ckpt.load_checkpoint(latest, state)
+            start_epoch += 1
+            logger.info("resumed from %s (epoch %d, loss %.4f)", latest, start_epoch, last_loss)
+        else:
+            logger.info("from_checkpoint=True but no checkpoint found; fresh start")
+
+    state = place_state_on_mesh(state, mesh)
+    if cfg.spmd_mode:
+        step_fn = make_spmd_train_step(mesh, _dtype(cfg.compute_dtype))
+    else:
+        step_fn = make_train_step(_dtype(cfg.compute_dtype))
+
+    summary = TrainSummary()
+    total_images = 0
+    train_t0 = time.perf_counter()
+    epoch_loss = float("nan")
+
+    # SURVEY §5 observability: step-level XLA traces, viewable in TensorBoard
+    # (the reference only has MPI.Wtime wall-clock pairs, main.py:145,158).
+    profiling = bool(cfg.profile_dir)
+    if profiling:
+        jax.profiler.start_trace(cfg.profile_dir)
+
+    for epoch in range(start_epoch, cfg.num_epochs):
+        t0 = time.perf_counter()  # ≙ MPI.Wtime() (main.py:145)
+        losses = []
+        for step_i, batch in enumerate(loader.epoch(epoch)):
+            state, m = step_fn(state, shard_batch(batch, mesh))
+            losses.append(m["loss"])
+            total_images += cfg.batch_size
+            if cfg.log_every_steps and (step_i + 1) % cfg.log_every_steps == 0:
+                logger.info(
+                    "epoch %d step %d loss %.4f", epoch, step_i + 1, float(m["loss"])
+                )
+        # Device sync so the timer measures compute, not dispatch.
+        jax.block_until_ready(state.params)
+        dt = time.perf_counter() - t0
+        epoch_loss = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
+        ips = (len(losses) * cfg.batch_size) / dt if dt > 0 else 0.0
+        # ≙ reference epoch log line (main.py:158-160)
+        logger.info(
+            "Epoch: %d, Loss: %.6f, Time: %.2f s, %.1f img/s", epoch, epoch_loss, dt, ips
+        )
+        metrics.write(
+            {"kind": "epoch", "epoch": epoch, "loss": epoch_loss, "time_s": dt,
+             "images_per_sec": ips}
+        )
+        summary.epoch_times.append(dt)
+        summary.epoch_losses.append(epoch_loss)
+        summary.epochs_run += 1
+
+        if (epoch + 1) % cfg.checkpoint_every_epochs == 0:
+            path = ckpt.save_checkpoint(
+                cfg.checkpoint_dir, epoch=epoch, state=state, loss=epoch_loss,
+                keep=cfg.keep_checkpoints,
+            )
+            if path:
+                summary.checkpoint_path = path
+                logger.info("checkpoint saved: %s (≙ main.py:162-171)", path)
+
+        if cfg.validate:
+            # Reference quirk preserved behind a flag: validation runs over the
+            # TRAIN manifest (main.py:104-112; SURVEY §3); val_on_train=False
+            # gives the honest test-split validation.
+            val_manifest = train_manifest if cfg.val_on_train else test_manifest
+            acc, vloss = evaluate_manifest(cfg, state, mesh, val_manifest)
+            summary.val_accuracy = acc
+            logger.info("Accuracy of the network: %.4f (val_on_train=%s)", acc, cfg.val_on_train)
+            metrics.write({"kind": "val", "epoch": epoch, "accuracy": acc, "loss": vloss})
+
+    if profiling:
+        jax.profiler.stop_trace()
+        logger.info("profiler trace written to %s", cfg.profile_dir)
+
+    wall = time.perf_counter() - train_t0
+    summary.final_loss = epoch_loss
+    summary.images_per_sec = total_images / wall if wall > 0 else 0.0
+    metrics.close()
+    return summary
+
+
+def main(argv=None) -> TrainSummary:
+    from mpi_pytorch_tpu.config import parse_config
+
+    cfg = parse_config(argv)
+    return train(cfg)
+
+
+if __name__ == "__main__":
+    main()

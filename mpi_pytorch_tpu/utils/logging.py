@@ -34,21 +34,28 @@ def init_logger(name: str = "MPT", log_file: str | None = "training.log",
     logger = logging.getLogger(f"{name}_R{rank}")
     logger.setLevel(level)
     logger.propagate = False
-    if logger.handlers:  # idempotent re-init, unlike the reference
-        return logger
 
     fmt = logging.Formatter(
         "%(asctime)s %(name)s %(levelname)s: %(message)s", datefmt="%Y-%m-%d %H:%M:%S"
     )
-    sh = logging.StreamHandler(sys.stdout)
-    sh.setFormatter(fmt)
-    logger.addHandler(sh)
+    if not any(isinstance(h, logging.StreamHandler) and not isinstance(h, logging.FileHandler)
+               for h in logger.handlers):
+        sh = logging.StreamHandler(sys.stdout)
+        sh.setFormatter(fmt)
+        logger.addHandler(sh)
     if log_file:
-        os.makedirs(os.path.dirname(log_file) or ".", exist_ok=True)
-        fh = logging.FileHandler(log_file)
-        fh.setFormatter(fmt)
-        logger.addHandler(fh)
-    logger.info("Logger Initialized (process %d)", rank)
+        target = os.path.abspath(log_file)
+        file_handlers = [h for h in logger.handlers if isinstance(h, logging.FileHandler)]
+        if not any(h.baseFilename == target for h in file_handlers):
+            # Re-init with a different path (new run/config): swap file handlers.
+            for h in file_handlers:
+                logger.removeHandler(h)
+                h.close()
+            os.makedirs(os.path.dirname(log_file) or ".", exist_ok=True)
+            fh = logging.FileHandler(log_file)
+            fh.setFormatter(fmt)
+            logger.addHandler(fh)
+            logger.info("Logger Initialized (process %d)", rank)
     return logger
 
 

@@ -1,13 +1,21 @@
 """Test env: 8 virtual CPU devices so the real sharded code paths run without
 TPU hardware — the TPU-native analogue of testing MPI code without a cluster
-(SURVEY §4). Must run before jax is imported anywhere."""
+(SURVEY §4).
+
+Note: this image's sitecustomize imports jax at interpreter startup and
+latches ``jax_platforms`` from the env, so plain env assignment here is too
+late — we must go through ``jax.config.update`` (backend init is lazy, so
+this still lands before any device is created)."""
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

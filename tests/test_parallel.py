@@ -1,0 +1,195 @@
+"""Simulated-distributed tests on 8 virtual CPU devices (SURVEY §4 item 2):
+the correctness property ``mpi_avg_grads`` implicitly provides — an N-shard
+DP step equals a single-device step on the concatenated batch — plus TP head
+sharding and collectives parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from mpi_pytorch_tpu.config import MeshConfig
+from mpi_pytorch_tpu.models import create_model_bundle
+from mpi_pytorch_tpu.parallel import collectives, create_mesh, param_specs, shard_batch
+from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
+from mpi_pytorch_tpu.train.step import (
+    make_spmd_train_step,
+    make_train_step,
+    place_state_on_mesh,
+)
+
+BATCH = 16
+NUM_CLASSES = 8
+SIZE = 32
+
+
+def _setup(model="resnet18", lr=1e-3, sgd=False):
+    import optax
+
+    bundle, variables = create_model_bundle(
+        model, NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=SIZE
+    )
+    # Equivalence tests use SGD: Adam's m/√v normalization amplifies
+    # reduction-order noise on near-zero grads into ±lr sign flips.
+    tx = optax.sgd(lr) if sgd else make_optimizer(lr)
+    state = TrainState.create(
+        apply_fn=bundle.model.apply, variables=variables, tx=tx, rng=jax.random.PRNGKey(1)
+    )
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(BATCH, SIZE, SIZE, 3)).astype(np.float32)
+    labels = (np.arange(BATCH) % NUM_CLASSES).astype(np.int32)
+    return bundle, state, (images, labels)
+
+
+def test_mesh_shapes():
+    mesh = create_mesh(MeshConfig())
+    assert mesh.shape == {"data": 8, "model": 1}
+    mesh = create_mesh(MeshConfig(model_parallel=2))
+    assert mesh.shape == {"data": 4, "model": 2}
+    with pytest.raises(ValueError):
+        create_mesh(MeshConfig(model_parallel=3))
+
+
+def test_head_param_specs_tp():
+    mesh = create_mesh(MeshConfig(model_parallel=2))
+    bundle, variables = create_model_bundle(
+        "resnet18", NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=SIZE
+    )
+    specs = param_specs(variables["params"], mesh)
+    assert specs["head"]["kernel"] == P(None, "model")
+    assert specs["head"]["bias"] == P("model")
+    assert specs["conv1"]["kernel"] == P()
+
+
+@pytest.mark.parametrize("mode", ["auto", "spmd"])
+def test_dp_step_equals_single_device(mode):
+    """8-way DP step == single-device step on the full batch.
+
+    float32 compute; BN-free check not needed for spmd since local-vs-global
+    BN stats only affect running averages, not the normalized activations...
+    except they DO affect normalization (local batch mean). So use alexnet
+    (BN-free) for exact equivalence, dropout disabled via eval-free seed:
+    alexnet has dropout — fix by using resnet18 for auto (sync-BN == global
+    batch norm == single-device norm) and squeezenet (BN-free, has dropout
+    only before head... it has dropout too). Use resnet18 + spmd with
+    per-shard BN: equivalence holds only for auto. For spmd, assert gradient
+    averaging correctness on a BN-free, dropout-free stack instead — covered
+    in test_spmd_grads_match_manual_average.
+    """
+    if mode == "spmd":
+        pytest.skip("covered by test_spmd_grads_match_manual_average")
+    bundle, state, batch = _setup(sgd=True)
+    single_step = make_train_step(compute_dtype=jnp.float32)
+    s1, m1 = single_step(state, (jnp.asarray(batch[0]), jnp.asarray(batch[1])))
+
+    bundle2, state2, _ = _setup(sgd=True)
+    mesh = create_mesh(MeshConfig())
+    state2 = place_state_on_mesh(state2, mesh)
+    sharded_batch = shard_batch((batch[0], batch[1]), mesh)
+    dp_step = make_train_step(compute_dtype=jnp.float32)
+    s2, m2 = dp_step(state2, sharded_batch)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=2e-4)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s1.params), jax.tree_util.tree_leaves(s2.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_spmd_grads_match_manual_average():
+    """shard_map DP grads == mean of per-shard grads computed by hand, and
+    one spmd step == one manual 'MPI-style' step (the reference algorithm:
+    per-rank forward/backward on its shard, average grads, identical update)."""
+    from flax import linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape(x.shape[0], -1)
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(NUM_CLASSES, name="head")(x)
+
+    model = MLP()
+    rng = np.random.default_rng(1)
+    images = rng.normal(size=(BATCH, 8, 8, 3)).astype(np.float32)
+    labels = (np.arange(BATCH) % NUM_CLASSES).astype(np.int32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)), train=True)
+    tx = make_optimizer(1e-2)
+    state = TrainState.create(
+        apply_fn=model.apply, variables=variables, tx=tx, rng=jax.random.PRNGKey(2)
+    )
+
+    # Manual MPI-style reference first (the spmd step donates/deletes its
+    # input buffers, which alias state.params on single-host CPU): 8
+    # rank-local grads, averaged, single update.
+    from mpi_pytorch_tpu.ops.losses import classification_loss
+
+    def loss_fn(params, img, lab):
+        return classification_loss(model.apply({"params": params}, img, train=True), lab)
+
+    shards_i = np.split(images, 8)
+    shards_l = np.split(labels, 8)
+    grads = [
+        jax.grad(loss_fn)(state.params, jnp.asarray(i), jnp.asarray(l))
+        for i, l in zip(shards_i, shards_l)
+    ]
+    avg = jax.tree_util.tree_map(lambda *g: sum(g) / len(g), *grads)
+    updates, _ = tx.update(avg, state.opt_state, state.params)
+    import optax
+
+    manual_params = optax.apply_updates(state.params, updates)
+
+    mesh = create_mesh(MeshConfig())
+    spmd = make_spmd_train_step(mesh, compute_dtype=jnp.float32)
+    state_m = place_state_on_mesh(state, mesh)
+    s_spmd, m_spmd = spmd(state_m, shard_batch((images, labels), mesh))
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(manual_params), jax.tree_util.tree_leaves(s_spmd.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_tp_head_step_runs_and_matches_dp():
+    """dp=4 × tp=2: same loss/params as pure DP (TP must be numerically
+    transparent)."""
+    bundle, state, batch = _setup(sgd=True)
+    mesh_dp = create_mesh(MeshConfig())
+    step = make_train_step(compute_dtype=jnp.float32)
+    s_dp, m_dp = step(
+        place_state_on_mesh(state, mesh_dp), shard_batch(batch, mesh_dp)
+    )
+
+    bundle2, state2, _ = _setup(sgd=True)
+    mesh_tp = create_mesh(MeshConfig(model_parallel=2))
+    step2 = make_train_step(compute_dtype=jnp.float32)
+    s_tp, m_tp = step2(
+        place_state_on_mesh(state2, mesh_tp), shard_batch(batch, mesh_tp)
+    )
+    np.testing.assert_allclose(float(m_dp["loss"]), float(m_tp["loss"]), rtol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(s_dp.params["head"]["kernel"]),
+        np.asarray(s_tp.params["head"]["kernel"]),
+        atol=2e-4,
+    )
+
+
+def test_collectives_parity():
+    """collectives.* inside shard_map reproduce mpi_tools semantics."""
+    mesh = create_mesh(MeshConfig())
+
+    def body(x):
+        s = collectives.all_reduce(x, "sum", "data")
+        m = collectives.avg_grads({"g": x}, "data")["g"]
+        b = collectives.broadcast_from(x, "data", root=0)
+        return s, m, b
+
+    f = shard_map(body, mesh=mesh, in_specs=(P("data"),), out_specs=(P("data"), P("data"), P("data")))
+    x = jnp.arange(8, dtype=jnp.float32)
+    s, m, b = f(x)
+    np.testing.assert_allclose(np.asarray(s), np.full(8, 28.0))
+    np.testing.assert_allclose(np.asarray(m), np.full(8, 3.5))
+    np.testing.assert_allclose(np.asarray(b), np.zeros(8))  # root shard holds 0.0
+    assert collectives.num_devices() == 8
