@@ -1,0 +1,83 @@
+"""Headline benchmark: resnet18 training throughput, images/sec/chip.
+
+Mirrors the reference's north-star workload (``main.py``: resnet18, 64 500
+classes, batch 128, Adam 4e-4, 128×128 inputs) as one jitted DP train step
+over all available chips, bfloat16 compute. Prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` is value ÷ the reference's best *per-worker* throughput
+(≈4.4 img/s/worker — 800 imgs / 45.4 s over 4 MPI ranks, derived from
+``training.log:1268-1275``; see BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REFERENCE_IMG_PER_SEC_PER_WORKER = 4.4  # BASELINE.md, training.log:1268-1275
+
+MODEL = "resnet18"
+NUM_CLASSES = 64500  # utils.py:39
+IMAGE = 128          # utils.py:33-34
+GLOBAL_BATCH = 128   # utils.py:40
+WARMUP_STEPS = 5
+MEASURE_STEPS = 30
+
+
+def main() -> None:
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.models import create_model_bundle
+    from mpi_pytorch_tpu.parallel.mesh import create_mesh, shard_batch
+    from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
+    from mpi_pytorch_tpu.train.step import make_train_step, place_state_on_mesh
+
+    n_chips = jax.device_count()
+    # Per-chip batch 128 (so one chip runs the reference's exact global batch;
+    # more chips scale the global batch like adding MPI ranks does).
+    batch = GLOBAL_BATCH * n_chips
+
+    mesh = create_mesh(Config().mesh)
+    bundle, variables = create_model_bundle(
+        MODEL, NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=IMAGE,
+        dtype=jnp.bfloat16, param_dtype=jnp.float32,
+    )
+    state = TrainState.create(
+        apply_fn=bundle.model.apply, variables=variables,
+        tx=make_optimizer(4e-4), rng=jax.random.PRNGKey(1),
+    )
+    state = place_state_on_mesh(state, mesh)
+    step = make_train_step(jnp.bfloat16)
+
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((batch, IMAGE, IMAGE, 3), np.float32)
+    labels = rng.integers(0, NUM_CLASSES, size=(batch,), dtype=np.int64).astype(np.int32)
+    device_batch = shard_batch((images, labels), mesh)
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step(state, device_batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, metrics = step(state, device_batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    ips = MEASURE_STEPS * batch / dt
+    ips_per_chip = ips / n_chips
+    print(json.dumps({
+        "metric": f"{MODEL} train images/sec/chip (bf16, {NUM_CLASSES} classes, batch {GLOBAL_BATCH}/chip)",
+        "value": round(ips_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips_per_chip / REFERENCE_IMG_PER_SEC_PER_WORKER, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

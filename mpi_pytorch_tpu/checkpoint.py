@@ -36,6 +36,22 @@ def _ckpt_path(ckpt_dir: str, epoch: int) -> str:
     return os.path.join(ckpt_dir, f"ckpt_{epoch:05d}.msgpack")
 
 
+def _payload(state: Any, epoch: int = 0, loss: float = 0.0) -> dict:
+    """The single checkpoint schema, used both as the save payload and as the
+    restore template so the two can never drift apart."""
+    return {
+        "epoch": epoch,
+        "step": np.asarray(state.step),
+        "loss": np.asarray(loss, np.float32),
+        "params": jax.device_get(state.params),
+        "batch_stats": jax.device_get(state.batch_stats)
+        if state.batch_stats is not None
+        else {},
+        "opt_state": jax.device_get(state.opt_state),
+        "rng": jax.device_get(state.rng),
+    }
+
+
 def save_checkpoint(
     ckpt_dir: str,
     *,
@@ -48,17 +64,7 @@ def save_checkpoint(
     if process_index() != 0:
         return None
     os.makedirs(ckpt_dir, exist_ok=True)
-    payload = {
-        "epoch": epoch,
-        "step": np.asarray(state.step),
-        "loss": np.asarray(loss, np.float32),
-        "params": jax.device_get(state.params),
-        "batch_stats": jax.device_get(state.batch_stats)
-        if state.batch_stats is not None
-        else {},
-        "opt_state": jax.device_get(state.opt_state),
-        "rng": jax.device_get(state.rng),
-    }
+    payload = _payload(state, epoch, loss)
     path = _ckpt_path(ckpt_dir, epoch)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -95,18 +101,7 @@ def load_checkpoint(path: str, state: Any) -> tuple[Any, int, float]:
     driver can continue the epoch loop, main.py:127-129)."""
     with open(path, "rb") as f:
         data = f.read()
-    template = {
-        "epoch": 0,
-        "step": np.asarray(state.step),
-        "loss": np.zeros((), np.float32),
-        "params": jax.device_get(state.params),
-        "batch_stats": jax.device_get(state.batch_stats)
-        if state.batch_stats is not None
-        else {},
-        "opt_state": jax.device_get(state.opt_state),
-        "rng": jax.device_get(state.rng),
-    }
-    restored = serialization.from_bytes(template, data)
+    restored = serialization.from_bytes(_payload(state), data)
     new_state = state.replace(
         step=jax.numpy.asarray(restored["step"]),
         params=restored["params"],
@@ -115,3 +110,19 @@ def load_checkpoint(path: str, state: Any) -> tuple[Any, int, float]:
         rng=jax.numpy.asarray(restored["rng"]),
     )
     return new_state, int(restored["epoch"]), float(restored["loss"])
+
+
+def load_for_eval(path: str, state: Any) -> tuple[Any, int, float]:
+    """Restore params + batch_stats only — the inference path (≙ predictor
+    ranks loading just the ``state_dict``, ``evaluation_pipeline.py:142-144``).
+    No optimizer template is needed, so eval never materializes Adam moments."""
+    with open(path, "rb") as f:
+        raw = serialization.msgpack_restore(f.read())
+    params = serialization.from_state_dict(jax.device_get(state.params), raw["params"])
+    batch_stats = None
+    if state.batch_stats is not None:
+        batch_stats = serialization.from_state_dict(
+            jax.device_get(state.batch_stats), raw["batch_stats"]
+        )
+    new_state = state.replace(params=params, batch_stats=batch_stats)
+    return new_state, int(raw["epoch"]), float(raw["loss"])

@@ -113,6 +113,7 @@ class Config:
     # --- observability ---
     log_file: str = "training.log"
     eval_log_file: str = "evaluation.log"
+    metrics_file: str = "metrics.jsonl"  # structured JSONL metrics; "" disables
     profile_dir: str = ""  # non-empty → jax.profiler traces written here
     log_every_steps: int = 10
 
@@ -180,6 +181,15 @@ def _str2bool(v: str) -> bool:
 
 def parse_config(argv: Sequence[str] | None = None, **overrides: Any) -> Config:
     """Build a Config from defaults < env (MPT_*) < CLI flags < explicit overrides."""
+    # MPT_PLATFORM=cpu forces the JAX platform before backend init. The env
+    # var JAX_PLATFORMS alone is unreliable here: this image's sitecustomize
+    # registers the TPU plugin at interpreter startup, so only
+    # jax.config.update lands in time (same trick as tests/conftest.py).
+    platform = os.environ.get("MPT_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
     cfg = Config()
 
     # env overrides: MPT_BATCH_SIZE=64 etc.

@@ -29,11 +29,16 @@ import time
 from dataclasses import dataclass
 
 import jax
+import jax.numpy as jnp
+import optax
 
 from mpi_pytorch_tpu import checkpoint as ckpt
 from mpi_pytorch_tpu.config import Config, parse_config
 from mpi_pytorch_tpu.data import load_manifests
-from mpi_pytorch_tpu.train.trainer import build_training, evaluate_manifest
+from mpi_pytorch_tpu.models import create_model_bundle
+from mpi_pytorch_tpu.parallel.mesh import create_mesh
+from mpi_pytorch_tpu.train.state import TrainState
+from mpi_pytorch_tpu.train.trainer import evaluate_manifest
 from mpi_pytorch_tpu.utils.logging import MetricsWriter, init_logger
 
 
@@ -46,15 +51,41 @@ class EvalSummary:
     images_per_sec: float
 
 
+def build_inference(cfg: Config, mesh=None):
+    """Inference-only construction: model + params, no optimizer moments, no
+    train-split loader — the predictor-rank setup (``evaluation_pipeline.py:
+    132-144``) without the training baggage ``build_training`` carries."""
+    mesh = mesh or create_mesh(cfg.mesh)
+    _, test_manifest = load_manifests(cfg)
+    compute_dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[cfg.compute_dtype]
+    bundle, variables = create_model_bundle(
+        cfg.model_name,
+        cfg.num_classes,
+        use_pretrained=cfg.use_pretrained,
+        rng=jax.random.PRNGKey(cfg.seed),
+        image_size=cfg.image_size[0],
+        dtype=compute_dtype,
+        param_dtype=jnp.float32,
+        pretrained_dir=cfg.pretrained_dir,
+    )
+    state = TrainState.create(
+        apply_fn=bundle.model.apply,
+        variables=variables,
+        tx=optax.identity(),
+        rng=jax.random.PRNGKey(cfg.seed),
+    )
+    return mesh, bundle, state, test_manifest
+
+
 def evaluate(cfg: Config) -> EvalSummary:
     logger = init_logger("MPT_EVAL", cfg.eval_log_file)
-    mesh, bundle, state, (train_manifest, test_manifest, _) = build_training(cfg)
+    mesh, bundle, state, test_manifest = build_inference(cfg)
 
     latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
     if latest:
         # ≙ predictor ranks loading the trained checkpoint
-        # (evaluation_pipeline.py:142-144).
-        state, epoch, loss = ckpt.load_checkpoint(latest, state)
+        # (evaluation_pipeline.py:142-144); params/batch_stats only.
+        state, epoch, loss = ckpt.load_for_eval(latest, state)
         logger.info("loaded checkpoint %s (epoch %d)", latest, epoch)
     else:
         logger.info("no checkpoint in %s — evaluating fresh init", cfg.checkpoint_dir)
@@ -69,7 +100,7 @@ def evaluate(cfg: Config) -> EvalSummary:
     n = len(test_manifest)
     # ≙ rank-0 final accuracy log (evaluation_pipeline.py:198-199)
     logger.info("Accuracy of the network: %.4f (%d images, %.2f s)", acc, n, wall)
-    writer = MetricsWriter("metrics.jsonl")
+    writer = MetricsWriter(cfg.metrics_file)
     writer.write(
         {"kind": "eval", "accuracy": acc, "loss": mean_loss, "images": n, "time_s": wall}
     )

@@ -20,9 +20,17 @@ AUX_LOSS_WEIGHT = 0.4
 
 
 def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
-    """Mean fused softmax CE with integer labels (≙ nn.CrossEntropyLoss)."""
+    """Mean fused softmax CE with integer labels (≙ nn.CrossEntropyLoss).
+
+    Labels < 0 mark padding rows (tail batches are padded to a static shape so
+    XLA never recompiles); they contribute nothing to the mean.
+    """
     logits = logits.astype(jnp.float32)
-    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    valid = labels >= 0
+    per_example = optax.softmax_cross_entropy_with_integer_labels(
+        logits, jnp.maximum(labels, 0)
+    )
+    return jnp.sum(per_example * valid) / jnp.maximum(jnp.sum(valid), 1)
 
 
 def classification_loss(outputs, labels: jnp.ndarray) -> jnp.ndarray:
@@ -35,5 +43,11 @@ def classification_loss(outputs, labels: jnp.ndarray) -> jnp.ndarray:
 
 
 def accuracy_count(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
-    """Number of correct top-1 predictions (≙ reference ``main.py:179-182``)."""
-    return jnp.sum(jnp.argmax(logits, axis=-1) == labels)
+    """Number of correct top-1 predictions (≙ reference ``main.py:179-182``).
+    Padding rows (label < 0) never count as correct."""
+    return jnp.sum((jnp.argmax(logits, axis=-1) == labels) & (labels >= 0))
+
+
+def valid_count(labels: jnp.ndarray) -> jnp.ndarray:
+    """Number of non-padding rows in a batch."""
+    return jnp.sum((labels >= 0).astype(jnp.int32))

@@ -1,19 +1,15 @@
 from mpi_pytorch_tpu.parallel import collectives
 from mpi_pytorch_tpu.parallel.mesh import (
-    batch_spec,
     create_mesh,
     named_shardings,
     param_specs,
-    replicated,
     shard_batch,
 )
 
 __all__ = [
-    "batch_spec",
     "collectives",
     "create_mesh",
     "named_shardings",
     "param_specs",
-    "replicated",
     "shard_batch",
 ]

@@ -33,7 +33,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from mpi_pytorch_tpu.ops.losses import accuracy_count, classification_loss
+from mpi_pytorch_tpu.ops.losses import accuracy_count, classification_loss, valid_count
 from mpi_pytorch_tpu.parallel import collectives
 from mpi_pytorch_tpu.parallel.mesh import named_shardings, param_specs
 from mpi_pytorch_tpu.train.state import TrainState
@@ -84,10 +84,14 @@ def _apply_updates(state: TrainState, grads, new_bs) -> TrainState:
 # ---------------------------------------------------------------------------
 
 
+@functools.lru_cache(maxsize=None)
 def make_train_step(compute_dtype=jnp.bfloat16) -> Callable:
     """Auto-sharded train step: ``jit(step)`` with donated state. Sharding
     comes from the input arrays' placements (state placed by
-    ``place_state_on_mesh``, batch by ``mesh.shard_batch``)."""
+    ``place_state_on_mesh``, batch by ``mesh.shard_batch``).
+
+    Memoized so repeated ``train()`` calls in one process (resume, tests)
+    reuse the same jitted function and its XLA compilation cache."""
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, batch):
@@ -99,7 +103,7 @@ def make_train_step(compute_dtype=jnp.bfloat16) -> Callable:
         metrics = {
             "loss": loss,
             "correct": accuracy_count(logits, labels),
-            "count": jnp.asarray(labels.shape[0], jnp.int32),
+            "count": valid_count(labels),
         }
         return new_state, metrics
 
@@ -205,7 +209,7 @@ def make_spmd_train_step(mesh, compute_dtype=jnp.bfloat16) -> Callable:
         metrics = {
             "loss": lax.pmean(loss, data_axis),
             "correct": lax.psum(accuracy_count(logits, labels), data_axis),
-            "count": lax.psum(jnp.asarray(labels.shape[0], jnp.int32), data_axis),
+            "count": lax.psum(valid_count(labels), data_axis),
         }
         return new_state, metrics
 

@@ -71,6 +71,13 @@ def build_training(cfg: Config, mesh=None):
         raise ValueError(
             f"global batch {cfg.batch_size} not divisible by {jax.process_count()} hosts"
         )
+    data_size = mesh.shape[cfg.mesh.data_axis]
+    if cfg.batch_size % data_size != 0:
+        raise ValueError(
+            f"global batch {cfg.batch_size} not divisible by data-parallel size "
+            f"{data_size}; sharding the batch over the '{cfg.mesh.data_axis}' axis "
+            "requires even division"
+        )
     host_batch = cfg.batch_size // jax.process_count()
 
     train_loader = DataLoader(
@@ -110,6 +117,19 @@ def build_training(cfg: Config, mesh=None):
     return mesh, bundle, state, (train_manifest, test_manifest, train_loader)
 
 
+def pad_batch(images: np.ndarray, labels: np.ndarray, target: int):
+    """Pad a tail batch to the static ``target`` rows; label -1 marks padding,
+    which the loss/accuracy ops mask out (ops/losses.py). Static shapes mean
+    XLA never recompiles, and no images are dropped (the reference's
+    DataLoader keeps tail batches too, ``main.py:99-102``)."""
+    pad = target - images.shape[0]
+    if pad <= 0:
+        return images, labels
+    images = np.concatenate([images, np.zeros((pad, *images.shape[1:]), images.dtype)])
+    labels = np.concatenate([labels, np.full(pad, -1, labels.dtype)])
+    return images, labels
+
+
 def evaluate_manifest(cfg: Config, state: TrainState, mesh, manifest) -> tuple[float, float]:
     """Batched sharded eval over a manifest → (accuracy, mean_loss).
     ≙ the rank-0 validation loop (``main.py:173-185``), but using every chip."""
@@ -128,14 +148,7 @@ def evaluate_manifest(cfg: Config, state: TrainState, mesh, manifest) -> tuple[f
     correct = total = 0
     loss_sum = 0.0
     for images, labels in loader.epoch(0):
-        n = images.shape[0]
-        if n < host_batch:
-            # Pad the tail to the static batch shape; label -1 marks padding
-            # rows, which the eval step masks out. No recompilation, no
-            # dropped images (the reference's DataLoader keeps tails too).
-            pad = host_batch - n
-            images = np.concatenate([images, np.zeros((pad, *images.shape[1:]), images.dtype)])
-            labels = np.concatenate([labels, np.full(pad, -1, labels.dtype)])
+        images, labels = pad_batch(images, labels, host_batch)
         m = eval_step(state, shard_batch((images, labels), mesh))
         correct += int(m["correct"])
         total += int(m["count"])
@@ -147,7 +160,7 @@ def evaluate_manifest(cfg: Config, state: TrainState, mesh, manifest) -> tuple[f
 
 def train(cfg: Config) -> TrainSummary:
     logger = init_logger("MPT", cfg.log_file)
-    metrics = MetricsWriter("metrics.jsonl")
+    metrics = MetricsWriter(cfg.metrics_file)
     mesh, bundle, state, (train_manifest, test_manifest, loader) = build_training(cfg)
     logger.info(
         "world: %d process(es), %d device(s), mesh %s",
@@ -188,8 +201,13 @@ def train(cfg: Config) -> TrainSummary:
     for epoch in range(start_epoch, cfg.num_epochs):
         t0 = time.perf_counter()  # ≙ MPI.Wtime() (main.py:145)
         losses = []
+        host_batch = cfg.batch_size // jax.process_count()
         for step_i, batch in enumerate(loader.epoch(epoch)):
-            state, m = step_fn(state, shard_batch(batch, mesh))
+            # Tail batches (drop_remainder=False) are padded to the static
+            # shape with masked rows, so training keeps every image without
+            # triggering an XLA recompile.
+            images, labels = pad_batch(batch[0], batch[1], host_batch)
+            state, m = step_fn(state, shard_batch((images, labels), mesh))
             losses.append(m["loss"])
             total_images += cfg.batch_size
             if cfg.log_every_steps and (step_i + 1) % cfg.log_every_steps == 0:

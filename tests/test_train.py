@@ -47,12 +47,14 @@ def test_loss_decreases(tmp_path, spmd):
 
 
 def test_checkpoint_resume(tmp_path):
-    cfg = _tiny_cfg(str(tmp_path), num_epochs=1)
+    # num_classes=200 (not the full 64500) keeps the XLA CPU compile cheap;
+    # raw-category-id label handling is covered by test_data.test_labels_fit_head.
+    cfg = _tiny_cfg(str(tmp_path), num_epochs=1, num_classes=200)
     s1 = train(cfg)
     assert s1.checkpoint_path and os.path.exists(s1.checkpoint_path)
 
     # resume: epoch counter continues (helpers.py:10-15 semantics)
-    cfg2 = _tiny_cfg(str(tmp_path), num_epochs=2, from_checkpoint=True)
+    cfg2 = _tiny_cfg(str(tmp_path), num_epochs=2, from_checkpoint=True, num_classes=200)
     s2 = train(cfg2)
     assert s2.epochs_run == 1  # only epoch 1 remains
     assert "00001" in s2.checkpoint_path
