@@ -1,0 +1,143 @@
+"""Offline dataset builder — capability parity with the reference's
+``create_dataset.py`` (component #8 in SURVEY §2a).
+
+The reference reads the Herbarium ``metadata.json``, joins its ``images`` ×
+``annotations`` tables into one dataframe (``create_dataset.py:34-39``),
+samples ``N_IMAGES`` rows with seed 0 (``:52``), splits 80/20 (``:55``),
+writes ``data/{train,test}_sample.csv`` (``:56-57``) and copies the image
+files into ``data/img/{train,test}`` (``:62-66``). This builder does the
+same, plus a ``--synthetic`` mode that *generates* a labeled JPEG dataset
+(class-conditioned patterns) so the full decode→train path can run in
+environments where the Herbarium images are unavailable (they are gitignored
+in the reference too).
+
+    python -m mpi_pytorch_tpu.data.create_dataset \
+        --metadata train/metadata.json --img-root train/ --out data/
+
+    python -m mpi_pytorch_tpu.data.create_dataset \
+        --synthetic 1000 --num-classes 50 --out data/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+
+import numpy as np
+import pandas as pd
+
+CSV_COLUMNS = ["file_name", "height", "id", "license", "width", "category_id"]
+
+
+def read_metadata(path: str) -> pd.DataFrame:
+    """images × annotations join on image id (≙ ``create_dataset.py:34-39``)."""
+    with open(path) as f:
+        meta = json.load(f)
+    images = pd.DataFrame(meta["images"])
+    # annotations carry their own "id"; drop it so the image id survives the
+    # merge un-suffixed (the reference CSVs' "id" column is the image id).
+    annotations = pd.DataFrame(meta["annotations"]).drop(columns=["id"], errors="ignore")
+    df = images.merge(annotations, left_on="id", right_on="image_id", how="inner")
+    keep = [c for c in CSV_COLUMNS if c in df.columns]
+    return df[keep]
+
+
+def sample_and_split(
+    df: pd.DataFrame, n_images: int, seed: int = 0, train_frac: float = 0.8
+) -> tuple[pd.DataFrame, pd.DataFrame]:
+    """Seed-0 sample + 80/20 split (≙ ``create_dataset.py:52-55``)."""
+    df = df.sample(n=min(n_images, len(df)), random_state=seed)
+    n_train = int(len(df) * train_frac)
+    return df.iloc[:n_train].reset_index(drop=True), df.iloc[n_train:].reset_index(drop=True)
+
+
+def write_split(
+    train_df: pd.DataFrame,
+    test_df: pd.DataFrame,
+    out_dir: str,
+    img_root: str | None = None,
+    copy_images: bool = True,
+) -> tuple[str, str]:
+    """Write the two manifests; optionally copy images into ``out/img/...``
+    (≙ ``create_dataset.py:56-66``)."""
+    os.makedirs(out_dir, exist_ok=True)
+    train_csv = os.path.join(out_dir, "train_sample.csv")
+    test_csv = os.path.join(out_dir, "test_sample.csv")
+    train_df.to_csv(train_csv)
+    test_df.to_csv(test_csv)
+    if img_root and copy_images:
+        for split, df in (("train", train_df), ("test", test_df)):
+            for fname in df["file_name"]:
+                # Preserve the nested file_name path — the manifests keep it,
+                # and the loader joins img_dir with it (data/pipeline.py).
+                dst = os.path.join(out_dir, "img", split, fname)
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                if not os.path.exists(dst):
+                    shutil.copyfile(os.path.join(img_root, fname), dst)
+    return train_csv, test_csv
+
+
+def generate_synthetic(
+    out_dir: str, n_images: int, num_classes: int, image_size: int = 128, seed: int = 0
+) -> pd.DataFrame:
+    """Generate a labeled JPEG dataset with the same class-conditioned
+    patterns the in-memory synthetic loader uses (data/pipeline.py), so
+    on-disk decode runs produce learnable data too."""
+    from PIL import Image
+
+    from mpi_pytorch_tpu.data.pipeline import synthetic_image
+
+    rng = np.random.default_rng(seed)
+    rows = []
+    for split in ("train", "test"):
+        os.makedirs(os.path.join(out_dir, "img", split), exist_ok=True)
+    labels = rng.integers(0, num_classes, size=n_images)
+    for i, label in enumerate(labels):
+        split = "train" if i < int(n_images * 0.8) else "test"
+        fname = f"synthetic_{i:06d}.jpg"
+        img = (synthetic_image(int(label), (image_size, image_size)) * 255).astype(np.uint8)
+        Image.fromarray(img).save(os.path.join(out_dir, "img", split, fname), quality=90)
+        rows.append(
+            {"file_name": fname, "height": image_size, "id": i, "license": 0,
+             "width": image_size, "category_id": int(label), "split": split}
+        )
+    return pd.DataFrame(rows)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--metadata", help="Herbarium metadata.json path")
+    ap.add_argument("--img-root", help="root directory the metadata file_names are relative to")
+    ap.add_argument("--out", default="data")
+    ap.add_argument("--n-images", type=int, default=50000)  # utils.py:14
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-copy", action="store_true", help="write CSVs only")
+    ap.add_argument("--synthetic", type=int, default=0, metavar="N",
+                    help="generate N synthetic labeled JPEGs instead of reading metadata")
+    ap.add_argument("--num-classes", type=int, default=100, help="synthetic mode classes")
+    ap.add_argument("--image-size", type=int, default=128, help="synthetic mode size")
+    args = ap.parse_args(argv)
+
+    if args.synthetic:
+        df = generate_synthetic(args.out, args.synthetic, args.num_classes,
+                                args.image_size, args.seed)
+        train_df = df[df["split"] == "train"].drop(columns="split").reset_index(drop=True)
+        test_df = df[df["split"] == "test"].drop(columns="split").reset_index(drop=True)
+        train_csv, test_csv = write_split(train_df, test_df, args.out, copy_images=False)
+    else:
+        if not args.metadata:
+            raise SystemExit("--metadata (or --synthetic N) is required")
+        if not args.img_root and not args.no_copy:
+            raise SystemExit("--img-root is required to copy images (or pass --no-copy)")
+        df = read_metadata(args.metadata)
+        train_df, test_df = sample_and_split(df, args.n_images, args.seed)
+        train_csv, test_csv = write_split(
+            train_df, test_df, args.out, args.img_root, copy_images=not args.no_copy
+        )
+    print(f"wrote {train_csv} ({len(train_df)} rows), {test_csv} ({len(test_df)} rows)")
+
+
+if __name__ == "__main__":
+    main()

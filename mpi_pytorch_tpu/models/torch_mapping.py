@@ -1,0 +1,192 @@
+"""Mapping between this zoo's Flax param trees and torchvision state_dicts.
+
+This is the substance of the ``use_pretrained`` capability (reference
+``models.py:16-101`` downloads torchvision ImageNet weights; this environment
+has neither torchvision nor egress, so weights are converted offline by
+``tools/convert_torchvision.py`` using these rules and loaded from disk by
+``models/pretrained.py``).
+
+Layout conventions converted here:
+- conv kernels:  torch OIHW  → flax HWIO
+- dense kernels: torch [out, in] → flax [in, out]
+- the first dense after a flatten: torch flattens CHW, this zoo flattens HWC
+  (NHWC layout), so the input axis is additionally permuted
+- BatchNorm: torch ``weight``/``bias``/``running_mean``/``running_var`` →
+  flax ``scale``/``bias`` (params) + ``mean``/``var`` (batch_stats)
+
+Classifier heads (``head``/``aux_head``) are never mapped: the reference
+replaces them with fresh ``num_classes`` layers (``models.py:36,44,53,62,70,
+80,90-94``), and so does this framework.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from mpi_pytorch_tpu.models.common import head_filter
+
+# ---------------------------------------------------------------------------
+# tensor layout transforms (torch-side array → flax-side array)
+# ---------------------------------------------------------------------------
+
+
+def conv_kernel(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w, (2, 3, 1, 0))  # OIHW → HWIO
+
+
+def dense_kernel(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w, (1, 0))  # [out, in] → [in, out]
+
+
+def flatten_dense_kernel(c: int, h: int, wd: int) -> Callable[[np.ndarray], np.ndarray]:
+    """Dense right after flatten: permute the input axis CHW → HWC."""
+
+    def t(w: np.ndarray) -> np.ndarray:
+        out = w.shape[0]
+        return w.reshape(out, c, h, wd).transpose(0, 2, 3, 1).reshape(out, -1).T
+
+    return t
+
+
+def identity(w: np.ndarray) -> np.ndarray:
+    return w
+
+
+# ---------------------------------------------------------------------------
+# per-architecture module-prefix maps: flax module path → torchvision prefix
+# ---------------------------------------------------------------------------
+
+# AlexNet/VGG11-BN/SqueezeNet are nn.Sequential in torchvision; the numeric
+# indices below are the fixed positions of the parameterized layers.
+_ALEXNET = {
+    "conv1": "features.0", "conv2": "features.3", "conv3": "features.6",
+    "conv4": "features.8", "conv5": "features.10",
+    "fc1": "classifier.1", "fc2": "classifier.4",
+}
+_VGG11 = {
+    **{f"conv{i}": f"features.{n}" for i, n in enumerate((0, 4, 8, 11, 15, 18, 22, 25))},
+    **{f"bn{i}": f"features.{n}" for i, n in enumerate((1, 5, 9, 12, 16, 19, 23, 26))},
+    "fc1": "classifier.0", "fc2": "classifier.3",
+}
+_SQUEEZENET = {
+    "conv1": "features.0",
+    **{f"fire{i + 2}": f"features.{n}" for i, n in enumerate((3, 4, 5, 7, 8, 9, 10, 12))},
+}
+
+# Dense layers fed by a flatten, with the (C, H, W) the torch side flattened.
+_FLATTEN_DENSE = {
+    ("alexnet", "fc1"): (256, 6, 6),
+    ("vgg11_bn", "fc1"): (512, 7, 7),
+}
+
+
+def _module_prefix(arch: str, module_path: tuple[str, ...]) -> str:
+    """torchvision prefix for a flax module path (everything but the leaf)."""
+    if arch in ("resnet18", "resnet34"):
+        out = []
+        for p in module_path:
+            if p.startswith("layer") and "_" in p:
+                stage, block = p.split("_")
+                out.append(f"{stage}.{block}")
+            elif p == "downsample_conv":
+                out.append("downsample.0")
+            elif p == "downsample_bn":
+                out.append("downsample.1")
+            else:
+                out.append(p)
+        return ".".join(out)
+    if arch == "alexnet":
+        return ".".join(_ALEXNET.get(p, p) for p in module_path)
+    if arch == "vgg11_bn":
+        return ".".join(_VGG11.get(p, p) for p in module_path)
+    if arch == "squeezenet1_0":
+        return ".".join(_SQUEEZENET.get(p, p) for p in module_path)
+    if arch == "densenet121":
+        out = []
+        for p in module_path:
+            if p.startswith("denseblock") and "_" in p:
+                block, layer = p.split("_")
+                n = block.removeprefix("denseblock")
+                out.append(f"features.{block}.denselayer{layer.removeprefix('layer')}")
+                continue
+            if p.startswith("transition") or p in ("conv0", "norm0", "norm5"):
+                out.append(f"features.{p}")
+                continue
+            out.append(p)
+        return ".".join(out)
+    if arch == "inception_v3":
+        # module names were chosen to match torchvision exactly
+        # (Conv2d_1a_3x3, Mixed_5b…, AuxLogits, conv/bn, branch names).
+        return ".".join(module_path)
+    raise ValueError(f"no torchvision mapping for {arch!r}")
+
+
+def tv_entries(
+    arch: str, collection: str, path: tuple[str, ...], shape: tuple[int, ...]
+) -> tuple[str, Callable[[np.ndarray], np.ndarray]] | None:
+    """(torchvision key, transform) for one flax leaf, or None if the leaf is
+    a classifier-head param (kept fresh) with no torchvision counterpart.
+
+    ``collection`` is "params" or "batch_stats"; ``path`` is the flax tree
+    path as strings, e.g. ("layer2_0", "bn1", "scale").
+    """
+    if head_filter(path):
+        return None
+    *module_path, leaf = path
+    prefix = _module_prefix(arch, tuple(module_path))
+
+    if collection == "batch_stats":
+        return f"{prefix}.running_{'mean' if leaf == 'mean' else 'var'}", identity
+
+    if leaf == "scale":  # BatchNorm scale
+        return f"{prefix}.weight", identity
+    if leaf == "bias":
+        return f"{prefix}.bias", identity
+    if leaf == "kernel":
+        if len(shape) == 4:
+            return f"{prefix}.weight", conv_kernel
+        key = (arch, module_path[-1] if module_path else "")
+        if key in _FLATTEN_DENSE:
+            return f"{prefix}.weight", flatten_dense_kernel(*_FLATTEN_DENSE[key])
+        return f"{prefix}.weight", dense_kernel
+    raise ValueError(f"unrecognized param leaf {leaf!r} at {path}")
+
+
+def convert_state_dict(arch: str, variables: dict, state_dict: dict) -> dict:
+    """Overlay a torchvision ``state_dict`` (str → numpy array) onto freshly
+    initialized flax ``variables``. Heads keep their fresh init; every other
+    leaf must find its counterpart (missing keys raise, so a silent partial
+    load can't masquerade as pretrained)."""
+    import jax
+
+    def build(collection: str, tree):
+        flat = jax.tree_util.tree_flatten_with_path(tree)
+        out = []
+        for path_keys, leaf in flat[0]:
+            path = tuple(str(getattr(k, "key", k)) for k in path_keys)
+            entry = tv_entries(arch, collection, path, tuple(leaf.shape))
+            if entry is None:
+                out.append(leaf)  # head: keep fresh init
+                continue
+            key, transform = entry
+            if key not in state_dict:
+                raise KeyError(
+                    f"{arch}: torchvision state_dict is missing {key!r} "
+                    f"(needed for flax param {'/'.join(path)})"
+                )
+            arr = transform(np.asarray(state_dict[key]))
+            if tuple(arr.shape) != tuple(leaf.shape):
+                raise ValueError(
+                    f"{arch}: shape mismatch for {key!r}: torchvision "
+                    f"{arr.shape} vs flax {leaf.shape} at {'/'.join(path)}"
+                )
+            out.append(arr.astype(np.asarray(leaf).dtype))
+        return jax.tree_util.tree_unflatten(flat[1], out)
+
+    result = dict(variables)
+    result["params"] = build("params", variables["params"])
+    if "batch_stats" in variables:
+        result["batch_stats"] = build("batch_stats", variables["batch_stats"])
+    return result

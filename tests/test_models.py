@@ -10,7 +10,8 @@ import pytest
 from mpi_pytorch_tpu.models import create_model_bundle, initialize_model
 from mpi_pytorch_tpu.models.registry import init_variables
 
-NUM_CLASSES = 10
+from conftest import TEST_NUM_CLASSES as NUM_CLASSES
+
 BATCH = 2
 
 # torchvision parameter totals at num_classes=10 (fc/conv head resized):
@@ -37,20 +38,6 @@ ARCHS = list(EXPECTED_PARAMS)
 
 def _count(tree):
     return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(tree))
-
-
-@pytest.fixture(scope="module")
-def bundles():
-    out = {}
-    for name in ARCHS:
-        size = 75 if name == "inception_v3" else 64  # small for test speed; 75 ≥ aux pool needs
-        if name == "inception_v3":
-            size = 299  # aux pooling path needs the real spatial dims
-        bundle, variables = create_model_bundle(
-            name, NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=size
-        )
-        out[name] = (bundle, variables)
-    return out
 
 
 @pytest.mark.parametrize("name", ARCHS)

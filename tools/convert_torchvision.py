@@ -1,0 +1,115 @@
+"""Offline torchvision → Flax weight converter (the ``use_pretrained`` path).
+
+The reference gets pretrained backbones by downloading torchvision ImageNet
+weights at model-construction time (``models.py:33,41,50,59,68,77,87``). This
+environment has no torchvision and no egress, so the conversion runs offline,
+once, wherever torchvision (or a saved ``.pth`` state_dict) is available:
+
+    # with torchvision installed (downloads ImageNet weights):
+    python tools/convert_torchvision.py --model resnet18 --out pretrained/
+
+    # or from a saved state_dict file (no torchvision needed, torch only):
+    python tools/convert_torchvision.py --model resnet18 \
+        --state-dict resnet18-imagenet.pth --out pretrained/
+
+The output ``pretrained/<model>.msgpack`` is what
+``mpi_pytorch_tpu.models.pretrained.load_pretrained`` consumes when a config
+sets ``use_pretrained=True`` (head layers always keep their fresh
+``num_classes`` init, mirroring the reference's head replacement).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+import jax
+
+# Offline host-side tool: weight conversion never needs an accelerator, and
+# forcing CPU here keeps it runnable on machines where the TPU plugin is
+# absent or claimed (must land before first device use — see tests/conftest.py).
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+from flax import serialization
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_pytorch_tpu.models import create_model_bundle  # noqa: E402
+from mpi_pytorch_tpu.models.torch_mapping import convert_state_dict  # noqa: E402
+
+# torchvision factory names per zoo architecture (reference models.py:30-95).
+_TV_FACTORIES = {
+    "resnet18": "resnet18",
+    "resnet34": "resnet34",
+    "alexnet": "alexnet",
+    "vgg11_bn": "vgg11_bn",
+    "squeezenet1_0": "squeezenet1_0",
+    "densenet121": "densenet121",
+    "inception_v3": "inception_v3",
+}
+
+
+def fetch_state_dict(model_name: str, state_dict_path: str | None) -> dict:
+    """numpy state_dict either from a .pth file or live torchvision."""
+    if state_dict_path:
+        import torch
+
+        sd = torch.load(state_dict_path, map_location="cpu", weights_only=True)
+        if hasattr(sd, "state_dict"):
+            sd = sd.state_dict()
+    else:
+        try:
+            import torchvision.models as tvm
+        except ImportError:
+            raise SystemExit(
+                "torchvision is not installed here; pass --state-dict with a "
+                ".pth file exported on a machine that has it"
+            )
+        kwargs = {"aux_logits": True} if model_name == "inception_v3" else {}
+        sd = getattr(tvm, _TV_FACTORIES[model_name])(
+            weights="IMAGENET1K_V1", **kwargs
+        ).state_dict()
+    out = {}
+    # Legacy densenet hub checkpoints use norm.1/conv.2-style keys inside
+    # denselayers (torchvision re-maps them in its own loader); normalize to
+    # the modern norm1/conv2 names the mapping emits.
+    legacy = re.compile(r"(denselayer\d+\.(?:norm|conv))\.(\d)\.")
+    for k, v in sd.items():
+        if k.endswith("num_batches_tracked"):
+            continue
+        k = legacy.sub(r"\g<1>\g<2>.", k)
+        out[k] = v.detach().cpu().numpy() if hasattr(v, "detach") else np.asarray(v)
+    return out
+
+
+def convert(model_name: str, out_dir: str, state_dict_path: str | None = None,
+            num_classes: int = 64500) -> str:
+    state_dict = fetch_state_dict(model_name, state_dict_path)
+    bundle, variables = create_model_bundle(
+        model_name, num_classes, rng=jax.random.PRNGKey(0),
+    )
+    converted = convert_state_dict(model_name, variables, state_dict)
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"{model_name}.msgpack")
+    with open(path, "wb") as f:
+        f.write(serialization.to_bytes(converted))
+    return path
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", required=True, choices=sorted(_TV_FACTORIES))
+    ap.add_argument("--out", default="pretrained")
+    ap.add_argument("--state-dict", default=None,
+                    help=".pth state_dict file (otherwise torchvision downloads)")
+    ap.add_argument("--num-classes", type=int, default=64500)
+    args = ap.parse_args(argv)
+    path = convert(args.model, args.out, args.state_dict, args.num_classes)
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()
