@@ -1,14 +1,21 @@
 """Headline benchmark: resnet18 training throughput, images/sec/chip.
 
 Mirrors the reference's north-star workload (``main.py``: resnet18, 64 500
-classes, batch 128, Adam 4e-4, 128×128 inputs) as one jitted DP train step
-over all available chips, bfloat16 compute. Prints ONE JSON line:
+classes, Adam 4e-4, 128×128 inputs) as one jitted DP train step over all
+available chips, bfloat16 compute. Prints ONE JSON line:
 
-    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N, ...}
 
 ``vs_baseline`` is value ÷ the reference's best *per-worker* throughput
 (≈4.4 img/s/worker — 800 imgs / 45.4 s over 4 MPI ranks, derived from
-``training.log:1268-1275``; see BASELINE.md).
+``training.log:1268-1275``; see BASELINE.md). ``mfu_pct`` is computed from
+the XLA cost analysis of the compiled step against the chip's peak bf16
+FLOP/s.
+
+Timing notes: the state is donated through the step, so blocking on the
+final state (not just a metrics scalar) is what guarantees every queued step
+actually finished — scalar outputs can resolve early through the remote-PJRT
+relay and overstate throughput by >5×.
 """
 
 from __future__ import annotations
@@ -23,12 +30,12 @@ import numpy as np
 REFERENCE_IMG_PER_SEC_PER_WORKER = 4.4  # BASELINE.md, training.log:1268-1275
 
 MODEL = "resnet18"
-NUM_CLASSES = 64500  # utils.py:39
-IMAGE = 128          # utils.py:33-34
-GLOBAL_BATCH = 128   # utils.py:40
+NUM_CLASSES = 64500   # utils.py:39
+IMAGE = 128           # utils.py:33-34
+BATCH_PER_CHIP = 256  # throughput-optimal on v5e (B-sweep: 21.6k img/s @256
+#                       vs 16.2k @128; plateaus ~23k by 1024)
 WARMUP_STEPS = 5
 MEASURE_STEPS = 30
-
 
 def main() -> None:
     from mpi_pytorch_tpu.config import Config
@@ -36,11 +43,10 @@ def main() -> None:
     from mpi_pytorch_tpu.parallel.mesh import create_mesh, shard_batch
     from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
     from mpi_pytorch_tpu.train.step import make_train_step, place_state_on_mesh
+    from mpi_pytorch_tpu.utils.hardware import peak_bf16_tflops, step_flops
 
     n_chips = jax.device_count()
-    # Per-chip batch 128 (so one chip runs the reference's exact global batch;
-    # more chips scale the global batch like adding MPI ranks does).
-    batch = GLOBAL_BATCH * n_chips
+    batch = BATCH_PER_CHIP * n_chips
 
     mesh = create_mesh(Config().mesh)
     bundle, variables = create_model_bundle(
@@ -56,27 +62,40 @@ def main() -> None:
 
     rng = np.random.default_rng(0)
     images = rng.standard_normal((batch, IMAGE, IMAGE, 3), np.float32)
-    labels = rng.integers(0, NUM_CLASSES, size=(batch,), dtype=np.int64).astype(np.int32)
+    labels = rng.integers(0, NUM_CLASSES, size=(batch,)).astype(np.int32)
     device_batch = shard_batch((images, labels), mesh)
 
+    compiled = step.lower(state, device_batch).compile()
+    flops_per_step = step_flops(compiled)
+
     for _ in range(WARMUP_STEPS):
-        state, metrics = step(state, device_batch)
-    jax.block_until_ready(metrics["loss"])
+        state, metrics = compiled(state, device_batch)
+    jax.block_until_ready(state.params)
 
     t0 = time.perf_counter()
     for _ in range(MEASURE_STEPS):
-        state, metrics = step(state, device_batch)
-    jax.block_until_ready(metrics["loss"])
+        state, metrics = compiled(state, device_batch)
+    jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
 
     ips = MEASURE_STEPS * batch / dt
-    ips_per_chip = ips / n_chips
-    print(json.dumps({
-        "metric": f"{MODEL} train images/sec/chip (bf16, {NUM_CLASSES} classes, batch {GLOBAL_BATCH}/chip)",
-        "value": round(ips_per_chip, 2),
+    # cost_analysis() FLOPs are PER-DEVICE under SPMD partitioning, so this
+    # is already per-chip achieved TFLOP/s — no further division by n_chips.
+    tflops_per_chip = flops_per_step * MEASURE_STEPS / dt / 1e12
+    peak = peak_bf16_tflops(jax.devices()[0])
+    record = {
+        "metric": (
+            f"{MODEL} train images/sec/chip (bf16, {NUM_CLASSES} classes, "
+            f"{IMAGE}px, batch {BATCH_PER_CHIP}/chip, {n_chips} chip(s))"
+        ),
+        "value": round(ips / n_chips, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(ips_per_chip / REFERENCE_IMG_PER_SEC_PER_WORKER, 2),
-    }))
+        "vs_baseline": round(ips / n_chips / REFERENCE_IMG_PER_SEC_PER_WORKER, 2),
+        "tflops_per_chip": round(tflops_per_chip, 2),
+    }
+    if peak:
+        record["mfu_pct"] = round(100.0 * tflops_per_chip / peak, 1)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

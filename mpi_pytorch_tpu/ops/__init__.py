@@ -1,8 +1,25 @@
+from mpi_pytorch_tpu.ops.fused_ce import fused_softmax_ce
 from mpi_pytorch_tpu.ops.losses import (
     AUX_LOSS_WEIGHT,
     accuracy_count,
     classification_loss,
     cross_entropy,
+    valid_count,
+)
+from mpi_pytorch_tpu.ops.ring_attention import (
+    full_attention,
+    ring_attention,
+    ring_self_attention,
 )
 
-__all__ = ["AUX_LOSS_WEIGHT", "accuracy_count", "classification_loss", "cross_entropy"]
+__all__ = [
+    "AUX_LOSS_WEIGHT",
+    "accuracy_count",
+    "classification_loss",
+    "cross_entropy",
+    "full_attention",
+    "fused_softmax_ce",
+    "ring_attention",
+    "ring_self_attention",
+    "valid_count",
+]

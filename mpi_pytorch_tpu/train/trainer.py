@@ -38,6 +38,7 @@ from mpi_pytorch_tpu.train.step import (
     make_train_step,
     place_state_on_mesh,
 )
+from mpi_pytorch_tpu.utils import hardware as hw
 from mpi_pytorch_tpu.utils.logging import MetricsWriter, init_logger
 
 
@@ -187,6 +188,19 @@ def train(cfg: Config) -> TrainSummary:
     else:
         step_fn = make_train_step(_dtype(cfg.compute_dtype))
 
+    # AOT-compile the step on the static batch shape: one compile serves the
+    # whole run, and the executable's cost analysis gives exact FLOPs/step for
+    # MFU logging (SURVEY §5 — the reference has only wall-clock timers).
+    host_batch = cfg.batch_size // jax.process_count()
+    sample = shard_batch(
+        (np.zeros((host_batch, *cfg.image_size, 3), np.float32),
+         np.zeros((host_batch,), np.int32)),
+        mesh,
+    )
+    compiled_step = step_fn.lower(state, sample).compile()
+    flops_per_step = hw.step_flops(compiled_step)
+    peak = hw.peak_bf16_tflops(jax.devices()[0])
+
     summary = TrainSummary()
     total_images = 0
     train_t0 = time.perf_counter()
@@ -201,13 +215,12 @@ def train(cfg: Config) -> TrainSummary:
     for epoch in range(start_epoch, cfg.num_epochs):
         t0 = time.perf_counter()  # ≙ MPI.Wtime() (main.py:145)
         losses = []
-        host_batch = cfg.batch_size // jax.process_count()
         for step_i, batch in enumerate(loader.epoch(epoch)):
             # Tail batches (drop_remainder=False) are padded to the static
             # shape with masked rows, so training keeps every image without
             # triggering an XLA recompile.
             images, labels = pad_batch(batch[0], batch[1], host_batch)
-            state, m = step_fn(state, shard_batch((images, labels), mesh))
+            state, m = compiled_step(state, shard_batch((images, labels), mesh))
             losses.append(m["loss"])
             total_images += cfg.batch_size
             if cfg.log_every_steps and (step_i + 1) % cfg.log_every_steps == 0:
@@ -219,13 +232,19 @@ def train(cfg: Config) -> TrainSummary:
         dt = time.perf_counter() - t0
         epoch_loss = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
         ips = (len(losses) * cfg.batch_size) / dt if dt > 0 else 0.0
-        # ≙ reference epoch log line (main.py:158-160)
+        # cost_analysis() FLOPs are PER-DEVICE under SPMD partitioning.
+        per_chip_tflops = flops_per_step * len(losses) / dt / 1e12 if dt > 0 else 0.0
+        tflops = per_chip_tflops * jax.device_count()
+        mfu = 100.0 * per_chip_tflops / peak if peak else None
+        # ≙ reference epoch log line (main.py:158-160), plus throughput/MFU
         logger.info(
-            "Epoch: %d, Loss: %.6f, Time: %.2f s, %.1f img/s", epoch, epoch_loss, dt, ips
+            "Epoch: %d, Loss: %.6f, Time: %.2f s, %.1f img/s%s",
+            epoch, epoch_loss, dt, ips,
+            f", MFU {mfu:.1f}%" if mfu is not None else "",
         )
         metrics.write(
             {"kind": "epoch", "epoch": epoch, "loss": epoch_loss, "time_s": dt,
-             "images_per_sec": ips}
+             "images_per_sec": ips, "tflops": tflops, "mfu_pct": mfu}
         )
         summary.epoch_times.append(dt)
         summary.epoch_losses.append(epoch_loss)

@@ -1,0 +1,32 @@
+"""Hardware peak-FLOPs table for MFU accounting (SURVEY §5 observability —
+the reference only has wall-clock ``MPI.Wtime`` pairs, ``main.py:145,158``)."""
+
+from __future__ import annotations
+
+# Peak bf16 TFLOP/s per chip, keyed by substrings of device_kind.
+_PEAK_BF16_TFLOPS = {
+    "v4": 275.0,
+    "v5 lite": 197.0, "v5e": 197.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0, "v6e": 918.0,
+}
+
+
+def peak_bf16_tflops(device) -> float | None:
+    """Peak bf16 TFLOP/s for a jax device, or None if unknown (CPU, new TPUs)."""
+    kind = getattr(device, "device_kind", "").lower()
+    for name, peak in _PEAK_BF16_TFLOPS.items():
+        if name in kind:
+            return peak
+    return None
+
+
+def step_flops(compiled) -> float:
+    """Total FLOPs of a compiled XLA executable (0.0 if unavailable)."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return float(ca.get("flops", 0.0))
+    except Exception:
+        return 0.0

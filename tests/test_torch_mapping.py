@@ -34,10 +34,8 @@ def _flat(tree):
     ]
 
 
-def _torch_shape(flax_shape, transform):
-    """Invert a layout transform to get the torch-side shape."""
-    probe = np.zeros(flax_shape, np.float32)
-    # brute-force: try the candidate torch shapes
+def _torch_shape(flax_shape):
+    """Invert the layout convention to get the torch-side shape."""
     if len(flax_shape) == 4:  # conv HWIO ← OIHW
         return (flax_shape[3], flax_shape[2], flax_shape[0], flax_shape[1])
     if len(flax_shape) == 2:  # dense [in, out] ← [out, in]
@@ -62,7 +60,7 @@ def test_mapping_covers_every_leaf_and_roundtrips(bundles, arch):
             key, transform = entry
             assert key not in seen_keys, f"duplicate torchvision key {key}"
             seen_keys.add(key)
-            tshape = _torch_shape(tuple(leaf.shape), transform)
+            tshape = _torch_shape(tuple(leaf.shape))
             state_dict[key] = rng.standard_normal(tshape).astype(np.float32)
             assert transform(state_dict[key]).shape == tuple(leaf.shape), (
                 f"{arch} {key}: transform produces {transform(state_dict[key]).shape}, "

@@ -40,16 +40,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from mpi_pytorch_tpu.models import create_model_bundle  # noqa: E402
 from mpi_pytorch_tpu.models.torch_mapping import convert_state_dict  # noqa: E402
 
-# torchvision factory names per zoo architecture (reference models.py:30-95).
-_TV_FACTORIES = {
-    "resnet18": "resnet18",
-    "resnet34": "resnet34",
-    "alexnet": "alexnet",
-    "vgg11_bn": "vgg11_bn",
-    "squeezenet1_0": "squeezenet1_0",
-    "densenet121": "densenet121",
-    "inception_v3": "inception_v3",
-}
+# zoo architectures; torchvision factories share these exact names
+# (reference models.py:30-95).
+_MODELS = (
+    "resnet18", "resnet34", "alexnet", "vgg11_bn",
+    "squeezenet1_0", "densenet121", "inception_v3",
+)
 
 
 def fetch_state_dict(model_name: str, state_dict_path: str | None) -> dict:
@@ -69,9 +65,7 @@ def fetch_state_dict(model_name: str, state_dict_path: str | None) -> dict:
                 ".pth file exported on a machine that has it"
             )
         kwargs = {"aux_logits": True} if model_name == "inception_v3" else {}
-        sd = getattr(tvm, _TV_FACTORIES[model_name])(
-            weights="IMAGENET1K_V1", **kwargs
-        ).state_dict()
+        sd = getattr(tvm, model_name)(weights="IMAGENET1K_V1", **kwargs).state_dict()
     out = {}
     # Legacy densenet hub checkpoints use norm.1/conv.2-style keys inside
     # denselayers (torchvision re-maps them in its own loader); normalize to
@@ -101,7 +95,7 @@ def convert(model_name: str, out_dir: str, state_dict_path: str | None = None,
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--model", required=True, choices=sorted(_TV_FACTORIES))
+    ap.add_argument("--model", required=True, choices=sorted(_MODELS))
     ap.add_argument("--out", default="pretrained")
     ap.add_argument("--state-dict", default=None,
                     help=".pth state_dict file (otherwise torchvision downloads)")
