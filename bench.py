@@ -93,7 +93,7 @@ def main() -> None:
         "vs_baseline": round(ips / n_chips / REFERENCE_IMG_PER_SEC_PER_WORKER, 2),
         "tflops_per_chip": round(tflops_per_chip, 2),
     }
-    if peak:
+    if peak and flops_per_step > 0:
         record["mfu_pct"] = round(100.0 * tflops_per_chip / peak, 1)
     print(json.dumps(record))
 

@@ -11,4 +11,9 @@ __version__ = "0.1.0"
 
 from mpi_pytorch_tpu.config import Config, MeshConfig, parse_config
 
+# Driver entry points live in their modules (a lazy `mpt.train` attribute
+# would be shadowed by the `mpi_pytorch_tpu.train` subpackage of the same
+# name the moment anything imports it):
+#   from mpi_pytorch_tpu.train.trainer import train
+#   from mpi_pytorch_tpu.evaluate import evaluate
 __all__ = ["Config", "MeshConfig", "parse_config", "__version__"]

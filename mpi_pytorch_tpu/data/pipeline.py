@@ -32,6 +32,14 @@ from mpi_pytorch_tpu.data.manifest import Manifest
 _MEAN = np.asarray(IMAGENET_MEAN, dtype=np.float32)
 _STD = np.asarray(IMAGENET_STD, dtype=np.float32)
 
+# Normalized synthetic images by (label, size), capped by BYTES so image
+# size doesn't change the memory footprint. First-come insertion: covers
+# small-vocabulary runs (e.g. the DEBUG sample's 964 classes) completely;
+# full-64500-class runs fall back to regeneration for uncached labels.
+_SYNTH_CACHE: dict = {}
+_SYNTH_CACHE_BUDGET = 256 * 1024 * 1024
+_synth_cache_bytes = 0
+
 
 def normalize_image(img: np.ndarray) -> np.ndarray:
     """[0,1] float32 HWC → ImageNet-normalized (parity: transforms.Normalize,
@@ -112,12 +120,20 @@ class DataLoader:
 
     def _load_one(self, i: int) -> np.ndarray:
         if self.synthetic:
-            # Key the pattern by label so classes are separable.
-            img = synthetic_image(int(self.manifest.labels[i]), self.image_size)
-        else:
-            path = os.path.join(self.manifest.img_dir, self.manifest.filenames[i])
-            img = decode_image(path, self.image_size)
-        return normalize_image(img)
+            # Key the pattern by label so classes are separable. The pattern
+            # is a pure function of (label, size), so a bounded cache removes
+            # the host-side generation bottleneck (1 CPU core feeding a TPU).
+            key = (int(self.manifest.labels[i]), self.image_size)
+            img = _SYNTH_CACHE.get(key)
+            if img is None:
+                global _synth_cache_bytes
+                img = normalize_image(synthetic_image(*key))
+                if _synth_cache_bytes + img.nbytes <= _SYNTH_CACHE_BUDGET:
+                    _SYNTH_CACHE[key] = img
+                    _synth_cache_bytes += img.nbytes
+            return img
+        path = os.path.join(self.manifest.img_dir, self.manifest.filenames[i])
+        return normalize_image(decode_image(path, self.image_size))
 
     def epoch(self, epoch: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         """Iterate one epoch of batches, prefetched in the background."""

@@ -78,6 +78,9 @@ def build_inference(cfg: Config, mesh=None):
 
 
 def evaluate(cfg: Config) -> EvalSummary:
+    from mpi_pytorch_tpu.parallel.distributed import maybe_initialize_distributed
+
+    maybe_initialize_distributed()
     logger = init_logger("MPT_EVAL", cfg.eval_log_file)
     mesh, bundle, state, test_manifest = build_inference(cfg)
 

@@ -58,8 +58,7 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False) -> jnp.ndar
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def body(t, carry):
-        k_blk, v_blk, m, l, o = carry
+    def accumulate(t, k_blk, v_blk, m, l, o):
         # after t rotations this shard holds the block that originated at
         # ring position (me - t) mod n
         src = (me - t) % n
@@ -78,7 +77,11 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False) -> jnp.ndar
         o = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
             "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
         )
-        m = m_new
+        return m_new, l, o
+
+    def body(t, carry):
+        k_blk, v_blk, m, l, o = carry
+        m, l, o = accumulate(t, k_blk, v_blk, m, l, o)
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         return k_blk, v_blk, m, l, o
@@ -86,7 +89,10 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False) -> jnp.ndar
     m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, sq), jnp.float32)
     o0 = jnp.zeros((b, sq, h, d), jnp.float32)
-    _, _, _, l, o = lax.fori_loop(0, n, body, (k, v, m0, l0, o0))
+    # n-1 rotate-and-accumulate steps, then a final accumulate without the
+    # wasted last rotation (its result would be discarded).
+    k_blk, v_blk, m, l, o = lax.fori_loop(0, n - 1, body, (k, v, m0, l0, o0))
+    _, l, o = accumulate(n - 1, k_blk, v_blk, m, l, o)
     denom = jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
     return (o / denom).astype(q.dtype)
 

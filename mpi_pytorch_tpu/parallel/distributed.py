@@ -1,0 +1,52 @@
+"""Multi-host world bootstrap — the TPU-native replacement for the MPI world.
+
+The reference's world is created by ``mpiexec -n N`` spawning N ranks that
+rendezvous through libmpi (``main.py:16-18``, launch: ``README.md:38``). The
+JAX equivalent is one process per host calling
+``jax.distributed.initialize()``, after which ``jax.devices()`` spans every
+chip on every host and the single-controller SPMD model (mesh + collectives
+over ICI/DCN) replaces rank-explicit programming.
+
+On TPU pods the coordinator address / process ids come from the TPU runtime
+metadata automatically, so ``maybe_initialize_distributed()`` needs no
+arguments there; elsewhere the standard env vars
+(``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``) are
+honored. Single-host runs (this CI/dev environment, and any laptop) skip
+initialization entirely — everything downstream already works on the
+one-process world.
+"""
+
+from __future__ import annotations
+
+import os
+
+_initialized = False
+
+
+def maybe_initialize_distributed() -> bool:
+    """Initialize the multi-host JAX world if the environment calls for it.
+
+    Returns True when ``jax.distributed.initialize`` ran (or had already
+    run), False for single-host operation. Idempotent; safe to call from
+    every driver entry point (≙ the module-level MPI setup every reference
+    driver repeats, ``main.py:16-18`` / ``evaluation_pipeline.py:13-15``).
+    """
+    global _initialized
+    if _initialized:
+        return True
+
+    multihost_flag = os.environ.get("MPT_MULTIHOST", "").lower()
+    explicit = bool(os.environ.get("JAX_COORDINATOR_ADDRESS")) or multihost_flag in (
+        "1", "true", "yes", "on",
+    )
+    on_pod = bool(os.environ.get("TPU_WORKER_HOSTNAMES", "").strip()) and (
+        len(os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")) > 1
+    )
+    if not explicit and not on_pod:
+        return False
+
+    import jax
+
+    jax.distributed.initialize()  # args resolved from TPU metadata / env
+    _initialized = True
+    return True

@@ -160,6 +160,9 @@ def evaluate_manifest(cfg: Config, state: TrainState, mesh, manifest) -> tuple[f
 
 
 def train(cfg: Config) -> TrainSummary:
+    from mpi_pytorch_tpu.parallel.distributed import maybe_initialize_distributed
+
+    maybe_initialize_distributed()
     logger = init_logger("MPT", cfg.log_file)
     metrics = MetricsWriter(cfg.metrics_file)
     mesh, bundle, state, (train_manifest, test_manifest, loader) = build_training(cfg)
@@ -235,7 +238,9 @@ def train(cfg: Config) -> TrainSummary:
         # cost_analysis() FLOPs are PER-DEVICE under SPMD partitioning.
         per_chip_tflops = flops_per_step * len(losses) / dt / 1e12 if dt > 0 else 0.0
         tflops = per_chip_tflops * jax.device_count()
-        mfu = 100.0 * per_chip_tflops / peak if peak else None
+        # mfu None (omitted) when either peak or FLOPs are unknown — a
+        # confident "0.0%" would be indistinguishable from a stalled chip.
+        mfu = 100.0 * per_chip_tflops / peak if (peak and flops_per_step > 0) else None
         # ≙ reference epoch log line (main.py:158-160), plus throughput/MFU
         logger.info(
             "Epoch: %d, Loss: %.6f, Time: %.2f s, %.1f img/s%s",

@@ -193,3 +193,22 @@ def test_collectives_parity():
     np.testing.assert_allclose(np.asarray(m), np.full(8, 3.5))
     np.testing.assert_allclose(np.asarray(b), np.zeros(8))  # root shard holds 0.0
     assert collectives.num_devices() == 8
+
+
+def test_distributed_init_gating(monkeypatch):
+    """Single-host environments must skip jax.distributed.initialize; the
+    multi-host triggers are the explicit env vars or a multi-worker pod."""
+    from mpi_pytorch_tpu.parallel import distributed
+
+    monkeypatch.setattr(distributed, "_initialized", False)
+    for var in ("JAX_COORDINATOR_ADDRESS", "MPT_MULTIHOST", "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(var, raising=False)
+    assert distributed.maybe_initialize_distributed() is False
+
+    # single-worker pod metadata (what this image sets) is still single-host
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    assert distributed.maybe_initialize_distributed() is False
+
+    # already-initialized short-circuits without touching jax
+    monkeypatch.setattr(distributed, "_initialized", True)
+    assert distributed.maybe_initialize_distributed() is True
