@@ -32,8 +32,8 @@ REFERENCE_IMG_PER_SEC_PER_WORKER = 4.4  # BASELINE.md, training.log:1268-1275
 MODEL = "resnet18"
 NUM_CLASSES = 64500   # utils.py:39
 IMAGE = 128           # utils.py:33-34
-BATCH_PER_CHIP = 256  # throughput-optimal on v5e (B-sweep: 21.6k img/s @256
-#                       vs 16.2k @128; plateaus ~23k by 1024)
+BATCH_PER_CHIP = 512  # throughput-optimal on v5e (B-sweep: ~19-20k img/s @256,
+#                       ~21-23k @512, plateau by 1024; 16.2k @128)
 WARMUP_STEPS = 5
 MEASURE_STEPS = 30
 
