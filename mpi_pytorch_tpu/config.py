@@ -90,6 +90,9 @@ class Config:
     # --- precision / TPU ---
     compute_dtype: str = "bfloat16"  # MXU-native; params stay float32
     param_dtype: str = "float32"
+    # host batch dtype: bfloat16 halves host→device transfer (the step casts
+    # to compute_dtype anyway); float32 preserves exact reference numerics.
+    input_dtype: str = "float32"
     sync_batchnorm: bool = False  # reference keeps per-rank local BN stats (SURVEY §7)
     # spmd_mode=True uses the shard_map step with explicit collectives and
     # per-shard local BN — exact reference DP semantics; default is the
@@ -133,6 +136,8 @@ class Config:
             raise ValueError(f"learning_rate must be > 0, got {self.learning_rate}")
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"compute_dtype must be float32|bfloat16, got {self.compute_dtype}")
+        if self.input_dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"input_dtype must be float32|bfloat16, got {self.input_dtype}")
         if self.spmd_mode and self.mesh.model_parallel > 1:
             raise ValueError(
                 "spmd_mode is pure data-parallel (reference-parity shard_map step); "

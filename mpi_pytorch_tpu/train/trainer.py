@@ -91,6 +91,7 @@ def build_training(cfg: Config, mesh=None):
         synthetic=cfg.synthetic_data,
         num_workers=cfg.loader_workers,
         prefetch=cfg.prefetch_batches,
+        image_dtype=cfg.input_dtype,
     )
 
     bundle, variables = create_model_bundle(
@@ -145,6 +146,7 @@ def evaluate_manifest(cfg: Config, state: TrainState, mesh, manifest) -> tuple[f
         synthetic=cfg.synthetic_data,
         num_workers=cfg.loader_workers,
         prefetch=cfg.prefetch_batches,
+        image_dtype=cfg.input_dtype,
     )
     correct = total = 0
     loss_sum = 0.0
@@ -195,8 +197,10 @@ def train(cfg: Config) -> TrainSummary:
     # whole run, and the executable's cost analysis gives exact FLOPs/step for
     # MFU logging (SURVEY §5 — the reference has only wall-clock timers).
     host_batch = cfg.batch_size // jax.process_count()
+    # The sample must match the loader's batch dtype exactly — the AOT
+    # executable is specialized on input avals.
     sample = shard_batch(
-        (np.zeros((host_batch, *cfg.image_size, 3), np.float32),
+        (np.zeros((host_batch, *cfg.image_size, 3), loader.image_dtype),
          np.zeros((host_batch,), np.int32)),
         mesh,
     )

@@ -162,3 +162,18 @@ def test_synthetic_jpeg_dataset_trains_via_decode_path(tmp_path):
     summary = train(cfg)
     assert summary.epochs_run == 1
     assert np.isfinite(summary.final_loss)
+
+
+def test_loader_bfloat16_batches():
+    import ml_dtypes
+
+    m = _tiny_manifest(n=16)
+    dl = DataLoader(m, batch_size=8, image_size=(16, 16), synthetic=True,
+                    shuffle=False, image_dtype="bfloat16")
+    imgs, labels = next(iter(dl.epoch(0)))
+    assert imgs.dtype == np.dtype(ml_dtypes.bfloat16)
+    assert labels.dtype == np.int32
+    # values match the float32 pipeline to bf16 precision
+    dl32 = DataLoader(m, batch_size=8, image_size=(16, 16), synthetic=True, shuffle=False)
+    imgs32, _ = next(iter(dl32.epoch(0)))
+    np.testing.assert_allclose(imgs.astype(np.float32), imgs32, atol=0.02, rtol=0.02)
