@@ -39,6 +39,9 @@ _STD = np.asarray(IMAGENET_STD, dtype=np.float32)
 _SYNTH_CACHE: dict = {}
 _SYNTH_CACHE_BUDGET = 256 * 1024 * 1024
 _synth_cache_bytes = 0
+# Guards the check-then-insert (loader worker threads share the cache); the
+# lock-free read in _load_one is safe under the GIL.
+_SYNTH_CACHE_LOCK = threading.Lock()
 
 
 def normalize_image(img: np.ndarray) -> np.ndarray:
@@ -138,9 +141,12 @@ class DataLoader:
             if img is None:
                 global _synth_cache_bytes
                 img = normalize_image(synthetic_image(*key))
-                if _synth_cache_bytes + img.nbytes <= _SYNTH_CACHE_BUDGET:
-                    _SYNTH_CACHE[key] = img
-                    _synth_cache_bytes += img.nbytes
+                with _SYNTH_CACHE_LOCK:
+                    if key not in _SYNTH_CACHE and (
+                        _synth_cache_bytes + img.nbytes <= _SYNTH_CACHE_BUDGET
+                    ):
+                        _SYNTH_CACHE[key] = img
+                        _synth_cache_bytes += img.nbytes
             return img
         path = os.path.join(self.manifest.img_dir, self.manifest.filenames[i])
         return normalize_image(decode_image(path, self.image_size))

@@ -206,10 +206,18 @@ def make_spmd_train_step(mesh, compute_dtype=jnp.bfloat16) -> Callable:
             new_bs = collectives.all_reduce(new_bs, "mean", axis=data_axis)
 
         new_state = _apply_updates(state, grads, new_bs)
+        # Reported loss is the GLOBAL per-sample mean (each shard's mean loss
+        # weighted by its valid-row count), so padded tail steps with uneven
+        # shard occupancy stay exact — the *gradient* above keeps the
+        # reference's unweighted per-rank average (mpi_avg_grads divides by
+        # world size regardless of local batch size, mpi_tools.py:36).
+        local_count = valid_count(labels)
+        global_count = lax.psum(local_count, data_axis)
         metrics = {
-            "loss": lax.pmean(loss, data_axis),
+            "loss": lax.psum(loss * local_count.astype(loss.dtype), data_axis)
+            / jnp.maximum(global_count.astype(loss.dtype), 1),
             "correct": lax.psum(accuracy_count(logits, labels), data_axis),
-            "count": lax.psum(valid_count(labels), data_axis),
+            "count": global_count,
         }
         return new_state, metrics
 

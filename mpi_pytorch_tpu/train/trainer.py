@@ -123,13 +123,75 @@ def pad_batch(images: np.ndarray, labels: np.ndarray, target: int):
     """Pad a tail batch to the static ``target`` rows; label -1 marks padding,
     which the loss/accuracy ops mask out (ops/losses.py). Static shapes mean
     XLA never recompiles, and no images are dropped (the reference's
-    DataLoader keeps tail batches too, ``main.py:99-102``)."""
+    DataLoader keeps tail batches too, ``main.py:99-102``).
+
+    Padding rows repeat real rows (cyclically) rather than injecting zero
+    images: the loss masks them either way, but during training BatchNorm
+    batch statistics span the whole padded batch, and repeated real rows keep
+    those stats unbiased in expectation where zero rows would skew them
+    (the reference instead trains on the smaller real tail batch)."""
     pad = target - images.shape[0]
     if pad <= 0:
         return images, labels
-    images = np.concatenate([images, np.zeros((pad, *images.shape[1:]), images.dtype)])
+    images = np.concatenate([images, _cyclic_fill(images, pad)])
     labels = np.concatenate([labels, np.full(pad, -1, labels.dtype)])
     return images, labels
+
+
+def _cyclic_fill(images: np.ndarray, n: int) -> np.ndarray:
+    """``n`` rows of real image content, repeating ``images`` cyclically
+    (zeros only when there are no real rows at all) — the shared fill
+    strategy of ``pad_batch`` and ``synchronized_batches``."""
+    if images.shape[0] == 0:
+        return np.zeros((n, *images.shape[1:]), images.dtype)
+    return images[np.resize(np.arange(images.shape[0]), n)]
+
+
+def global_step_count(total_examples: int, host_batch: int, drop_remainder: bool) -> int:
+    """Number of steps EVERY host must run per epoch, computed from global
+    quantities so it is identical on all hosts.
+
+    Per-host shards come from ``np.array_split`` semantics (manifest.shard),
+    so shard sizes differ by up to 1 across hosts. Each step is a global SPMD
+    program: a host running one extra (or one fewer) step than its peers
+    deadlocks the collective. With drop_remainder the count is what the
+    *smallest* shard yields (larger shards truncate); without, it is what the
+    *largest* shard yields (exhausted shards feed all-padding batches)."""
+    procs = jax.process_count()
+    if drop_remainder:
+        return (total_examples // procs) // host_batch
+    largest = -(-total_examples // procs)
+    return -(-largest // host_batch)
+
+
+def synchronized_batches(loader: DataLoader, epoch: int, n_steps: int):
+    """Yield exactly ``n_steps`` (images, labels) host-batches from ``loader``,
+    padding with all-padding batches (every label -1) once the local shard is
+    exhausted and truncating any surplus — so every host issues the same
+    number of collective steps (see ``global_step_count``).
+
+    Filler batches repeat the images of the last REAL batch (labels all -1):
+    the loss masks them either way, but BatchNorm batch statistics span
+    whatever images the step sees, so filler must be real image content, not
+    zeros — the same reasoning as ``pad_batch``."""
+    it = iter(loader.epoch(epoch))
+    all_pad = np.full((loader.batch_size,), -1, np.int32)
+    last_images = None
+    try:
+        for _ in range(n_steps):
+            batch = next(it, None)
+            if batch is not None:
+                last_images = batch[0]
+                yield batch
+            else:
+                if last_images is None:  # empty local shard: no real rows exist
+                    last_images = np.zeros(
+                        (0, *loader.image_size, 3), loader.image_dtype
+                    )
+                yield (_cyclic_fill(last_images, loader.batch_size), all_pad)
+    finally:
+        if hasattr(it, "close"):
+            it.close()  # stops the producer thread on early exit / truncation
 
 
 def evaluate_manifest(cfg: Config, state: TrainState, mesh, manifest) -> tuple[float, float]:
@@ -150,7 +212,8 @@ def evaluate_manifest(cfg: Config, state: TrainState, mesh, manifest) -> tuple[f
     )
     correct = total = 0
     loss_sum = 0.0
-    for images, labels in loader.epoch(0):
+    n_steps = global_step_count(len(manifest), host_batch, drop_remainder=False)
+    for images, labels in synchronized_batches(loader, 0, n_steps):
         images, labels = pad_batch(images, labels, host_batch)
         m = eval_step(state, shard_batch((images, labels), mesh))
         correct += int(m["correct"])
@@ -219,17 +282,21 @@ def train(cfg: Config) -> TrainSummary:
     if profiling:
         jax.profiler.start_trace(cfg.profile_dir)
 
+    n_steps = global_step_count(
+        len(train_manifest), host_batch, cfg.drop_remainder
+    )
+
     for epoch in range(start_epoch, cfg.num_epochs):
         t0 = time.perf_counter()  # ≙ MPI.Wtime() (main.py:145)
-        losses = []
-        for step_i, batch in enumerate(loader.epoch(epoch)):
+        losses, counts = [], []
+        for step_i, batch in enumerate(synchronized_batches(loader, epoch, n_steps)):
             # Tail batches (drop_remainder=False) are padded to the static
             # shape with masked rows, so training keeps every image without
             # triggering an XLA recompile.
             images, labels = pad_batch(batch[0], batch[1], host_batch)
             state, m = compiled_step(state, shard_batch((images, labels), mesh))
             losses.append(m["loss"])
-            total_images += cfg.batch_size
+            counts.append(m["count"])
             if cfg.log_every_steps and (step_i + 1) % cfg.log_every_steps == 0:
                 logger.info(
                     "epoch %d step %d loss %.4f", epoch, step_i + 1, float(m["loss"])
@@ -237,8 +304,22 @@ def train(cfg: Config) -> TrainSummary:
         # Device sync so the timer measures compute, not dispatch.
         jax.block_until_ready(state.params)
         dt = time.perf_counter() - t0
-        epoch_loss = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
-        ips = (len(losses) * cfg.batch_size) / dt if dt > 0 else 0.0
+        if losses:
+            # Per-sample accounting: weight each step's mean loss by its
+            # global valid-row count, so padded tail steps aren't over-weighted
+            # (matches the reference's per-sample loss bookkeeping) and
+            # throughput never counts padding rows. One device sync per epoch.
+            loss_v = jnp.stack(losses)
+            count_v = jnp.stack(counts).astype(jnp.float32)
+            n_valid = float(jnp.sum(count_v))
+            epoch_loss = (
+                float(jnp.sum(loss_v * count_v) / n_valid) if n_valid else float("nan")
+            )
+        else:
+            n_valid = 0.0
+            epoch_loss = float("nan")
+        total_images += int(n_valid)
+        ips = n_valid / dt if dt > 0 else 0.0
         # cost_analysis() FLOPs are PER-DEVICE under SPMD partitioning.
         per_chip_tflops = flops_per_step * len(losses) / dt / 1e12 if dt > 0 else 0.0
         tflops = per_chip_tflops * jax.device_count()

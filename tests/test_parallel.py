@@ -63,23 +63,10 @@ def test_head_param_specs_tp():
     assert specs["conv1"]["kernel"] == P()
 
 
-@pytest.mark.parametrize("mode", ["auto", "spmd"])
-def test_dp_step_equals_single_device(mode):
-    """8-way DP step == single-device step on the full batch.
-
-    float32 compute; BN-free check not needed for spmd since local-vs-global
-    BN stats only affect running averages, not the normalized activations...
-    except they DO affect normalization (local batch mean). So use alexnet
-    (BN-free) for exact equivalence, dropout disabled via eval-free seed:
-    alexnet has dropout — fix by using resnet18 for auto (sync-BN == global
-    batch norm == single-device norm) and squeezenet (BN-free, has dropout
-    only before head... it has dropout too). Use resnet18 + spmd with
-    per-shard BN: equivalence holds only for auto. For spmd, assert gradient
-    averaging correctness on a BN-free, dropout-free stack instead — covered
-    in test_spmd_grads_match_manual_average.
-    """
-    if mode == "spmd":
-        pytest.skip("covered by test_spmd_grads_match_manual_average")
+def test_dp_step_equals_single_device():
+    """8-way auto-mode DP step == single-device step on the full batch
+    (resnet18: auto mode normalizes BN over the logical global batch, so the
+    equivalence is exact up to reduction order)."""
     bundle, state, batch = _setup(sgd=True)
     single_step = make_train_step(compute_dtype=jnp.float32)
     s1, m1 = single_step(state, (jnp.asarray(batch[0]), jnp.asarray(batch[1])))
@@ -148,6 +135,61 @@ def test_spmd_grads_match_manual_average():
 
     for a, b in zip(
         jax.tree_util.tree_leaves(manual_params), jax.tree_util.tree_leaves(s_spmd.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_spmd_zoo_model_matches_manual_mpi_step():
+    """One spmd-mode step on a real zoo model (alexnet: BN-free, dropout
+    active) == the reference MPI algorithm computed by hand: each of the 8
+    'ranks' runs forward/backward on its shard with its own dropout stream
+    (rng folded by shard index exactly as the spmd step folds
+    ``lax.axis_index``), grads are averaged, and one identical update is
+    applied (``mpi_avg_grads`` + optimizer.step, ``mpi_tools.py:30-37``)."""
+    import optax
+
+    from mpi_pytorch_tpu.ops.losses import classification_loss
+
+    size = 64  # alexnet's conv/pool stack needs more than 32px
+    bundle, variables = create_model_bundle(
+        "alexnet", NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=size
+    )
+    model = bundle.model
+    tx = optax.sgd(1e-2)
+    state = TrainState.create(
+        apply_fn=model.apply, variables=variables, tx=tx, rng=jax.random.PRNGKey(3)
+    )
+    rng = np.random.default_rng(4)
+    images = rng.normal(size=(BATCH, size, size, 3)).astype(np.float32)
+    labels = (np.arange(BATCH) % NUM_CLASSES).astype(np.int32)
+
+    # Manual MPI-style step first (the spmd step donates its input buffers).
+    def loss_fn(params, img, lab, drop_rng):
+        out = model.apply(
+            {"params": params}, img, train=True, rngs={"dropout": drop_rng}
+        )
+        return classification_loss(out, lab)
+
+    base_rng = jax.random.fold_in(state.rng, int(state.step))
+    grads = [
+        jax.grad(loss_fn)(
+            state.params, jnp.asarray(i), jnp.asarray(l),
+            jax.random.fold_in(base_rng, k),  # ≙ fold_in(axis_index) per shard
+        )
+        for k, (i, l) in enumerate(zip(np.split(images, 8), np.split(labels, 8)))
+    ]
+    avg = jax.tree_util.tree_map(lambda *g: sum(g) / len(g), *grads)
+    updates, _ = tx.update(avg, state.opt_state, state.params)
+    manual_params = optax.apply_updates(state.params, updates)
+
+    mesh = create_mesh(MeshConfig())
+    spmd = make_spmd_train_step(mesh, compute_dtype=jnp.float32)
+    s_spmd, _ = spmd(
+        place_state_on_mesh(state, mesh), shard_batch((images, labels), mesh)
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(manual_params),
+        jax.tree_util.tree_leaves(s_spmd.params),
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
