@@ -119,6 +119,11 @@ class Config:
     metrics_file: str = "metrics.jsonl"  # structured JSONL metrics; "" disables
     profile_dir: str = ""  # non-empty → jax.profiler traces written here
     log_every_steps: int = 10
+    # Sanitizer (SURVEY §5 race-detection row): XLA collectives are
+    # deterministic by construction, so the debug surface that remains is
+    # numerics — this flag turns every NaN-producing op into an immediate
+    # error with a traceback (jax_debug_nans).
+    debug_nans: bool = False
 
     mesh: MeshConfig = field(default_factory=MeshConfig)
 
@@ -157,6 +162,16 @@ class Config:
         if self.model_name == "inception_v3":
             return (299, 299)
         return (self.height, self.width)
+
+
+def apply_runtime_flags(cfg: Config) -> None:
+    """Apply config knobs that live in the JAX runtime rather than in our own
+    code. Called by the train/eval drivers before any compilation."""
+    import jax
+
+    # Unconditional so a later run in the same process with the flag off
+    # isn't stuck with the previous run's setting.
+    jax.config.update("jax_debug_nans", cfg.debug_nans)
 
 
 def _add_dataclass_args(parser: argparse.ArgumentParser, cls: type, prefix: str = "") -> None:

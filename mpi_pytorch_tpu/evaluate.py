@@ -80,7 +80,10 @@ def build_inference(cfg: Config, mesh=None):
 def evaluate(cfg: Config) -> EvalSummary:
     from mpi_pytorch_tpu.parallel.distributed import maybe_initialize_distributed
 
+    from mpi_pytorch_tpu.config import apply_runtime_flags
+
     maybe_initialize_distributed()
+    apply_runtime_flags(cfg)
     logger = init_logger("MPT_EVAL", cfg.eval_log_file)
     mesh, bundle, state, test_manifest = build_inference(cfg)
 

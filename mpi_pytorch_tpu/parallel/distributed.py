@@ -47,6 +47,18 @@ def maybe_initialize_distributed() -> bool:
 
     import jax
 
-    jax.distributed.initialize()  # args resolved from TPU metadata / env
+    # jax.distributed.initialize() auto-resolves its arguments on managed
+    # clusters (TPU pod metadata, SLURM, …) but does NOT read the manual
+    # JAX_* env vars itself — pass those through explicitly so ad-hoc
+    # multi-process launches (≙ plain `mpiexec -n N` on a lab cluster,
+    # README.md:38) work too.
+    kwargs = {}
+    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        kwargs["coordinator_address"] = os.environ["JAX_COORDINATOR_ADDRESS"]
+    if os.environ.get("JAX_NUM_PROCESSES"):
+        kwargs["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
+    if os.environ.get("JAX_PROCESS_ID"):
+        kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+    jax.distributed.initialize(**kwargs)
     _initialized = True
     return True

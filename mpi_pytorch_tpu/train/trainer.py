@@ -227,7 +227,10 @@ def evaluate_manifest(cfg: Config, state: TrainState, mesh, manifest) -> tuple[f
 def train(cfg: Config) -> TrainSummary:
     from mpi_pytorch_tpu.parallel.distributed import maybe_initialize_distributed
 
+    from mpi_pytorch_tpu.config import apply_runtime_flags
+
     maybe_initialize_distributed()
+    apply_runtime_flags(cfg)
     logger = init_logger("MPT", cfg.log_file)
     metrics = MetricsWriter(cfg.metrics_file)
     mesh, bundle, state, (train_manifest, test_manifest, loader) = build_training(cfg)

@@ -45,3 +45,18 @@ def test_inception_image_size():
 def test_mesh_override():
     cfg = parse_config(["--mesh.model-parallel", "4"])
     assert cfg.mesh.model_parallel == 4
+
+
+def test_debug_nans_flag_wires_jax_config():
+    import jax
+
+    from mpi_pytorch_tpu.config import apply_runtime_flags
+
+    assert parse_config([]).debug_nans is False
+    cfg = parse_config(["--debug-nans", "true"])
+    assert cfg.debug_nans is True
+    try:
+        apply_runtime_flags(cfg)
+        assert jax.config.jax_debug_nans is True
+    finally:
+        jax.config.update("jax_debug_nans", False)

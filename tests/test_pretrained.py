@@ -1,0 +1,98 @@
+"""End-to-end ``use_pretrained`` coverage (reference ``utils.py:45`` +
+``models.py:33``): a torchvision-style state_dict saved as a real ``.pth``
+file goes through the offline converter's convert+save path, and
+``create_model_bundle(use_pretrained=True)`` loads the result — backbone
+weights match the converted tensors, the num_classes head keeps fresh init."""
+
+import importlib.util
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from mpi_pytorch_tpu.models import create_model_bundle
+from mpi_pytorch_tpu.models.common import head_filter
+from mpi_pytorch_tpu.models.torch_mapping import tv_entries
+
+ARCH = "resnet18"
+NUM_CLASSES = 50
+
+
+def _load_converter():
+    spec = importlib.util.spec_from_file_location(
+        "convert_torchvision",
+        os.path.join(os.path.dirname(__file__), "..", "tools", "convert_torchvision.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _flat(tree):
+    return [
+        (tuple(str(getattr(k, "key", k)) for k in path), leaf)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+    ]
+
+
+def _torch_shape(flax_shape):
+    if len(flax_shape) == 4:
+        return (flax_shape[3], flax_shape[2], flax_shape[0], flax_shape[1])
+    if len(flax_shape) == 2:
+        return (flax_shape[1], flax_shape[0])
+    return flax_shape
+
+
+def test_use_pretrained_end_to_end(tmp_path):
+    torch = pytest.importorskip("torch")
+
+    # 1. a synthetic torchvision-style state_dict, saved as a real .pth
+    _, template = create_model_bundle(
+        ARCH, NUM_CLASSES, rng=jax.random.PRNGKey(0)
+    )
+    rng = np.random.default_rng(7)
+    state_dict, transforms = {}, {}
+    for collection in ("params", "batch_stats"):
+        if collection not in template:
+            continue
+        for path, leaf in _flat(template[collection]):
+            entry = tv_entries(ARCH, collection, path, tuple(leaf.shape))
+            if entry is None:
+                continue
+            key, transform = entry
+            arr = rng.standard_normal(_torch_shape(tuple(leaf.shape))).astype(np.float32)
+            state_dict[key] = torch.from_numpy(arr)
+            transforms[(collection,) + path] = (transform, arr)
+    pth = str(tmp_path / f"{ARCH}.pth")
+    torch.save(state_dict, pth)
+
+    # 2. the converter's real convert+save path (torch .pth → msgpack)
+    converter = _load_converter()
+    out = converter.convert(ARCH, str(tmp_path / "pretrained"), pth, NUM_CLASSES)
+    assert os.path.exists(out)
+
+    # 3. the driver-facing load path
+    bundle, variables = create_model_bundle(
+        ARCH, NUM_CLASSES, use_pretrained=True,
+        pretrained_dir=str(tmp_path / "pretrained"),
+        rng=jax.random.PRNGKey(1),
+    )
+    fresh_bundle, fresh = create_model_bundle(
+        ARCH, NUM_CLASSES, rng=jax.random.PRNGKey(1)
+    )
+    for collection in ("params", "batch_stats"):
+        for (path, loaded), (_, fresh_leaf) in zip(
+            _flat(variables[collection]), _flat(fresh[collection])
+        ):
+            full = (collection,) + path
+            if head_filter(path):
+                # head keeps the fresh num_classes init (≙ reference head
+                # replacement, models.py:36)
+                np.testing.assert_array_equal(np.asarray(loaded), np.asarray(fresh_leaf))
+            else:
+                transform, arr = transforms[full]
+                np.testing.assert_allclose(
+                    np.asarray(loaded), transform(arr), atol=1e-6,
+                    err_msg=f"backbone leaf {full} does not match converted weights",
+                )
