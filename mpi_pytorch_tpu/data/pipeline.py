@@ -44,6 +44,16 @@ _synth_cache_bytes = 0
 _SYNTH_CACHE_LOCK = threading.Lock()
 
 
+def epoch_order(seed: int, epoch: int, n: int, shuffle: bool) -> np.ndarray:
+    """THE per-epoch visit order, shared by the streaming loader and the
+    device-cache index path so both walk the data identically: deterministic
+    per ``(seed, epoch)`` — the shuffle discipline the reference lacks
+    (``main.py:102``; SURVEY §3 quirks)."""
+    if shuffle:
+        return np.random.default_rng((seed, epoch)).permutation(n)
+    return np.arange(n)
+
+
 def normalize_image(img: np.ndarray) -> np.ndarray:
     """[0,1] float32 HWC → ImageNet-normalized (parity: transforms.Normalize,
     ``main.py:65``)."""
@@ -154,9 +164,7 @@ class DataLoader:
     def epoch(self, epoch: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         """Iterate one epoch of batches, prefetched in the background."""
         n = len(self.manifest)
-        order = np.arange(n)
-        if self.shuffle:
-            order = np.random.default_rng((self.seed, epoch)).permutation(n)
+        order = epoch_order(self.seed, epoch, n, self.shuffle)
         nb = len(self)
         if nb == 0:
             return iter(())

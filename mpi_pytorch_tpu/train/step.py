@@ -111,6 +111,40 @@ def make_train_step(compute_dtype=jnp.bfloat16) -> Callable:
 
 
 @functools.lru_cache(maxsize=None)
+def make_cached_train_step(mesh, compute_dtype=jnp.bfloat16) -> Callable:
+    """Train step over a DEVICE-RESIDENT dataset (cfg.device_cache): the
+    normalized image set lives in HBM (replicated), and each step gathers its
+    batch rows by index inside the compiled program — the host sends only
+    ``[B]`` int32 indices + a ``[B]`` valid mask per step instead of the
+    ``[B,H,W,3]`` pixels. The gather output is shard-constrained onto the
+    ``data`` axis, so each device materializes only its own batch shard and
+    the rest of the step is identical to ``make_train_step``.
+
+    This is the end state of the reference's data-feeding problem (its MPI
+    pipeline existed to hide per-image host cost, ``evaluation_pipeline.py:
+    53-129``): for datasets that fit HBM there is nothing left to hide."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def cached_step(state: TrainState, dataset, labels_all, idx, valid):
+        images = jnp.take(dataset, idx, axis=0).astype(compute_dtype)
+        images = lax.with_sharding_constraint(
+            images, NamedSharding(mesh, P(mesh.axis_names[0]))
+        )
+        labels = jnp.where(valid, jnp.take(labels_all, idx), -1)
+        rng = jax.random.fold_in(state.rng, state.step)
+        loss, logits, new_bs, grads = _loss_and_updates(state, images, labels, rng)
+        new_state = _apply_updates(state, grads, new_bs)
+        metrics = {
+            "loss": loss,
+            "correct": accuracy_count(logits, labels),
+            "count": valid_count(labels),
+        }
+        return new_state, metrics
+
+    return cached_step
+
+
+@functools.lru_cache(maxsize=None)
 def make_eval_step(compute_dtype=jnp.bfloat16) -> Callable:
     """Batched eval forward (≙ validation loop body ``main.py:173-182`` and
     the predict stage ``evaluation_pipeline.py:149-158``, batched).

@@ -33,6 +33,7 @@ from mpi_pytorch_tpu.models import create_model_bundle
 from mpi_pytorch_tpu.parallel.mesh import create_mesh, shard_batch
 from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
 from mpi_pytorch_tpu.train.step import (
+    make_cached_train_step,
     make_eval_step,
     make_spmd_train_step,
     make_train_step,
@@ -194,6 +195,81 @@ def synchronized_batches(loader: DataLoader, epoch: int, n_steps: int):
             it.close()  # stops the producer thread on early exit / truncation
 
 
+def cached_index_batches(cfg: Config, n: int, host_batch: int, epoch: int, n_steps: int):
+    """Per-epoch (idx [B] int32, valid [B] bool) batches for the
+    device-cache path. The permutation uses the same ``(seed, epoch)`` rng
+    discipline as ``DataLoader.epoch``, so a cached run and a streaming run
+    walk the data in the same order; tail indices repeat real rows
+    (the ``_cyclic_fill`` policy) with ``valid=False``."""
+    from mpi_pytorch_tpu.data.pipeline import epoch_order
+
+    order = epoch_order(cfg.seed, epoch, n, cfg.shuffle)
+    for step_i in range(n_steps):
+        idx = order[step_i * host_batch : (step_i + 1) * host_batch]
+        valid = np.ones(len(idx), bool)
+        pad = host_batch - len(idx)
+        if pad > 0:
+            fill = np.resize(idx, pad) if len(idx) else np.zeros(pad, order.dtype)
+            idx = np.concatenate([idx, fill])
+            valid = np.concatenate([valid, np.zeros(pad, bool)])
+        yield idx.astype(np.int32), valid
+
+
+def device_prefetch(batches, mesh, host_batch: int, depth: int = 2):
+    """Double-buffered host→device transfer: pad + ``shard_batch`` each
+    host batch ``depth`` steps ahead of the consumer. ``device_put`` is
+    asynchronous, so the H2D copy for batch N+1 overlaps the compute of
+    batch N — the overlap the reference's 4-stage MPI pipeline bought with
+    dedicated ranks (``evaluation_pipeline.py:53-129``), at zero process
+    cost."""
+    from collections import deque
+
+    buf = deque()
+    for images, labels in batches:
+        images, labels = pad_batch(images, labels, host_batch)
+        buf.append(shard_batch((images, labels), mesh))
+        if len(buf) > depth:
+            yield buf.popleft()
+    while buf:
+        yield buf.popleft()
+
+
+def build_device_cache(cfg: Config, loader: DataLoader, mesh):
+    """Materialize the loader's whole shard as device-resident arrays
+    (images replicated over the mesh, in ``cfg.input_dtype``), for the
+    ``device_cache`` fast path. One pass through the loader in manifest
+    order; the per-epoch shuffle happens on indices instead."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ordered = DataLoader(
+        loader.manifest,
+        batch_size=loader.batch_size,
+        image_size=loader.image_size,
+        shuffle=False,
+        drop_remainder=False,
+        synthetic=loader.synthetic,
+        num_workers=loader.num_workers,
+        prefetch=loader.prefetch,
+        image_dtype=str(np.dtype(loader.image_dtype)),
+    )
+    # Preallocate and fill in place: np.concatenate over a parts list would
+    # transiently hold the dataset twice, at exactly the scale (GBs) this
+    # feature targets.
+    images = np.empty(
+        (len(loader.manifest), *loader.image_size, 3), loader.image_dtype
+    )
+    row = 0
+    for batch_images, _ in ordered.epoch(0):
+        images[row : row + batch_images.shape[0]] = batch_images
+        row += batch_images.shape[0]
+    assert row == images.shape[0], (row, images.shape)
+    rep = NamedSharding(mesh, P())
+    dataset = jax.device_put(images, rep)
+    labels = jax.device_put(loader.manifest.labels.astype(np.int32), rep)
+    jax.block_until_ready(dataset)
+    return dataset, labels
+
+
 def evaluate_manifest(cfg: Config, state: TrainState, mesh, manifest) -> tuple[float, float]:
     """Batched sharded eval over a manifest → (accuracy, mean_loss).
     ≙ the rank-0 validation loop (``main.py:173-185``), but using every chip."""
@@ -254,23 +330,41 @@ def train(cfg: Config) -> TrainSummary:
             logger.info("from_checkpoint=True but no checkpoint found; fresh start")
 
     state = place_state_on_mesh(state, mesh)
-    if cfg.spmd_mode:
-        step_fn = make_spmd_train_step(mesh, _dtype(cfg.compute_dtype))
-    else:
-        step_fn = make_train_step(_dtype(cfg.compute_dtype))
+    host_batch = cfg.batch_size // jax.process_count()
 
     # AOT-compile the step on the static batch shape: one compile serves the
     # whole run, and the executable's cost analysis gives exact FLOPs/step for
     # MFU logging (SURVEY §5 — the reference has only wall-clock timers).
-    host_batch = cfg.batch_size // jax.process_count()
-    # The sample must match the loader's batch dtype exactly — the AOT
-    # executable is specialized on input avals.
-    sample = shard_batch(
-        (np.zeros((host_batch, *cfg.image_size, 3), loader.image_dtype),
-         np.zeros((host_batch,), np.int32)),
-        mesh,
-    )
-    compiled_step = step_fn.lower(state, sample).compile()
+    dataset = labels_all = None
+    if cfg.device_cache:
+        if jax.process_count() > 1:
+            raise ValueError(
+                "device_cache is single-process only; multi-host runs stream "
+                "per-host shards (set device_cache=False)"
+            )
+        dataset, labels_all = build_device_cache(cfg, loader, mesh)
+        logger.info(
+            "device cache: %d images (%.1f MB %s) resident in HBM",
+            dataset.shape[0], dataset.nbytes / 1e6, dataset.dtype,
+        )
+        cached_fn = make_cached_train_step(mesh, _dtype(cfg.compute_dtype))
+        compiled_step = cached_fn.lower(
+            state, dataset, labels_all,
+            np.zeros((host_batch,), np.int32), np.ones((host_batch,), bool),
+        ).compile()
+    elif cfg.spmd_mode:
+        step_fn = make_spmd_train_step(mesh, _dtype(cfg.compute_dtype))
+    else:
+        step_fn = make_train_step(_dtype(cfg.compute_dtype))
+    if not cfg.device_cache:
+        # The sample must match the loader's batch dtype exactly — the AOT
+        # executable is specialized on input avals.
+        sample = shard_batch(
+            (np.zeros((host_batch, *cfg.image_size, 3), loader.image_dtype),
+             np.zeros((host_batch,), np.int32)),
+            mesh,
+        )
+        compiled_step = step_fn.lower(state, sample).compile()
     flops_per_step = hw.step_flops(compiled_step)
     peak = hw.peak_bf16_tflops(jax.devices()[0])
 
@@ -292,12 +386,29 @@ def train(cfg: Config) -> TrainSummary:
     for epoch in range(start_epoch, cfg.num_epochs):
         t0 = time.perf_counter()  # ≙ MPI.Wtime() (main.py:145)
         losses, counts = [], []
-        for step_i, batch in enumerate(synchronized_batches(loader, epoch, n_steps)):
+        if cfg.device_cache:
+            # Same (seed, epoch) shuffle discipline as DataLoader.epoch, so
+            # cached and streaming runs see identical batch compositions.
+            step_args = (
+                (dataset, labels_all, idx, valid)
+                for idx, valid in cached_index_batches(
+                    cfg, len(loader.manifest), host_batch, epoch, n_steps
+                )
+            )
+        else:
             # Tail batches (drop_remainder=False) are padded to the static
             # shape with masked rows, so training keeps every image without
-            # triggering an XLA recompile.
-            images, labels = pad_batch(batch[0], batch[1], host_batch)
-            state, m = compiled_step(state, shard_batch((images, labels), mesh))
+            # triggering an XLA recompile; device_prefetch keeps the H2D
+            # copies a couple of steps ahead of compute.
+            step_args = (
+                (dev_batch,)
+                for dev_batch in device_prefetch(
+                    synchronized_batches(loader, epoch, n_steps),
+                    mesh, host_batch, cfg.prefetch_device_batches,
+                )
+            )
+        for step_i, args in enumerate(step_args):
+            state, m = compiled_step(state, *args)
             losses.append(m["loss"])
             counts.append(m["count"])
             if cfg.log_every_steps and (step_i + 1) % cfg.log_every_steps == 0:

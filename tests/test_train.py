@@ -98,6 +98,24 @@ def test_eval_pipeline_matches_direct_forward(tmp_path):
     assert res.accuracy == pytest.approx(direct_acc, abs=1e-9)
 
 
+def test_device_cache_matches_streaming(tmp_path):
+    """device_cache=True (HBM-resident dataset, on-device index gather) walks
+    the data in the same order as the streaming loader and must produce the
+    same loss trajectory — including a padded tail step (102 images, batch 32
+    → 6-row tail)."""
+    cfg_a = _tiny_cfg(
+        os.path.join(str(tmp_path), "a"), num_epochs=2, num_classes=200,
+        debug_sample_size=128, drop_remainder=False,
+    )
+    sa = train(cfg_a)
+    cfg_b = _tiny_cfg(
+        os.path.join(str(tmp_path), "b"), num_epochs=2, num_classes=200,
+        debug_sample_size=128, drop_remainder=False, device_cache=True,
+    )
+    sb = train(cfg_b)
+    np.testing.assert_allclose(sa.epoch_losses, sb.epoch_losses, rtol=1e-4)
+
+
 def test_feature_extract_freezes_backbone(tmp_path):
     from mpi_pytorch_tpu.train.trainer import build_training
     from mpi_pytorch_tpu.parallel.mesh import shard_batch
