@@ -19,8 +19,10 @@ automatically for auto-resume.
 
 from __future__ import annotations
 
+import functools
 import os
 import re
+import threading
 from typing import Any
 
 import jax
@@ -36,20 +38,45 @@ def _ckpt_path(ckpt_dir: str, epoch: int) -> str:
     return os.path.join(ckpt_dir, f"ckpt_{epoch:05d}.msgpack")
 
 
-def _payload(state: Any, epoch: int = 0, loss: float = 0.0) -> dict:
-    """The single checkpoint schema, used both as the save payload and as the
-    restore template so the two can never drift apart."""
+def _state_arrays(state: Any) -> dict:
+    """The device-array view of a TrainState that goes into a checkpoint —
+    the one place that knows which state fields are persisted."""
+    return {
+        "step": state.step,
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+        "rng": state.rng,
+    }
+
+
+def _payload_from(arrays: dict, epoch: int, loss: float) -> dict:
+    """The single checkpoint schema, built from a ``_state_arrays`` dict
+    (live state or async snapshot) — save paths and the restore template all
+    route through here so they can never drift apart."""
     return {
         "epoch": epoch,
-        "step": np.asarray(state.step),
+        "step": np.asarray(jax.device_get(arrays["step"])),
         "loss": np.asarray(loss, np.float32),
-        "params": jax.device_get(state.params),
-        "batch_stats": jax.device_get(state.batch_stats)
-        if state.batch_stats is not None
+        "params": jax.device_get(arrays["params"]),
+        "batch_stats": jax.device_get(arrays["batch_stats"])
+        if arrays["batch_stats"] is not None
         else {},
-        "opt_state": jax.device_get(state.opt_state),
-        "rng": jax.device_get(state.rng),
+        "opt_state": jax.device_get(arrays["opt_state"]),
+        "rng": jax.device_get(arrays["rng"]),
     }
+
+
+def _payload(state: Any, epoch: int = 0, loss: float = 0.0) -> dict:
+    return _payload_from(_state_arrays(state), epoch, loss)
+
+
+def _write_atomic(ckpt_dir: str, path: str, payload: dict, keep: int) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(serialization.to_bytes(payload))
+    os.replace(tmp, path)  # atomic on POSIX
+    _cleanup(ckpt_dir, keep)
 
 
 def save_checkpoint(
@@ -60,17 +87,14 @@ def save_checkpoint(
     loss: float,
     keep: int = 3,
 ) -> str | None:
-    """Write checkpoint (process 0 only); returns the path written."""
+    """Synchronous save (process 0 only); returns the path written. The
+    trainer uses ``AsyncCheckpointer``; this stays as the blocking variant
+    for tools and tests."""
     if process_index() != 0:
         return None
     os.makedirs(ckpt_dir, exist_ok=True)
-    payload = _payload(state, epoch, loss)
     path = _ckpt_path(ckpt_dir, epoch)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(serialization.to_bytes(payload))
-    os.replace(tmp, path)  # atomic on POSIX
-    _cleanup(ckpt_dir, keep)
+    _write_atomic(ckpt_dir, path, _payload(state, epoch, loss), keep)
     return path
 
 
@@ -93,6 +117,74 @@ def latest_checkpoint(ckpt_dir: str) -> str | None:
         if (m := _CKPT_RE.search(name))
     )
     return os.path.join(ckpt_dir, ckpts[-1][1]) if ckpts else None
+
+
+@functools.lru_cache(maxsize=None)
+def _copy_fn():
+    # jit output buffers never alias inputs (no donation), so this yields
+    # FRESH device arrays — the snapshot the async writer reads while the
+    # training loop donates the originals into the next step.
+    return jax.jit(lambda t: jax.tree_util.tree_map(lambda x: x.copy(), t))
+
+
+class AsyncCheckpointer:
+    """Non-blocking checkpointing: a ~ms on-device copy snapshots the state,
+    then a background thread does the expensive ``device_get`` + serialize +
+    atomic write while training continues.
+
+    Rationale: the jitted train step donates the state (train/step.py), so a
+    background transfer from the *live* arrays would race with their deletion
+    on the next step; the device-side copy gives the writer its own buffers.
+    One save in flight at a time (a new save waits for the previous write);
+    call ``wait()`` before reading the file or exiting."""
+
+    def __init__(self) -> None:
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+
+    def save(
+        self, ckpt_dir: str, *, epoch: int, state: Any, loss: float, keep: int = 3
+    ) -> str | None:
+        """Snapshot now, write in the background; returns the path that will
+        exist once the write completes (None on processes > 0).
+
+        EVERY process must call this (the trainer does): the jitted snapshot
+        copy is a global SPMD computation on multi-host meshes, so gating it
+        to process 0 would diverge the programs the processes run. Only
+        process 0 spawns the writer thread. (Multi-host saves additionally
+        require the persisted arrays to be process-0-addressable, i.e.
+        replicated or host-local — the TP-sharded head under
+        ``mesh.model_parallel > 1`` on multiple hosts is not supported by
+        this writer yet.)"""
+        self.wait()
+        snapshot = _copy_fn()(_state_arrays(state))
+        jax.block_until_ready(snapshot["params"])  # copy is cheap; be certain
+        if process_index() != 0:
+            return None
+        os.makedirs(ckpt_dir, exist_ok=True)
+        path = _ckpt_path(ckpt_dir, epoch)
+
+        def _worker() -> None:
+            try:
+                _write_atomic(ckpt_dir, path, _payload_from(snapshot, epoch, loss), keep)
+            except BaseException as e:  # surfaced on the next save()/wait()
+                self._error = e
+
+        self._thread = threading.Thread(
+            target=_worker, name="async-checkpoint", daemon=True
+        )
+        self._thread.start()
+        return path
+
+    def wait(self) -> None:
+        """Block until the in-flight write (if any) has landed; re-raise any
+        writer error on the caller thread."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
 
 
 def load_checkpoint(path: str, state: Any) -> tuple[Any, int, float]:

@@ -352,11 +352,12 @@ def train(cfg: Config) -> TrainSummary:
             state, dataset, labels_all,
             np.zeros((host_batch,), np.int32), np.ones((host_batch,), bool),
         ).compile()
-    elif cfg.spmd_mode:
-        step_fn = make_spmd_train_step(mesh, _dtype(cfg.compute_dtype))
     else:
-        step_fn = make_train_step(_dtype(cfg.compute_dtype))
-    if not cfg.device_cache:
+        step_fn = (
+            make_spmd_train_step(mesh, _dtype(cfg.compute_dtype))
+            if cfg.spmd_mode
+            else make_train_step(_dtype(cfg.compute_dtype))
+        )
         # The sample must match the loader's batch dtype exactly — the AOT
         # executable is specialized on input avals.
         sample = shard_batch(
@@ -369,6 +370,7 @@ def train(cfg: Config) -> TrainSummary:
     peak = hw.peak_bf16_tflops(jax.devices()[0])
 
     summary = TrainSummary()
+    checkpointer = ckpt.AsyncCheckpointer()
     total_images = 0
     train_t0 = time.perf_counter()
     epoch_loss = float("nan")
@@ -383,95 +385,111 @@ def train(cfg: Config) -> TrainSummary:
         len(train_manifest), host_batch, cfg.drop_remainder
     )
 
-    for epoch in range(start_epoch, cfg.num_epochs):
-        t0 = time.perf_counter()  # ≙ MPI.Wtime() (main.py:145)
-        losses, counts = [], []
-        if cfg.device_cache:
-            # Same (seed, epoch) shuffle discipline as DataLoader.epoch, so
-            # cached and streaming runs see identical batch compositions.
-            step_args = (
-                (dataset, labels_all, idx, valid)
-                for idx, valid in cached_index_batches(
-                    cfg, len(loader.manifest), host_batch, epoch, n_steps
+    try:
+        for epoch in range(start_epoch, cfg.num_epochs):
+            t0 = time.perf_counter()  # ≙ MPI.Wtime() (main.py:145)
+            losses, counts = [], []
+            if cfg.device_cache:
+                # Same (seed, epoch) shuffle discipline as DataLoader.epoch, so
+                # cached and streaming runs see identical batch compositions.
+                step_args = (
+                    (dataset, labels_all, idx, valid)
+                    for idx, valid in cached_index_batches(
+                        cfg, len(loader.manifest), host_batch, epoch, n_steps
+                    )
                 )
-            )
-        else:
-            # Tail batches (drop_remainder=False) are padded to the static
-            # shape with masked rows, so training keeps every image without
-            # triggering an XLA recompile; device_prefetch keeps the H2D
-            # copies a couple of steps ahead of compute.
-            step_args = (
-                (dev_batch,)
-                for dev_batch in device_prefetch(
-                    synchronized_batches(loader, epoch, n_steps),
-                    mesh, host_batch, cfg.prefetch_device_batches,
+            else:
+                # Tail batches (drop_remainder=False) are padded to the static
+                # shape with masked rows, so training keeps every image without
+                # triggering an XLA recompile; device_prefetch keeps the H2D
+                # copies a couple of steps ahead of compute.
+                step_args = (
+                    (dev_batch,)
+                    for dev_batch in device_prefetch(
+                        synchronized_batches(loader, epoch, n_steps),
+                        mesh, host_batch, cfg.prefetch_device_batches,
+                    )
                 )
-            )
-        for step_i, args in enumerate(step_args):
-            state, m = compiled_step(state, *args)
-            losses.append(m["loss"])
-            counts.append(m["count"])
-            if cfg.log_every_steps and (step_i + 1) % cfg.log_every_steps == 0:
-                logger.info(
-                    "epoch %d step %d loss %.4f", epoch, step_i + 1, float(m["loss"])
+            for step_i, args in enumerate(step_args):
+                state, m = compiled_step(state, *args)
+                losses.append(m["loss"])
+                counts.append(m["count"])
+                if cfg.log_every_steps and (step_i + 1) % cfg.log_every_steps == 0:
+                    logger.info(
+                        "epoch %d step %d loss %.4f", epoch, step_i + 1, float(m["loss"])
+                    )
+            # Device sync so the timer measures compute, not dispatch.
+            jax.block_until_ready(state.params)
+            dt = time.perf_counter() - t0
+            if losses:
+                # Per-sample accounting: weight each step's mean loss by its
+                # global valid-row count, so padded tail steps aren't over-weighted
+                # (matches the reference's per-sample loss bookkeeping) and
+                # throughput never counts padding rows. One device sync per epoch.
+                loss_v = jnp.stack(losses)
+                count_v = jnp.stack(counts).astype(jnp.float32)
+                n_valid = float(jnp.sum(count_v))
+                epoch_loss = (
+                    float(jnp.sum(loss_v * count_v) / n_valid) if n_valid else float("nan")
                 )
-        # Device sync so the timer measures compute, not dispatch.
-        jax.block_until_ready(state.params)
-        dt = time.perf_counter() - t0
-        if losses:
-            # Per-sample accounting: weight each step's mean loss by its
-            # global valid-row count, so padded tail steps aren't over-weighted
-            # (matches the reference's per-sample loss bookkeeping) and
-            # throughput never counts padding rows. One device sync per epoch.
-            loss_v = jnp.stack(losses)
-            count_v = jnp.stack(counts).astype(jnp.float32)
-            n_valid = float(jnp.sum(count_v))
-            epoch_loss = (
-                float(jnp.sum(loss_v * count_v) / n_valid) if n_valid else float("nan")
+            else:
+                n_valid = 0.0
+                epoch_loss = float("nan")
+            total_images += int(n_valid)
+            ips = n_valid / dt if dt > 0 else 0.0
+            # cost_analysis() FLOPs are PER-DEVICE under SPMD partitioning.
+            per_chip_tflops = flops_per_step * len(losses) / dt / 1e12 if dt > 0 else 0.0
+            tflops = per_chip_tflops * jax.device_count()
+            # mfu None (omitted) when either peak or FLOPs are unknown — a
+            # confident "0.0%" would be indistinguishable from a stalled chip.
+            mfu = 100.0 * per_chip_tflops / peak if (peak and flops_per_step > 0) else None
+            # ≙ reference epoch log line (main.py:158-160), plus throughput/MFU
+            logger.info(
+                "Epoch: %d, Loss: %.6f, Time: %.2f s, %.1f img/s%s",
+                epoch, epoch_loss, dt, ips,
+                f", MFU {mfu:.1f}%" if mfu is not None else "",
             )
-        else:
-            n_valid = 0.0
-            epoch_loss = float("nan")
-        total_images += int(n_valid)
-        ips = n_valid / dt if dt > 0 else 0.0
-        # cost_analysis() FLOPs are PER-DEVICE under SPMD partitioning.
-        per_chip_tflops = flops_per_step * len(losses) / dt / 1e12 if dt > 0 else 0.0
-        tflops = per_chip_tflops * jax.device_count()
-        # mfu None (omitted) when either peak or FLOPs are unknown — a
-        # confident "0.0%" would be indistinguishable from a stalled chip.
-        mfu = 100.0 * per_chip_tflops / peak if (peak and flops_per_step > 0) else None
-        # ≙ reference epoch log line (main.py:158-160), plus throughput/MFU
-        logger.info(
-            "Epoch: %d, Loss: %.6f, Time: %.2f s, %.1f img/s%s",
-            epoch, epoch_loss, dt, ips,
-            f", MFU {mfu:.1f}%" if mfu is not None else "",
-        )
-        metrics.write(
-            {"kind": "epoch", "epoch": epoch, "loss": epoch_loss, "time_s": dt,
-             "images_per_sec": ips, "tflops": tflops, "mfu_pct": mfu}
-        )
-        summary.epoch_times.append(dt)
-        summary.epoch_losses.append(epoch_loss)
-        summary.epochs_run += 1
+            metrics.write(
+                {"kind": "epoch", "epoch": epoch, "loss": epoch_loss, "time_s": dt,
+                 "images_per_sec": ips, "tflops": tflops, "mfu_pct": mfu}
+            )
+            summary.epoch_times.append(dt)
+            summary.epoch_losses.append(epoch_loss)
+            summary.epochs_run += 1
 
-        if (epoch + 1) % cfg.checkpoint_every_epochs == 0:
-            path = ckpt.save_checkpoint(
-                cfg.checkpoint_dir, epoch=epoch, state=state, loss=epoch_loss,
-                keep=cfg.keep_checkpoints,
-            )
-            if path:
-                summary.checkpoint_path = path
-                logger.info("checkpoint saved: %s (≙ main.py:162-171)", path)
+            if (epoch + 1) % cfg.checkpoint_every_epochs == 0:
+                # Async: an on-device snapshot (~ms) releases the epoch loop
+                # immediately; device_get + write happen on a background thread
+                # (the sync version stalled epochs 25-45 s through the device
+                # relay). ≙ rank-0 save (main.py:162-171), without stopping the
+                # world.
+                ckpt_t0 = time.perf_counter()
+                path = checkpointer.save(
+                    cfg.checkpoint_dir, epoch=epoch, state=state, loss=epoch_loss,
+                    keep=cfg.keep_checkpoints,
+                )
+                if path:
+                    summary.checkpoint_path = path
+                    logger.info(
+                        "checkpoint dispatched: %s (%.2f s stall; ≙ main.py:162-171)",
+                        path, time.perf_counter() - ckpt_t0,
+                    )
 
-        if cfg.validate:
-            # Reference quirk preserved behind a flag: validation runs over the
-            # TRAIN manifest (main.py:104-112; SURVEY §3); val_on_train=False
-            # gives the honest test-split validation.
-            val_manifest = train_manifest if cfg.val_on_train else test_manifest
-            acc, vloss = evaluate_manifest(cfg, state, mesh, val_manifest)
-            summary.val_accuracy = acc
-            logger.info("Accuracy of the network: %.4f (val_on_train=%s)", acc, cfg.val_on_train)
-            metrics.write({"kind": "val", "epoch": epoch, "accuracy": acc, "loss": vloss})
+            if cfg.validate:
+                # Reference quirk preserved behind a flag: validation runs over the
+                # TRAIN manifest (main.py:104-112; SURVEY §3); val_on_train=False
+                # gives the honest test-split validation.
+                val_manifest = train_manifest if cfg.val_on_train else test_manifest
+                acc, vloss = evaluate_manifest(cfg, state, mesh, val_manifest)
+                summary.val_accuracy = acc
+                logger.info("Accuracy of the network: %.4f (val_on_train=%s)", acc, cfg.val_on_train)
+                metrics.write({"kind": "val", "epoch": epoch, "accuracy": acc, "loss": vloss})
+
+    finally:
+        # The in-flight background write must land (or its error surface)
+        # even when an epoch or validation raises — otherwise a
+        # checkpoint logged as dispatched could silently never exist.
+        checkpointer.wait()
 
     if profiling:
         jax.profiler.stop_trace()
