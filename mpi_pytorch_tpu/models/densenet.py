@@ -107,8 +107,10 @@ class DenseNet(nn.Module):
         )
         x = nn.relu(x)
         x = global_avg_pool(x)
-        x = x.astype(jnp.float32)
-        return nn.Dense(self.num_classes, param_dtype=self.param_dtype, name="head")(x)
+        # Head matmul in compute dtype; the loss computes softmax in float32.
+        return nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype, name="head"
+        )(x)
 
 
 def densenet121(num_classes: int, **kw: Any) -> DenseNet:

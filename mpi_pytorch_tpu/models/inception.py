@@ -194,8 +194,11 @@ class InceptionAux(nn.Module):
         x = BasicConv(768, (5, 5), dtype=self.dtype, param_dtype=self.param_dtype,
                       bn_axis_name=self.bn_axis_name, name="conv1")(x, train)
         x = adaptive_avg_pool(x, (1, 1)).reshape(x.shape[0], -1)
-        x = x.astype(jnp.float32)
-        return nn.Dense(self.num_classes, param_dtype=self.param_dtype, name="aux_head")(x)
+        # Head matmul in compute dtype; the loss computes softmax in float32.
+        return nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype,
+            name="aux_head",
+        )(x)
 
 
 class InceptionV3(nn.Module):
@@ -239,8 +242,10 @@ class InceptionV3(nn.Module):
 
         x = global_avg_pool(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        x = x.astype(jnp.float32)
-        logits = nn.Dense(self.num_classes, param_dtype=self.param_dtype, name="head")(x)
+        # Head matmul in compute dtype; the loss computes softmax in float32.
+        logits = nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype, name="head"
+        )(x)
         if aux is not None:
             return logits, aux
         return logits

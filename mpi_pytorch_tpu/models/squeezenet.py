@@ -60,9 +60,10 @@ class SqueezeNet(nn.Module):
         x = fire(64, 256, 256, "fire9")(x)
 
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        # 1×1 conv head (reference models.py:70), then global average pool.
+        # 1×1 conv head (reference models.py:70), then global average pool;
+        # compute dtype like every other conv — the loss softmaxes in float32.
         x = nn.Conv(self.num_classes, (1, 1), param_dtype=self.param_dtype,
-                    dtype=jnp.float32, name="head")(x.astype(jnp.float32))
+                    dtype=self.dtype, name="head")(x)
         x = nn.relu(x)
         return global_avg_pool(x)
 

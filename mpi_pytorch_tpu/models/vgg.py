@@ -49,8 +49,10 @@ class VGG(nn.Module):
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = nn.relu(dense(4096, "fc2")(x))
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        x = x.astype(jnp.float32)
-        return nn.Dense(self.num_classes, param_dtype=self.param_dtype, name="head")(x)
+        # Head matmul in compute dtype; the loss computes softmax in float32.
+        return nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype, name="head"
+        )(x)
 
 
 def vgg11_bn(num_classes: int, **kw: Any) -> VGG:

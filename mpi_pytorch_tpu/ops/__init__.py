@@ -1,4 +1,4 @@
-from mpi_pytorch_tpu.ops.fused_ce import fused_softmax_ce
+from mpi_pytorch_tpu.ops.fused_head_ce import fused_head_ce, head_ce_reference
 from mpi_pytorch_tpu.ops.losses import (
     AUX_LOSS_WEIGHT,
     accuracy_count,
@@ -18,7 +18,8 @@ __all__ = [
     "classification_loss",
     "cross_entropy",
     "full_attention",
-    "fused_softmax_ce",
+    "fused_head_ce",
+    "head_ce_reference",
     "ring_attention",
     "ring_self_attention",
     "valid_count",

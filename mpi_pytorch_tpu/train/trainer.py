@@ -485,11 +485,18 @@ def train(cfg: Config) -> TrainSummary:
                 logger.info("Accuracy of the network: %.4f (val_on_train=%s)", acc, cfg.val_on_train)
                 metrics.write({"kind": "val", "epoch": epoch, "accuracy": acc, "loss": vloss})
 
-    finally:
-        # The in-flight background write must land (or its error surface)
-        # even when an epoch or validation raises — otherwise a
-        # checkpoint logged as dispatched could silently never exist.
-        checkpointer.wait()
+    except BaseException:
+        # Drain the in-flight write on the failure path too, but never let a
+        # secondary writer error replace the primary exception the user
+        # needs to see.
+        try:
+            checkpointer.wait()
+        except BaseException as werr:
+            logger.warning("background checkpoint write also failed: %s", werr)
+        raise
+    # Clean path: the last dispatched write must land before callers read the
+    # file (resume, evaluate), and a writer error must fail the run loudly.
+    checkpointer.wait()
 
     if profiling:
         jax.profiler.stop_trace()

@@ -1,0 +1,75 @@
+"""Fused head-matmul+CE kernel vs the plain-XLA reference: loss values and
+all three gradients (features, weights, bias), including label<0 padding
+rows and a vocab size that is not a multiple of the kernel's block."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_pytorch_tpu.ops.fused_head_ce import fused_head_ce, head_ce_reference
+
+B, D, V = 16, 64, 5000  # V % 2048 != 0 → exercises the -inf padding path
+
+
+def _inputs():
+    rng = np.random.default_rng(0)
+    # Pre-round to bf16 grid so the kernel's bf16 MXU matmul and the f32
+    # reference see identical operands (accumulation is f32 in both).
+    feats = (
+        jnp.asarray(rng.normal(size=(B, D)), jnp.float32)
+        .astype(jnp.bfloat16)
+        .astype(jnp.float32)
+    )
+    w = (
+        jnp.asarray(rng.normal(size=(D, V)) * 0.05, jnp.float32)
+        .astype(jnp.bfloat16)
+        .astype(jnp.float32)
+    )
+    b = jnp.asarray(rng.normal(size=(V,)) * 0.1, jnp.float32)
+    labels = np.asarray(rng.integers(0, V, size=(B,)), np.int32)
+    labels[3] = -1  # padding rows
+    labels[11] = -1
+    return feats, w, b, jnp.asarray(labels)
+
+
+def test_forward_matches_reference():
+    feats, w, b, labels = _inputs()
+    got = fused_head_ce(feats, w, b, labels, interpret=True)
+    want = head_ce_reference(feats, w, b, labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)
+    assert float(got[3]) == 0.0 and float(got[11]) == 0.0
+
+
+def test_grads_match_reference():
+    feats, w, b, labels = _inputs()
+
+    def total_fused(f, w_, b_):
+        return jnp.sum(fused_head_ce(f, w_, b_, labels, interpret=True))
+
+    def total_ref(f, w_, b_):
+        return jnp.sum(head_ce_reference(f, w_, b_, labels))
+
+    gf, gw, gb = jax.grad(total_fused, argnums=(0, 1, 2))(feats, w, b)
+    rf, rw, rb = jax.grad(total_ref, argnums=(0, 1, 2))(feats, w, b)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(rf), rtol=2e-2, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), rtol=2e-2, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(rb), rtol=2e-2, atol=2e-3)
+    # padding rows carry exactly zero feature-gradient
+    np.testing.assert_array_equal(np.asarray(gf[3]), np.zeros(D, np.float32))
+
+
+def test_weighted_upstream_gradient():
+    """Non-uniform cotangents route through the custom VJP correctly."""
+    feats, w, b, labels = _inputs()
+    weights = jnp.asarray(np.random.default_rng(1).uniform(0.1, 2.0, size=(B,)), jnp.float32)
+
+    def weighted(f):
+        return jnp.sum(fused_head_ce(f, w, b, labels, interpret=True) * weights)
+
+    def weighted_ref(f):
+        return jnp.sum(head_ce_reference(f, w, b, labels) * weights)
+
+    gf = jax.grad(weighted)(feats)
+    rf = jax.grad(weighted_ref)(feats)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(rf), rtol=2e-2, atol=2e-3)
