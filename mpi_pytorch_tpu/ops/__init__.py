@@ -11,6 +11,7 @@ from mpi_pytorch_tpu.ops.ring_attention import (
     ring_attention,
     ring_self_attention,
 )
+from mpi_pytorch_tpu.ops.ulysses import ulysses_attention, ulysses_self_attention
 
 __all__ = [
     "AUX_LOSS_WEIGHT",
@@ -22,5 +23,7 @@ __all__ = [
     "head_ce_reference",
     "ring_attention",
     "ring_self_attention",
+    "ulysses_attention",
+    "ulysses_self_attention",
     "valid_count",
 ]

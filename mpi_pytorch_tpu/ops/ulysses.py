@@ -1,0 +1,89 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The second of the framework's two long-context strategies (the first is
+``ops/ring_attention.py``; the reference itself has no attention — SURVEY
+§2c — but long-context scale is first-class here). Where the ring keeps the
+sequence sharded and rotates K/V blocks around the ICI ring (n-1 hops,
+overlapping transfer with blockwise compute), Ulysses re-shards: one
+``all_to_all`` turns sequence-sharded [B, S/n, H, D] tensors into
+head-sharded [B, S, H/n, D] tensors, each device runs ordinary full
+attention over the ENTIRE sequence for its subset of heads, and a second
+``all_to_all`` restores sequence sharding.
+
+Trade-off between the two (why both exist):
+
+- ring: no constraint on head count; per-device memory stays O(S/n); n-1
+  sequential ICI hops — best when S is huge and H is small.
+- ulysses: a fixed number of collectives regardless of n — 4 all-to-alls in
+  the forward pass (q/k/v re-shards + the output restore; doubled again by
+  autodiff in the backward) instead of the ring's n−1 sequential hops; needs
+  H divisible by n and materializes full-S scores per head shard — best when
+  H ≥ n and S fits per-device once divided by heads.
+
+Numerics are exact in both (tests assert equality with single-device
+attention, values and gradients).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mpi_pytorch_tpu.ops.ring_attention import full_attention
+
+
+def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False) -> jnp.ndarray:
+    """Per-shard Ulysses attention. Must run inside an SPMD context binding
+    ``axis_name``; each shard holds [B, S/n, H, D] with H divisible by n."""
+    def seq_to_heads(x):
+        # [B, S/n, H, D] → [B, S, H/n, D]: split heads across devices,
+        # concatenate the gathered sequence blocks in ring order.
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    # Full sequence is local now, so plain (global-position) causal masking
+    # inside full_attention is already correct.
+    out = full_attention(qh, kh, vh, causal=causal)
+    return heads_to_seq(out)
+
+
+@functools.lru_cache(maxsize=None)
+def _ulysses_jit(mesh, causal, seq_axis):
+    spec = P(None, seq_axis, None, None)
+    fn = shard_map(
+        functools.partial(ulysses_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def ulysses_self_attention(
+    q, k, v, mesh: Mesh, *, seq_axis: str | None = None, causal: bool = False
+) -> jnp.ndarray:
+    """Driver-facing wrapper: shards [B,S,H,D] tensors over ``seq_axis`` of
+    ``mesh``, all-to-alls to head sharding, attends, and restores. S and H
+    must both divide evenly by the axis size."""
+    seq_axis = seq_axis or mesh.axis_names[0]
+    size = mesh.shape[seq_axis]
+    if q.shape[1] % size != 0:
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by mesh axis "
+            f"'{seq_axis}' of size {size}"
+        )
+    if q.shape[2] % size != 0:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by mesh axis "
+            f"'{seq_axis}' of size {size}; use ring_attention when H < n"
+        )
+    return _ulysses_jit(mesh, causal, seq_axis)(q, k, v)

@@ -160,9 +160,13 @@ def make_eval_step(compute_dtype=jnp.bfloat16) -> Callable:
         valid = labels >= 0
         safe_labels = jnp.maximum(labels, 0)
         logits = state.apply_fn(state.variables, images.astype(compute_dtype), train=False)
-        per_ex = optax.softmax_cross_entropy_with_integer_labels(
-            logits.astype(jnp.float32), safe_labels
-        )
+        # The barrier pins a real f32 boundary: without it XLA fuses the
+        # upcast into the softmax chain and evaluates logsumexp at bf16
+        # precision, which yields per-example CE errors of ±3e-3 — enough to
+        # report (impossible) negative eval losses on a converged model
+        # (measured: batch loss-sums off by ±0.4 vs the eager computation).
+        logits = lax.optimization_barrier(logits.astype(jnp.float32))
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, safe_labels)
         return {
             "loss": jnp.sum(per_ex * valid),
             "correct": jnp.sum((jnp.argmax(logits, axis=-1) == labels) & valid),
