@@ -98,6 +98,10 @@ class Config:
     # per-shard local BN — exact reference DP semantics; default is the
     # compiler-partitioned jit step (global-batch BN, supports TP head).
     spmd_mode: bool = False
+    # ZeRO-1-style optimizer sharding (beyond reference parity): Adam moments
+    # sharded over the data axis instead of replicated — per-device optimizer
+    # memory 2×params → 2×params/n. Auto (jit) mode only.
+    zero_optimizer: bool = False
 
     # --- input pipeline ---
     shuffle: bool = True
@@ -154,6 +158,12 @@ class Config:
             raise ValueError(f"compute_dtype must be float32|bfloat16, got {self.compute_dtype}")
         if self.input_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"input_dtype must be float32|bfloat16, got {self.input_dtype}")
+        if self.zero_optimizer and self.spmd_mode:
+            raise ValueError(
+                "zero_optimizer shards Adam moments via the auto-partitioned "
+                "jit step; the spmd_mode shard_map step replicates its state "
+                "specs, so the two do not compose"
+            )
         if self.device_cache and self.spmd_mode:
             raise ValueError(
                 "device_cache uses the auto-partitioned gather step; it does "

@@ -98,10 +98,13 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False) -> jnp.ndar
 
 
 @functools.lru_cache(maxsize=None)
-def _ring_jit(mesh, causal, seq_axis):
+def _sp_jit(mesh, causal, seq_axis, per_shard_fn):
+    """Shared scaffolding for both SP strategies (ring here, Ulysses in
+    ops/ulysses.py): shard q/k/v's sequence axis over ``seq_axis`` and jit
+    the given per-shard attention function under shard_map."""
     spec = P(None, seq_axis, None, None)
     fn = shard_map(
-        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
+        functools.partial(per_shard_fn, axis_name=seq_axis, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -110,15 +113,26 @@ def _ring_jit(mesh, causal, seq_axis):
     return jax.jit(fn)
 
 
-def ring_self_attention(
-    q, k, v, mesh: Mesh, *, seq_axis: str | None = None, causal: bool = False
+def sp_self_attention(
+    per_shard_fn, q, k, v, mesh: Mesh, *, seq_axis: str | None = None,
+    causal: bool = False,
 ) -> jnp.ndarray:
-    """Driver-facing wrapper: shards [B,S,H,D] tensors over ``seq_axis`` of
-    ``mesh`` and runs the ring. S must divide evenly by the axis size."""
+    """Driver-facing wrapper shared by the SP strategies: shards [B,S,H,D]
+    tensors over ``seq_axis`` of ``mesh`` and runs ``per_shard_fn``. S must
+    divide evenly by the axis size."""
     seq_axis = seq_axis or mesh.axis_names[0]
     if q.shape[1] % mesh.shape[seq_axis] != 0:
         raise ValueError(
             f"sequence length {q.shape[1]} not divisible by mesh axis "
             f"'{seq_axis}' of size {mesh.shape[seq_axis]}"
         )
-    return _ring_jit(mesh, causal, seq_axis)(q, k, v)
+    return _sp_jit(mesh, causal, seq_axis, per_shard_fn)(q, k, v)
+
+
+def ring_self_attention(
+    q, k, v, mesh: Mesh, *, seq_axis: str | None = None, causal: bool = False
+) -> jnp.ndarray:
+    """Ring attention over ``seq_axis``-sharded [B,S,H,D] tensors."""
+    return sp_self_attention(
+        ring_attention, q, k, v, mesh, seq_axis=seq_axis, causal=causal
+    )

@@ -26,13 +26,9 @@ attention, values and gradients).
 
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from mpi_pytorch_tpu.ops.ring_attention import full_attention
 
@@ -55,35 +51,20 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False) -> jnp.n
     return heads_to_seq(out)
 
 
-@functools.lru_cache(maxsize=None)
-def _ulysses_jit(mesh, causal, seq_axis):
-    spec = P(None, seq_axis, None, None)
-    fn = shard_map(
-        functools.partial(ulysses_attention, axis_name=seq_axis, causal=causal),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
-    )
-    return jax.jit(fn)
-
-
 def ulysses_self_attention(
     q, k, v, mesh: Mesh, *, seq_axis: str | None = None, causal: bool = False
 ) -> jnp.ndarray:
     """Driver-facing wrapper: shards [B,S,H,D] tensors over ``seq_axis`` of
     ``mesh``, all-to-alls to head sharding, attends, and restores. S and H
     must both divide evenly by the axis size."""
-    seq_axis = seq_axis or mesh.axis_names[0]
-    size = mesh.shape[seq_axis]
-    if q.shape[1] % size != 0:
-        raise ValueError(
-            f"sequence length {q.shape[1]} not divisible by mesh axis "
-            f"'{seq_axis}' of size {size}"
-        )
+    from mpi_pytorch_tpu.ops.ring_attention import sp_self_attention
+
+    size = mesh.shape[seq_axis or mesh.axis_names[0]]
     if q.shape[2] % size != 0:
         raise ValueError(
             f"ulysses needs heads ({q.shape[2]}) divisible by mesh axis "
-            f"'{seq_axis}' of size {size}; use ring_attention when H < n"
+            f"of size {size}; use ring_attention when H < n"
         )
-    return _ulysses_jit(mesh, causal, seq_axis)(q, k, v)
+    return sp_self_attention(
+        ulysses_attention, q, k, v, mesh, seq_axis=seq_axis, causal=causal
+    )

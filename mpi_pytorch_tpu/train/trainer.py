@@ -215,6 +215,15 @@ def cached_index_batches(cfg: Config, n: int, host_batch: int, epoch: int, n_ste
         yield idx.astype(np.int32), valid
 
 
+def _state_shardings(state):
+    """The placed state's shardings, used to PIN the train step's output
+    state layout to its input layout. Without this the AOT executable's
+    output shardings are compiler-chosen, and with ZeRO-sharded moments XLA
+    happily emits data-sharded *params* — which the next call then rejects,
+    since AOT executables do not auto-reshard their inputs."""
+    return jax.tree_util.tree_map(lambda x: x.sharding, state)
+
+
 def device_prefetch(batches, mesh, host_batch: int, depth: int = 2):
     """Double-buffered host→device transfer: pad + ``shard_batch`` each
     host batch ``depth`` steps ahead of the consumer. ``device_put`` is
@@ -329,7 +338,16 @@ def train(cfg: Config) -> TrainSummary:
         else:
             logger.info("from_checkpoint=True but no checkpoint found; fresh start")
 
-    state = place_state_on_mesh(state, mesh)
+    if cfg.zero_optimizer and jax.process_count() > 1 and cfg.checkpoint_every_epochs:
+        # Data-axis-sharded moments span other hosts' devices, which the
+        # process-0 checkpoint writer cannot device_get (AsyncCheckpointer
+        # requires persisted arrays to be process-0-addressable).
+        raise ValueError(
+            "zero_optimizer with multi-host checkpointing is not supported yet: "
+            "shard the moments OR checkpoint, not both (set "
+            "checkpoint_every_epochs=0 to disable saves, or zero_optimizer=False)"
+        )
+    state = place_state_on_mesh(state, mesh, zero_optimizer=cfg.zero_optimizer)
     host_batch = cfg.batch_size // jax.process_count()
 
     # AOT-compile the step on the static batch shape: one compile serves the
@@ -348,7 +366,9 @@ def train(cfg: Config) -> TrainSummary:
             dataset.shape[0], dataset.nbytes / 1e6, dataset.dtype,
         )
         cached_fn = make_cached_train_step(mesh, _dtype(cfg.compute_dtype))
-        compiled_step = cached_fn.lower(
+        compiled_step = jax.jit(
+            cached_fn, donate_argnums=(0,), out_shardings=(_state_shardings(state), None),
+        ).lower(
             state, dataset, labels_all,
             np.zeros((host_batch,), np.int32), np.ones((host_batch,), bool),
         ).compile()
@@ -365,7 +385,13 @@ def train(cfg: Config) -> TrainSummary:
              np.zeros((host_batch,), np.int32)),
             mesh,
         )
-        compiled_step = step_fn.lower(state, sample).compile()
+        if cfg.spmd_mode:
+            compiled_step = step_fn.lower(state, sample).compile()
+        else:
+            compiled_step = jax.jit(
+                step_fn, donate_argnums=(0,),
+                out_shardings=(_state_shardings(state), None),
+            ).lower(state, sample).compile()
     flops_per_step = hw.step_flops(compiled_step)
     peak = hw.peak_bf16_tflops(jax.devices()[0])
 
@@ -457,7 +483,7 @@ def train(cfg: Config) -> TrainSummary:
             summary.epoch_losses.append(epoch_loss)
             summary.epochs_run += 1
 
-            if (epoch + 1) % cfg.checkpoint_every_epochs == 0:
+            if cfg.checkpoint_every_epochs and (epoch + 1) % cfg.checkpoint_every_epochs == 0:
                 # Async: an on-device snapshot (~ms) releases the epoch loop
                 # immediately; device_get + write happen on a background thread
                 # (the sync version stalled epochs 25-45 s through the device

@@ -218,6 +218,54 @@ def test_tp_head_step_runs_and_matches_dp():
     )
 
 
+def test_zero_optimizer_sharding_matches_replicated():
+    """ZeRO-1-style moment sharding: (a) Adam moments are actually sharded
+    over the data axis (per-device shard is 1/8 of the array), (b) one train
+    step produces the same params and loss as the replicated-optimizer
+    step."""
+    mesh = create_mesh(MeshConfig())
+
+    bundle, state, batch = _setup()  # adam
+    step = make_train_step(compute_dtype=jnp.float32)
+    s_rep, m_rep = step(
+        place_state_on_mesh(state, mesh), shard_batch(batch, mesh)
+    )
+
+    bundle2, state2, _ = _setup()
+    placed = place_state_on_mesh(state2, mesh, zero_optimizer=True)
+    sharded = [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(placed.opt_state)
+        if hasattr(leaf, "sharding") and leaf.ndim > 0
+        and not leaf.sharding.is_fully_replicated
+    ]
+    assert sharded, "no optimizer leaf ended up sharded"
+    big = max(sharded, key=lambda a: a.size)
+    assert big.addressable_shards[0].data.size == big.size // 8
+
+    s_zero, m_zero = step(placed, shard_batch(batch, mesh))
+    np.testing.assert_allclose(float(m_rep["loss"]), float(m_zero["loss"]), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_rep.params), jax.tree_util.tree_leaves(s_zero.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    # A SECOND step through the trainer's pinned-output-sharding executable:
+    # without out_shardings pinning, XLA returns data-sharded params from
+    # step 1 that the AOT executable rejects as step-2 input (regression
+    # caught end-to-end; unit-covered here).
+    from mpi_pytorch_tpu.train.trainer import _state_shardings
+
+    bundle3, state3, _ = _setup()  # placed was donated by the step above
+    placed2 = place_state_on_mesh(state3, mesh, zero_optimizer=True)
+    pinned = jax.jit(
+        step, donate_argnums=(0,), out_shardings=(_state_shardings(placed2), None)
+    )
+    placed2, _ = pinned(placed2, shard_batch(batch, mesh))
+    placed2, m3 = pinned(placed2, shard_batch(batch, mesh))
+    assert np.isfinite(float(m3["loss"]))
+
+
 def test_collectives_parity():
     """collectives.* inside shard_map reproduce mpi_tools semantics."""
     mesh = create_mesh(MeshConfig())
