@@ -108,6 +108,15 @@ class Config:
     seed: int = 0  # reference uses seed 0 for sampling (main.py:78)
     loader_workers: int = 8
     prefetch_batches: int = 2
+    # Native (C++) batched JPEG ingest (mpi_pytorch_tpu/native): decode a whole
+    # batch per ctypes call on C threads with the GIL released — the TPU-host
+    # equivalent of the reference's DataLoader worker processes / MPI
+    # preprocessing ranks. Auto-falls back to PIL when the toolchain is absent.
+    native_decode: bool = True
+    # libjpeg DCT prescale for large sources: 0 = full decode (PIL bit-parity),
+    # 1 = fastest, 2 = 2x-margin scaled decode (default; ~1/255 mean deviation
+    # from PIL, measured in tests/test_native_decode.py).
+    decode_prescale: int = 2
     drop_remainder: bool = True  # static shapes for XLA; see trainer for semantics
     # Keep the whole (decoded, normalized) training set resident in HBM and
     # have each jitted step gather its batch by index on device — zero
@@ -116,6 +125,10 @@ class Config:
     # manifest ≈ 3.7 GB bf16): the host feeds the chip once per run instead
     # of once per step. Single-process only (multi-host keeps streaming).
     device_cache: bool = False
+    # With device_cache: run each epoch as ONE compiled lax.scan over all its
+    # steps (one dispatch per epoch instead of per step), removing the
+    # remaining host↔device round-trips from the training path entirely.
+    scan_epoch: bool = False
     # Streaming path: batches transferred to device this many steps ahead of
     # compute (device_put is async), hiding host→device latency — the
     # overlap the reference's 4-stage MPI pipeline existed to provide.
@@ -168,6 +181,11 @@ class Config:
             raise ValueError(
                 "device_cache uses the auto-partitioned gather step; it does "
                 "not compose with the reference-parity spmd_mode shard_map step"
+            )
+        if self.scan_epoch and not self.device_cache:
+            raise ValueError(
+                "scan_epoch runs the epoch as one compiled scan over the "
+                "device-resident dataset; it requires device_cache=True"
             )
         if self.spmd_mode and self.mesh.model_parallel > 1:
             raise ValueError(

@@ -35,6 +35,7 @@ from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
 from mpi_pytorch_tpu.train.step import (
     make_cached_train_step,
     make_eval_step,
+    make_scanned_epoch,
     make_spmd_train_step,
     make_train_step,
     place_state_on_mesh,
@@ -93,6 +94,8 @@ def build_training(cfg: Config, mesh=None):
         num_workers=cfg.loader_workers,
         prefetch=cfg.prefetch_batches,
         image_dtype=cfg.input_dtype,
+        native_decode=cfg.native_decode,
+        decode_prescale=cfg.decode_prescale,
     )
 
     bundle, variables = create_model_bundle(
@@ -260,6 +263,8 @@ def build_device_cache(cfg: Config, loader: DataLoader, mesh):
         num_workers=loader.num_workers,
         prefetch=loader.prefetch,
         image_dtype=str(np.dtype(loader.image_dtype)),
+        native_decode=loader.native_decode,
+        decode_prescale=loader.decode_prescale,
     )
     # Preallocate and fill in place: np.concatenate over a parts list would
     # transiently hold the dataset twice, at exactly the scale (GBs) this
@@ -294,6 +299,8 @@ def evaluate_manifest(cfg: Config, state: TrainState, mesh, manifest) -> tuple[f
         num_workers=cfg.loader_workers,
         prefetch=cfg.prefetch_batches,
         image_dtype=cfg.input_dtype,
+        native_decode=cfg.native_decode,
+        decode_prescale=cfg.decode_prescale,
     )
     correct = total = 0
     loss_sum = 0.0
@@ -353,6 +360,7 @@ def train(cfg: Config) -> TrainSummary:
     # AOT-compile the step on the static batch shape: one compile serves the
     # whole run, and the executable's cost analysis gives exact FLOPs/step for
     # MFU logging (SURVEY §5 — the reference has only wall-clock timers).
+    n_steps = global_step_count(len(train_manifest), host_batch, cfg.drop_remainder)
     dataset = labels_all = None
     if cfg.device_cache:
         if jax.process_count() > 1:
@@ -365,13 +373,28 @@ def train(cfg: Config) -> TrainSummary:
             "device cache: %d images (%.1f MB %s) resident in HBM",
             dataset.shape[0], dataset.nbytes / 1e6, dataset.dtype,
         )
-        cached_fn = make_cached_train_step(mesh, _dtype(cfg.compute_dtype))
-        compiled_step = jax.jit(
-            cached_fn, donate_argnums=(0,), out_shardings=(_state_shardings(state), None),
+        # The per-step program is the FLOPs reference either way; the scan
+        # mode reuses the Lowered (cost analysis needs no backend compile)
+        # because XLA counts a scan body once regardless of trip count.
+        lowered_step = jax.jit(
+            make_cached_train_step(mesh, _dtype(cfg.compute_dtype)),
+            donate_argnums=(0,), out_shardings=(_state_shardings(state), None),
         ).lower(
             state, dataset, labels_all,
             np.zeros((host_batch,), np.int32), np.ones((host_batch,), bool),
-        ).compile()
+        )
+        if cfg.scan_epoch:
+            epoch_fn = make_scanned_epoch(mesh, _dtype(cfg.compute_dtype))
+            compiled_step = jax.jit(
+                epoch_fn, donate_argnums=(0,),
+                out_shardings=(_state_shardings(state), None),
+            ).lower(
+                state, dataset, labels_all,
+                np.zeros((n_steps, host_batch), np.int32),
+                np.ones((n_steps, host_batch), bool),
+            ).compile()
+        else:
+            compiled_step = lowered_step.compile()
     else:
         step_fn = (
             make_spmd_train_step(mesh, _dtype(cfg.compute_dtype))
@@ -392,7 +415,10 @@ def train(cfg: Config) -> TrainSummary:
                 step_fn, donate_argnums=(0,),
                 out_shardings=(_state_shardings(state), None),
             ).lower(state, sample).compile()
-    flops_per_step = hw.step_flops(compiled_step)
+    if cfg.device_cache and cfg.scan_epoch:
+        flops_per_step = hw.step_flops(lowered_step)
+    else:
+        flops_per_step = hw.step_flops(compiled_step)
     peak = hw.peak_bf16_tflops(jax.devices()[0])
 
     summary = TrainSummary()
@@ -407,15 +433,34 @@ def train(cfg: Config) -> TrainSummary:
     if profiling:
         jax.profiler.start_trace(cfg.profile_dir)
 
-    n_steps = global_step_count(
-        len(train_manifest), host_batch, cfg.drop_remainder
-    )
-
     try:
         for epoch in range(start_epoch, cfg.num_epochs):
             t0 = time.perf_counter()  # ≙ MPI.Wtime() (main.py:145)
             losses, counts = [], []
-            if cfg.device_cache:
+            loss_v = count_v = None  # [steps] device arrays, set below
+            if cfg.device_cache and cfg.scan_epoch:
+                # One dispatch for the whole epoch: stack the per-step index
+                # batches and let the compiled lax.scan run every step
+                # back-to-back on device. metrics come back as [n_steps]
+                # arrays — used as-is, never split into per-step scalars.
+                idx_steps = list(
+                    cached_index_batches(cfg, len(loader.manifest), host_batch, epoch, n_steps)
+                )
+                if idx_steps:  # zero-step epochs (tiny shard + drop_remainder) no-op
+                    idx_all = np.stack([i for i, _ in idx_steps])
+                    valid_all = np.stack([v for _, v in idx_steps])
+                    state, m = compiled_step(state, dataset, labels_all, idx_all, valid_all)
+                    loss_v, count_v = m["loss"], m["count"]
+                    if cfg.log_every_steps:
+                        for step_i in range(
+                            cfg.log_every_steps - 1, int(loss_v.shape[0]), cfg.log_every_steps
+                        ):
+                            logger.info(
+                                "epoch %d step %d loss %.4f",
+                                epoch, step_i + 1, float(loss_v[step_i]),
+                            )
+                step_args = ()
+            elif cfg.device_cache:
                 # Same (seed, epoch) shuffle discipline as DataLoader.epoch, so
                 # cached and streaming runs see identical batch compositions.
                 step_args = (
@@ -447,16 +492,19 @@ def train(cfg: Config) -> TrainSummary:
             # Device sync so the timer measures compute, not dispatch.
             jax.block_until_ready(state.params)
             dt = time.perf_counter() - t0
-            if losses:
+            if losses:  # per-step paths collected python lists
+                loss_v = jnp.stack(losses)
+                count_v = jnp.stack(counts)
+            steps_run = int(loss_v.shape[0]) if loss_v is not None else 0
+            if steps_run:
                 # Per-sample accounting: weight each step's mean loss by its
                 # global valid-row count, so padded tail steps aren't over-weighted
                 # (matches the reference's per-sample loss bookkeeping) and
                 # throughput never counts padding rows. One device sync per epoch.
-                loss_v = jnp.stack(losses)
-                count_v = jnp.stack(counts).astype(jnp.float32)
-                n_valid = float(jnp.sum(count_v))
+                count_f = count_v.astype(jnp.float32)
+                n_valid = float(jnp.sum(count_f))
                 epoch_loss = (
-                    float(jnp.sum(loss_v * count_v) / n_valid) if n_valid else float("nan")
+                    float(jnp.sum(loss_v * count_f) / n_valid) if n_valid else float("nan")
                 )
             else:
                 n_valid = 0.0
@@ -464,7 +512,7 @@ def train(cfg: Config) -> TrainSummary:
             total_images += int(n_valid)
             ips = n_valid / dt if dt > 0 else 0.0
             # cost_analysis() FLOPs are PER-DEVICE under SPMD partitioning.
-            per_chip_tflops = flops_per_step * len(losses) / dt / 1e12 if dt > 0 else 0.0
+            per_chip_tflops = flops_per_step * steps_run / dt / 1e12 if dt > 0 else 0.0
             tflops = per_chip_tflops * jax.device_count()
             # mfu None (omitted) when either peak or FLOPs are unknown — a
             # confident "0.0%" would be indistinguishable from a stalled chip.

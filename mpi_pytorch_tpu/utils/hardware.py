@@ -22,7 +22,9 @@ def peak_bf16_tflops(device) -> float | None:
 
 
 def step_flops(compiled) -> float:
-    """Total FLOPs of a compiled XLA executable (0.0 if unavailable)."""
+    """Total FLOPs of an XLA executable (0.0 if unavailable). Accepts either
+    a Compiled or a Lowered stage — cost analysis does not require the
+    (expensive) backend compile."""
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):

@@ -66,7 +66,7 @@ def test_sigkill_mid_training_then_resume(tmp_path):
 
     latest = ckpt.latest_checkpoint(ckpt_dir)
     assert latest is not None and latest.endswith(".msgpack")
-    killed_epoch = int(os.path.basename(latest)[5:10])
+    killed_epoch = int(ckpt._CKPT_RE.search(os.path.basename(latest)).group(1))
 
     # Auto-resume from whatever the crash left behind and run to completion.
     cfg = parse_config(
@@ -75,5 +75,7 @@ def test_sigkill_mid_training_then_resume(tmp_path):
     summary = train(cfg)
     assert summary.epochs_run == 2  # epochs killed+1 .. killed+2
     assert summary.checkpoint_path and os.path.exists(summary.checkpoint_path)
-    resumed_epoch = int(os.path.basename(summary.checkpoint_path)[5:10])
+    resumed_epoch = int(
+        ckpt._CKPT_RE.search(os.path.basename(summary.checkpoint_path)).group(1)
+    )
     assert resumed_epoch == killed_epoch + 2
