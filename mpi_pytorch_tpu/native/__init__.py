@@ -74,7 +74,11 @@ def _abi_version(lib: ctypes.CDLL) -> int:
 
 def _try_load() -> ctypes.CDLL | None:
     global _build_error
-    src_mtime = os.path.getmtime(_SRC)
+    try:
+        src_mtime = os.path.getmtime(_SRC)
+    except OSError as e:  # source not shipped (trimmed install): PIL path
+        _build_error = f"native source unavailable: {e}"
+        return None
     last_err: str | None = None
     for path in _candidate_paths():
         # Two attempts per candidate: a cached library that loads but has the

@@ -260,10 +260,14 @@ extern "C" {
 int mpt_decode_one(const uint8_t* buf, size_t len, int out_h, int out_w,
                    const float* mean, const float* stdv, float* out,
                    int prescale_margin) {
-  std::vector<uint8_t> pixels;
-  std::vector<float> rs;
-  return decode_buffer(buf, len, out_h, out_w, mean, stdv, out, prescale_margin,
-                       pixels, rs);
+  try {
+    std::vector<uint8_t> pixels;
+    std::vector<float> rs;
+    return decode_buffer(buf, len, out_h, out_w, mean, stdv, out,
+                         prescale_margin, pixels, rs);
+  } catch (...) {
+    return ERR_DECODE;  // allocation failure: per-item error, never a throw
+  }
 }
 
 // Decode n files into out[n*out_h*out_w*3] on n_threads C++ threads.
@@ -284,9 +288,16 @@ int mpt_decode_batch(const char** paths, int n, int out_h, int out_w,
     for (;;) {
       const int i = next.fetch_add(1);
       if (i >= n) return;
-      const int st = decode_file(paths[i], out_h, out_w, mean, stdv,
-                                 out + stride * i, prescale_margin, filebuf,
-                                 pixels, rs);
+      int st;
+      try {
+        st = decode_file(paths[i], out_h, out_w, mean, stdv, out + stride * i,
+                         prescale_margin, filebuf, pixels, rs);
+      } catch (...) {
+        // e.g. std::bad_alloc from a header declaring absurd dimensions
+        // (libjpeg permits up to 65500x65500). The contract is per-item
+        // failure, never thread/process death.
+        st = ERR_DECODE;
+      }
       statuses[i] = st;
       if (st != OK) {
         // A failed decode may have partially written its slot; zero it so

@@ -416,7 +416,23 @@ def train(cfg: Config) -> TrainSummary:
                 out_shardings=(_state_shardings(state), None),
             ).lower(state, sample).compile()
     if cfg.device_cache and cfg.scan_epoch:
-        flops_per_step = hw.step_flops(lowered_step)
+        # Per-step FLOPs for the scan mode, without compiling a throwaway
+        # per-step executable. Two wrinkles: (a) Lowered.cost_analysis() runs
+        # BEFORE SPMD partitioning, so the per-step lowering gives WHOLE-
+        # program FLOPs (÷ device_count approximates per-device); (b) whether
+        # the compiled scan's cost analysis counts the body once or
+        # trip-count times is an XLA implementation detail (observed: once).
+        # Use the compiled scan's number, disambiguated against the lowered
+        # estimate — exact on the observed behavior, correct within
+        # collective-overhead noise if XLA ever changes it.
+        est = hw.step_flops(lowered_step) / max(1, jax.device_count())
+        cand = hw.step_flops(compiled_step)
+        if cand > 0 and est > 0 and n_steps > 1:
+            flops_per_step = (
+                cand if abs(cand - est) <= abs(cand / n_steps - est) else cand / n_steps
+            )
+        else:
+            flops_per_step = cand if cand > 0 else est
     else:
         flops_per_step = hw.step_flops(compiled_step)
     peak = hw.peak_bf16_tflops(jax.devices()[0])

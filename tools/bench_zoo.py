@@ -1,0 +1,135 @@
+"""Per-architecture training throughput across the full model zoo.
+
+The headline ``bench.py`` measures the reference's north-star workload
+(resnet18); this sweeps all seven architectures of the zoo
+(≙ ``models.py:16-101``) through the same jitted DP train step on whatever
+chips are present, and prints one JSON line per architecture:
+
+    {"model": ..., "images_per_sec_per_chip": N, "mfu_pct": N, ...}
+
+Run: ``python tools/bench_zoo.py [--steps 20] [--out docs/zoo_bench.json]``
+
+Per-arch batch sizes are throughput-reasonable single-chip defaults, scaled
+down where activation memory is the binding constraint (vgg11_bn's big
+early feature maps; inception's 299px input — the size the reference would
+have needed for inception to work at all, SURVEY §3 quirks).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REFERENCE_IMG_PER_SEC_PER_WORKER = 4.4  # BASELINE.md, training.log:1268-1275
+NUM_CLASSES = 64500  # utils.py:39
+
+# (batch per chip, image size). 128px mirrors utils.py:33-34 except
+# inception_v3, which genuinely requires 299 (models.py:95, SURVEY §3).
+ZOO = {
+    "resnet18": (2048, 128),
+    "resnet34": (2048, 128),
+    "alexnet": (2048, 128),
+    "vgg11_bn": (512, 128),
+    # squeezenet's classifier is a 1x1 conv applied BEFORE global pooling
+    # (≙ models.py:70), so its head activation is [B, 8, 8, 64500] — 64x the
+    # other archs' logits per example. Batch 2048 blows compile memory.
+    "squeezenet1_0": (512, 128),
+    "densenet121": (1024, 128),
+    "inception_v3": (256, 299),
+}
+
+
+def bench_one(model_name: str, batch_per_chip: int, image: int, steps: int, warmup: int):
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.models import create_model_bundle
+    from mpi_pytorch_tpu.parallel.mesh import create_mesh, shard_batch
+    from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
+    from mpi_pytorch_tpu.train.step import make_train_step, place_state_on_mesh
+    from mpi_pytorch_tpu.utils.hardware import peak_bf16_tflops, step_flops
+
+    n_chips = jax.device_count()
+    batch = batch_per_chip * n_chips
+
+    mesh = create_mesh(Config().mesh)
+    bundle, variables = create_model_bundle(
+        model_name, NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=image,
+        dtype=jnp.bfloat16, param_dtype=jnp.float32,
+    )
+    state = TrainState.create(
+        apply_fn=bundle.model.apply, variables=variables,
+        tx=make_optimizer(4e-4), rng=jax.random.PRNGKey(1),
+    )
+    state = place_state_on_mesh(state, mesh)
+    step = make_train_step(jnp.bfloat16)
+
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((batch, image, image, 3), np.float32)
+    labels = rng.integers(0, NUM_CLASSES, size=(batch,)).astype(np.int32)
+    device_batch = shard_batch((images, labels), mesh)
+
+    compiled = step.lower(state, device_batch).compile()
+    flops_per_step = step_flops(compiled)
+
+    for _ in range(warmup):
+        state, _ = compiled(state, device_batch)
+    # Block on the donated state, not a metrics scalar: scalars can resolve
+    # early through the remote-PJRT relay and overstate throughput (bench.py).
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, _ = compiled(state, device_batch)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    ips = steps * batch / dt
+    tflops_per_chip = flops_per_step * steps / dt / 1e12  # cost analysis is per-device
+    peak = peak_bf16_tflops(jax.devices()[0])
+    rec = {
+        "model": model_name,
+        "batch_per_chip": batch_per_chip,
+        "image_size": image,
+        "chips": n_chips,
+        "images_per_sec_per_chip": round(ips / n_chips, 1),
+        "vs_baseline": round(ips / n_chips / REFERENCE_IMG_PER_SEC_PER_WORKER, 1),
+        "step_ms": round(dt / steps * 1e3, 2),
+        "tflops_per_chip": round(tflops_per_chip, 2),
+    }
+    if peak and flops_per_step > 0:
+        rec["mfu_pct"] = round(100.0 * tflops_per_chip / peak, 1)
+    return rec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--models", default=",".join(ZOO), help="comma-separated subset")
+    ap.add_argument("--out", default="", help="also write a JSON array to this path")
+    args = ap.parse_args()
+
+    records = []
+    for name in args.models.split(","):
+        batch, image = ZOO[name]
+        try:
+            rec = bench_one(name, batch, image, args.steps, args.warmup)
+        except Exception as e:  # e.g. OOM at this batch on a small chip
+            rec = {"model": name, "error": f"{type(e).__name__}: {e}"[:300]}
+        records.append(rec)
+        print(json.dumps(rec), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(records, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
