@@ -102,6 +102,16 @@ class Config:
     # sharded over the data axis instead of replicated — per-device optimizer
     # memory 2×params → 2×params/n. Auto (jit) mode only.
     zero_optimizer: bool = False
+    # Rematerialization (jax.checkpoint): recompute forward activations
+    # during backward instead of storing them — HBM for FLOPs, the lever for
+    # batch sizes / image sizes that exceed activation memory.
+    remat: bool = False
+    # Gradient accumulation: split each batch into this many microbatches,
+    # accumulate count-weighted gradients over a lax.scan, apply ONE
+    # optimizer update — the same global-batch gradient at 1/accum_steps the
+    # activation memory. (BN stats update per microbatch.) Streaming auto
+    # mode only.
+    accum_steps: int = 1
 
     # --- input pipeline ---
     shuffle: bool = True
@@ -186,6 +196,18 @@ class Config:
             raise ValueError(
                 "scan_epoch runs the epoch as one compiled scan over the "
                 "device-resident dataset; it requires device_cache=True"
+            )
+        if self.accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {self.accum_steps}")
+        if self.accum_steps > 1 and (self.spmd_mode or self.device_cache):
+            raise ValueError(
+                "accum_steps > 1 is implemented for the streaming auto-"
+                "partitioned step only (not spmd_mode / device_cache)"
+            )
+        if self.accum_steps > 1 and self.batch_size % self.accum_steps != 0:
+            raise ValueError(
+                f"batch_size {self.batch_size} not divisible by "
+                f"accum_steps {self.accum_steps}"
             )
         if self.spmd_mode and self.mesh.model_parallel > 1:
             raise ValueError(

@@ -39,8 +39,13 @@ from mpi_pytorch_tpu.parallel.mesh import named_shardings, param_specs
 from mpi_pytorch_tpu.train.state import TrainState
 
 
-def _loss_and_updates(state: TrainState, images, labels, rng):
-    """Shared core: forward (train mode), loss, logits, new batch_stats."""
+def _loss_and_updates(state: TrainState, images, labels, rng, remat: bool = False):
+    """Shared core: forward (train mode), loss, logits, new batch_stats.
+
+    ``remat`` wraps the forward in ``jax.checkpoint``: activations are
+    recomputed during the backward pass instead of being saved — the
+    canonical HBM-for-FLOPs trade that lets batch sizes (or 299px inception
+    inputs) exceed what activation memory would otherwise allow."""
 
     def loss_fn(params):
         variables = {"params": params}
@@ -61,6 +66,8 @@ def _loss_and_updates(state: TrainState, images, labels, rng):
         logits = out[0] if isinstance(out, tuple) else out
         return loss, (new_bs, logits)
 
+    if remat:
+        loss_fn = jax.checkpoint(loss_fn)
     (loss, (new_bs, logits)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
         state.params
     )
@@ -85,33 +92,136 @@ def _apply_updates(state: TrainState, grads, new_bs) -> TrainState:
 
 
 @functools.lru_cache(maxsize=None)
-def make_train_step(compute_dtype=jnp.bfloat16) -> Callable:
+def make_train_step(
+    compute_dtype=jnp.bfloat16, remat: bool = False, accum_steps: int = 1, mesh=None
+) -> Callable:
     """Auto-sharded train step: ``jit(step)`` with donated state. Sharding
     comes from the input arrays' placements (state placed by
     ``place_state_on_mesh``, batch by ``mesh.shard_batch``).
 
+    ``accum_steps`` > 1 splits the batch into that many microbatches and
+    accumulates gradients over a ``lax.scan`` before the single optimizer
+    update — same global-batch gradient (each microbatch's mean-grad is
+    weighted by its valid-row count), a fraction of the activation memory.
+    BatchNorm statistics are updated per microbatch (sequentially), the one
+    semantic difference from the unsplit step; requires ``mesh`` so each
+    microbatch stays sharded over the data axis through the reshape.
+
     Memoized so repeated ``train()`` calls in one process (resume, tests)
     reuse the same jitted function and its XLA compilation cache."""
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def train_step(state: TrainState, batch):
-        images, labels = batch
-        images = images.astype(compute_dtype)
-        rng = jax.random.fold_in(state.rng, state.step)
-        loss, logits, new_bs, grads = _loss_and_updates(state, images, labels, rng)
-        new_state = _apply_updates(state, grads, new_bs)
-        metrics = {
+    def compute_metrics(loss, logits, labels):
+        return {
             "loss": loss,
             "correct": accuracy_count(logits, labels),
             "count": valid_count(labels),
         }
+
+    if accum_steps <= 1:
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def train_step(state: TrainState, batch):
+            images, labels = batch
+            images = images.astype(compute_dtype)
+            rng = jax.random.fold_in(state.rng, state.step)
+            loss, logits, new_bs, grads = _loss_and_updates(
+                state, images, labels, rng, remat=remat
+            )
+            new_state = _apply_updates(state, grads, new_bs)
+            return new_state, compute_metrics(loss, logits, labels)
+
+        return train_step
+
+    if mesh is None:
+        raise ValueError("accum_steps > 1 requires the mesh (microbatch sharding)")
+    data_axis = mesh.axis_names[0]
+
+    n_data = mesh.shape[data_axis]
+
+    def local_microbatches(x):
+        # DEVICE-LOCAL split: each device scans its own k chunks, so no batch
+        # data crosses the ICI. A contiguous reshape([k, B/k]) would instead
+        # reshard essentially the whole batch every step (device d holds rows
+        # [d*B/n, (d+1)*B/n) but contiguous microbatch j needs different
+        # rows). Which rows share a microbatch is semantically irrelevant —
+        # the final gradient/metrics are count-weighted sums over ALL rows —
+        # except for per-microbatch BN stats, the already-documented
+        # difference of accumulation.
+        b = x.shape[0]
+        mpd = b // (n_data * accum_steps)  # rows per device per microbatch
+        x = lax.with_sharding_constraint(
+            x.reshape(n_data, accum_steps, mpd, *x.shape[1:]),
+            NamedSharding(mesh, P(data_axis)),
+        )
+        x = jnp.swapaxes(x, 0, 1)  # device-local transpose
+        x = lax.with_sharding_constraint(x, NamedSharding(mesh, P(None, data_axis)))
+        return lax.with_sharding_constraint(
+            x.reshape(accum_steps, n_data * mpd, *x.shape[3:]),
+            NamedSharding(mesh, P(None, data_axis)),
+        )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def accum_train_step(state: TrainState, batch):
+        images, labels = batch
+        images = images.astype(compute_dtype)
+        if images.shape[0] % (n_data * accum_steps):
+            raise ValueError(
+                f"batch {images.shape[0]} not divisible by data size {n_data} "
+                f"x accum_steps {accum_steps}"
+            )
+        im = local_microbatches(images)
+        lb = local_microbatches(labels)
+        base_rng = jax.random.fold_in(state.rng, state.step)
+        zero_grads = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+
+        def body(carry, xs):
+            grad_sum, bs, loss_sum, correct, count, i = carry
+            mimg, mlab = xs
+            st = state.replace(batch_stats=bs) if bs is not None else state
+            loss, logits, new_bs, grads = _loss_and_updates(
+                st, mimg, mlab, jax.random.fold_in(base_rng, i), remat=remat
+            )
+            # Weight each microbatch's mean-grad/mean-loss by its valid-row
+            # count so the accumulated step equals the unsplit big-batch step
+            # even when padded tail rows land unevenly across microbatches.
+            cnt = valid_count(mlab)
+            w = cnt.astype(loss.dtype)
+            grad_sum = jax.tree_util.tree_map(
+                lambda a, g: a + g * w.astype(g.dtype), grad_sum, grads
+            )
+            return (
+                grad_sum,
+                new_bs if bs is not None else None,
+                loss_sum + loss * w,
+                correct + accuracy_count(logits, mlab),
+                count + cnt,
+                i + 1,
+            ), None
+
+        init = (
+            zero_grads,
+            state.batch_stats,
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.int32),
+            jnp.zeros((), jnp.int32),
+            jnp.zeros((), jnp.int32),
+        )
+        (grad_sum, new_bs, loss_sum, correct, count, _), _ = lax.scan(
+            body, init, (im, lb)
+        )
+        denom = jnp.maximum(count.astype(jnp.float32), 1.0)
+        grads = jax.tree_util.tree_map(
+            lambda g: g / denom.astype(g.dtype), grad_sum
+        )
+        new_state = _apply_updates(state, grads, new_bs)
+        metrics = {"loss": loss_sum / denom, "correct": correct, "count": count}
         return new_state, metrics
 
-    return train_step
+    return accum_train_step
 
 
 @functools.lru_cache(maxsize=None)
-def make_cached_train_step(mesh, compute_dtype=jnp.bfloat16) -> Callable:
+def make_cached_train_step(mesh, compute_dtype=jnp.bfloat16, remat: bool = False) -> Callable:
     """Train step over a DEVICE-RESIDENT dataset (cfg.device_cache): the
     normalized image set lives in HBM (replicated), and each step gathers its
     batch rows by index inside the compiled program — the host sends only
@@ -126,12 +236,16 @@ def make_cached_train_step(mesh, compute_dtype=jnp.bfloat16) -> Callable:
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def cached_step(state: TrainState, dataset, labels_all, idx, valid):
-        return _cached_batch_step(mesh, compute_dtype, state, dataset, labels_all, idx, valid)
+        return _cached_batch_step(
+            mesh, compute_dtype, state, dataset, labels_all, idx, valid, remat=remat
+        )
 
     return cached_step
 
 
-def _cached_batch_step(mesh, compute_dtype, state, dataset, labels_all, idx, valid):
+def _cached_batch_step(
+    mesh, compute_dtype, state, dataset, labels_all, idx, valid, remat: bool = False
+):
     """One gather-from-HBM train step — THE shared body of the per-step
     cached mode and the scanned-epoch mode, so the two can never drift
     numerically (the trainer's FLOPs accounting and the scan≡cached test
@@ -142,7 +256,7 @@ def _cached_batch_step(mesh, compute_dtype, state, dataset, labels_all, idx, val
     )
     labels = jnp.where(valid, jnp.take(labels_all, idx), -1)
     rng = jax.random.fold_in(state.rng, state.step)
-    loss, logits, new_bs, grads = _loss_and_updates(state, images, labels, rng)
+    loss, logits, new_bs, grads = _loss_and_updates(state, images, labels, rng, remat=remat)
     new_state = _apply_updates(state, grads, new_bs)
     metrics = {
         "loss": loss,
@@ -153,7 +267,7 @@ def _cached_batch_step(mesh, compute_dtype, state, dataset, labels_all, idx, val
 
 
 @functools.lru_cache(maxsize=None)
-def make_scanned_epoch(mesh, compute_dtype=jnp.bfloat16) -> Callable:
+def make_scanned_epoch(mesh, compute_dtype=jnp.bfloat16, remat: bool = False) -> Callable:
     """An ENTIRE epoch as one compiled program (cfg.scan_epoch): ``lax.scan``
     over the per-step index batches, gathering each batch from the
     HBM-resident dataset exactly like ``make_cached_train_step``.
@@ -175,7 +289,7 @@ def make_scanned_epoch(mesh, compute_dtype=jnp.bfloat16) -> Callable:
         def body(state, step_batch):
             idx, valid = step_batch
             return _cached_batch_step(
-                mesh, compute_dtype, state, dataset, labels_all, idx, valid
+                mesh, compute_dtype, state, dataset, labels_all, idx, valid, remat=remat
             )
 
         return lax.scan(body, state, (idx_all, valid_all))
@@ -283,7 +397,7 @@ def place_state_on_mesh(state: TrainState, mesh, zero_optimizer: bool = False) -
 # ---------------------------------------------------------------------------
 
 
-def make_spmd_train_step(mesh, compute_dtype=jnp.bfloat16) -> Callable:
+def make_spmd_train_step(mesh, compute_dtype=jnp.bfloat16, remat: bool = False) -> Callable:
     """Reference-parity DP step: shard_map over ``data``; local BN stats;
     explicit ``avg_grads`` pmean — the literal TPU translation of one
     training iteration of ``mpiexec -n N python -m mpi4py main.py``."""
@@ -296,7 +410,7 @@ def make_spmd_train_step(mesh, compute_dtype=jnp.bfloat16) -> Callable:
         rng = jax.random.fold_in(
             jax.random.fold_in(state.rng, state.step), lax.axis_index(data_axis)
         )
-        loss, logits, new_bs, grads = _loss_and_updates(state, images, labels, rng)
+        loss, logits, new_bs, grads = _loss_and_updates(state, images, labels, rng, remat=remat)
 
         # THE line (≙ the entire mpi_avg_grads stack, mpi_tools.py:30-37):
         grads = collectives.avg_grads(grads, axis=data_axis)

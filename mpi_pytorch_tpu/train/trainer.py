@@ -82,6 +82,11 @@ def build_training(cfg: Config, mesh=None):
             "requires even division"
         )
     host_batch = cfg.batch_size // jax.process_count()
+    if cfg.accum_steps > 1 and (cfg.batch_size // cfg.accum_steps) % data_size != 0:
+        raise ValueError(
+            f"microbatch {cfg.batch_size}/{cfg.accum_steps} not divisible by "
+            f"data-parallel size {data_size}"
+        )
 
     train_loader = DataLoader(
         host_shard,
@@ -377,14 +382,14 @@ def train(cfg: Config) -> TrainSummary:
         # mode reuses the Lowered (cost analysis needs no backend compile)
         # because XLA counts a scan body once regardless of trip count.
         lowered_step = jax.jit(
-            make_cached_train_step(mesh, _dtype(cfg.compute_dtype)),
+            make_cached_train_step(mesh, _dtype(cfg.compute_dtype), remat=cfg.remat),
             donate_argnums=(0,), out_shardings=(_state_shardings(state), None),
         ).lower(
             state, dataset, labels_all,
             np.zeros((host_batch,), np.int32), np.ones((host_batch,), bool),
         )
         if cfg.scan_epoch:
-            epoch_fn = make_scanned_epoch(mesh, _dtype(cfg.compute_dtype))
+            epoch_fn = make_scanned_epoch(mesh, _dtype(cfg.compute_dtype), remat=cfg.remat)
             compiled_step = jax.jit(
                 epoch_fn, donate_argnums=(0,),
                 out_shardings=(_state_shardings(state), None),
@@ -397,9 +402,12 @@ def train(cfg: Config) -> TrainSummary:
             compiled_step = lowered_step.compile()
     else:
         step_fn = (
-            make_spmd_train_step(mesh, _dtype(cfg.compute_dtype))
+            make_spmd_train_step(mesh, _dtype(cfg.compute_dtype), remat=cfg.remat)
             if cfg.spmd_mode
-            else make_train_step(_dtype(cfg.compute_dtype))
+            else make_train_step(
+                _dtype(cfg.compute_dtype), remat=cfg.remat,
+                accum_steps=cfg.accum_steps, mesh=mesh,
+            )
         )
         # The sample must match the loader's batch dtype exactly — the AOT
         # executable is specialized on input avals.

@@ -187,6 +187,81 @@ def test_scan_epoch_requires_device_cache():
         Config(scan_epoch=True).validate_config()
 
 
+def _mlp_state(rng_seed=0, num_classes=11, image=8):
+    """A BN-free, dropout-free model so accumulation/remat equivalence can be
+    asserted exactly (no per-microbatch stats, no rng-shape dependence)."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+    from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(num_classes)(x)
+
+    model = MLP()
+    variables = model.init(jax.random.PRNGKey(rng_seed), jnp.zeros((1, image, image, 3)))
+    return TrainState.create(
+        apply_fn=model.apply, variables=variables, tx=make_optimizer(1e-3),
+        rng=jax.random.PRNGKey(rng_seed + 1),
+    )
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps=k (count-weighted microbatch grads, one optimizer update)
+    must equal the unsplit big-batch step — including when padded (-1) rows
+    land unevenly across microbatches."""
+    import jax.numpy as jnp
+    from mpi_pytorch_tpu.config import MeshConfig
+    from mpi_pytorch_tpu.parallel.mesh import create_mesh, shard_batch
+    from mpi_pytorch_tpu.train.step import make_train_step, place_state_on_mesh
+
+    mesh = create_mesh(MeshConfig())
+    rng = np.random.default_rng(0)
+    batch = 32
+    images = rng.standard_normal((batch, 8, 8, 3)).astype(np.float32)
+    labels = rng.integers(0, 11, size=(batch,)).astype(np.int32)
+    labels[5:11] = -1  # padding rows, unevenly placed across 4 microbatches
+
+    outs = {}
+    for k in (1, 4):
+        state = place_state_on_mesh(_mlp_state(), mesh)
+        step = make_train_step(jnp.float32, accum_steps=k, mesh=mesh)
+        new_state, m = step(state, shard_batch((images, labels), mesh))
+        outs[k] = (new_state.params, m)
+    p1, m1 = outs[1]
+    p4, m4 = outs[4]
+    assert int(m1["count"]) == int(m4["count"]) == batch - 6
+    assert int(m1["correct"]) == int(m4["correct"])
+    np.testing.assert_allclose(float(m1["loss"]), float(m4["loss"]), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-6, atol=2e-7), p1, p4
+    )
+
+
+def test_remat_matches_plain_step(tmp_path):
+    """jax.checkpoint only changes WHEN activations are computed, not what —
+    the loss trajectory must match the plain step."""
+    cfg_a = _tiny_cfg(os.path.join(str(tmp_path), "a"), num_epochs=2, num_classes=200)
+    sa = train(cfg_a)
+    cfg_b = _tiny_cfg(
+        os.path.join(str(tmp_path), "b"), num_epochs=2, num_classes=200, remat=True
+    )
+    sb = train(cfg_b)
+    np.testing.assert_allclose(sa.epoch_losses, sb.epoch_losses, rtol=1e-4)
+
+
+def test_accum_config_validation():
+    with pytest.raises(ValueError, match="accum_steps"):
+        Config(accum_steps=3, batch_size=128).validate_config()
+    with pytest.raises(ValueError, match="accum_steps"):
+        Config(accum_steps=2, device_cache=True).validate_config()
+    with pytest.raises(ValueError, match="accum_steps"):
+        Config(accum_steps=0).validate_config()
+
+
 def test_feature_extract_freezes_backbone(tmp_path):
     from mpi_pytorch_tpu.train.trainer import build_training
     from mpi_pytorch_tpu.parallel.mesh import shard_batch

@@ -118,11 +118,11 @@ def main() -> None:
     args = ap.parse_args()
 
     records = []
-    for name in args.models.split(","):
-        batch, image = ZOO[name]
+    for name in (m.strip() for m in args.models.split(",") if m.strip()):
         try:
+            batch, image = ZOO[name]  # inside try: a typo'd name must not
             rec = bench_one(name, batch, image, args.steps, args.warmup)
-        except Exception as e:  # e.g. OOM at this batch on a small chip
+        except Exception as e:  # kill the sweep or discard --out
             rec = {"model": name, "error": f"{type(e).__name__}: {e}"[:300]}
         records.append(rec)
         print(json.dumps(rec), flush=True)
