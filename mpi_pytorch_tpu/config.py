@@ -102,10 +102,12 @@ class Config:
     # sharded over the data axis instead of replicated — per-device optimizer
     # memory 2×params → 2×params/n. Auto (jit) mode only.
     zero_optimizer: bool = False
-    # Rematerialization (jax.checkpoint): recompute forward activations
-    # during backward instead of storing them — HBM for FLOPs, the lever for
-    # batch sizes / image sizes that exceed activation memory.
-    remat: bool = False
+    # Rematerialization strategy: "none" | "full" | "blocks".
+    # "full" wraps the whole forward in jax.checkpoint (measured NOT to pay
+    # for these CNNs — docs/RESULTS.md §4b); "blocks" checkpoints each
+    # residual block (resnet family), recomputing one block at a time during
+    # backward — the placement that can actually cut activation memory.
+    remat: str = "none"
     # Gradient accumulation: split each batch into this many microbatches,
     # accumulate count-weighted gradients over a lax.scan, apply ONE
     # optimizer update — the same global-batch gradient at 1/accum_steps the
@@ -197,6 +199,16 @@ class Config:
                 "scan_epoch runs the epoch as one compiled scan over the "
                 "device-resident dataset; it requires device_cache=True"
             )
+        if self.remat not in ("none", "full", "blocks"):
+            raise ValueError(f"remat must be none|full|blocks, got {self.remat!r}")
+        if self.remat == "blocks":
+            from mpi_pytorch_tpu.models.registry import supports_remat_blocks
+
+            if not supports_remat_blocks(self.model_name):
+                raise ValueError(
+                    "remat='blocks' is implemented for the resnet family only; "
+                    "use remat='full' or 'none'"
+                )
         if self.accum_steps < 1:
             raise ValueError(f"accum_steps must be >= 1, got {self.accum_steps}")
         if self.accum_steps > 1 and (self.spmd_mode or self.device_cache):

@@ -60,6 +60,12 @@ def available_models() -> tuple[str, ...]:
     return tuple(_REGISTRY)
 
 
+# Architectures whose factories accept remat_blocks (per-block nn.remat).
+# THE owner of this capability check — config validation defers here.
+def supports_remat_blocks(model_name: str) -> bool:
+    return model_name in ("resnet18", "resnet34")
+
+
 def initialize_model(
     model_name: str,
     num_classes: int,
@@ -70,6 +76,7 @@ def initialize_model(
     param_dtype: Any = jnp.float32,
     bn_axis_name: str | None = None,
     pretrained_dir: str = "pretrained",
+    remat_blocks: bool = False,
 ) -> tuple[nn.Module, int]:
     """Reference-parity signature (``models.py:16``): returns (model, input_size)."""
     if model_name not in _REGISTRY:
@@ -80,6 +87,13 @@ def initialize_model(
     kw: dict[str, Any] = dict(dtype=dtype, param_dtype=param_dtype)
     if model_name not in ("alexnet", "squeezenet1_0"):  # the BN-free architectures
         kw["bn_axis_name"] = bn_axis_name
+    if remat_blocks:
+        if not supports_remat_blocks(model_name):
+            raise ValueError(
+                f"remat='blocks' is implemented for the resnet family only "
+                f"(got {model_name!r}); use remat='full' or 'none'"
+            )
+        kw["remat_blocks"] = True
     model = factory(num_classes, **kw)
     return model, input_size
 
@@ -111,11 +125,13 @@ def create_model_bundle(
     param_dtype: Any = jnp.float32,
     bn_axis_name: str | None = None,
     pretrained_dir: str = "pretrained",
+    remat_blocks: bool = False,
 ) -> tuple[ModelBundle, dict]:
     """Full-fat factory: returns the bundle plus initialized variables."""
     model, canonical = initialize_model(
         model_name, num_classes, feature_extract, use_pretrained,
         dtype=dtype, param_dtype=param_dtype, bn_axis_name=bn_axis_name,
+        remat_blocks=remat_blocks,
     )
     size = image_size or (299 if model_name == "inception_v3" else 128)
     rng = rng if rng is not None else jax.random.PRNGKey(0)

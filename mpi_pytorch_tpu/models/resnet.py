@@ -57,6 +57,12 @@ class ResNet(nn.Module):
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     bn_axis_name: str | None = None
+    # Checkpoint each BasicBlock (nn.remat): the backward pass recomputes one
+    # block at a time instead of keeping every block's activations live —
+    # the per-stage placement whole-forward jax.checkpoint can't give
+    # (docs/RESULTS.md §4b). Param tree paths are unchanged (lifted
+    # transforms preserve scopes), so checkpoints/converters are unaffected.
+    remat_blocks: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -70,10 +76,15 @@ class ResNet(nn.Module):
         x = nn.relu(x)
         x = max_pool(x, 3, 2, padding=1)
 
+        block_cls = (
+            nn.remat(BasicBlock, static_argnums=(2,))  # (self, x, train)
+            if self.remat_blocks
+            else BasicBlock
+        )
         for stage, n_blocks in enumerate(self.stage_sizes):
             for block in range(n_blocks):
                 stride = 2 if stage > 0 and block == 0 else 1
-                x = BasicBlock(
+                x = block_cls(
                     features=64 * 2**stage,
                     stride=stride,
                     dtype=self.dtype,

@@ -117,6 +117,7 @@ def build_training(cfg: Config, mesh=None):
         # (the compiler inserts the cross-device mean), so no axis is needed.
         bn_axis_name=mesh.axis_names[0] if (cfg.sync_batchnorm and cfg.spmd_mode) else None,
         pretrained_dir=cfg.pretrained_dir,
+        remat_blocks=(cfg.remat == "blocks"),
     )
     tx = make_optimizer(cfg.learning_rate, bundle.trainable_mask)
     state = TrainState.create(
@@ -382,14 +383,14 @@ def train(cfg: Config) -> TrainSummary:
         # mode reuses the Lowered (cost analysis needs no backend compile)
         # because XLA counts a scan body once regardless of trip count.
         lowered_step = jax.jit(
-            make_cached_train_step(mesh, _dtype(cfg.compute_dtype), remat=cfg.remat),
+            make_cached_train_step(mesh, _dtype(cfg.compute_dtype), remat=(cfg.remat == "full")),
             donate_argnums=(0,), out_shardings=(_state_shardings(state), None),
         ).lower(
             state, dataset, labels_all,
             np.zeros((host_batch,), np.int32), np.ones((host_batch,), bool),
         )
         if cfg.scan_epoch:
-            epoch_fn = make_scanned_epoch(mesh, _dtype(cfg.compute_dtype), remat=cfg.remat)
+            epoch_fn = make_scanned_epoch(mesh, _dtype(cfg.compute_dtype), remat=(cfg.remat == "full"))
             compiled_step = jax.jit(
                 epoch_fn, donate_argnums=(0,),
                 out_shardings=(_state_shardings(state), None),
@@ -402,10 +403,10 @@ def train(cfg: Config) -> TrainSummary:
             compiled_step = lowered_step.compile()
     else:
         step_fn = (
-            make_spmd_train_step(mesh, _dtype(cfg.compute_dtype), remat=cfg.remat)
+            make_spmd_train_step(mesh, _dtype(cfg.compute_dtype), remat=(cfg.remat == "full"))
             if cfg.spmd_mode
             else make_train_step(
-                _dtype(cfg.compute_dtype), remat=cfg.remat,
+                _dtype(cfg.compute_dtype), remat=(cfg.remat == "full"),
                 accum_steps=cfg.accum_steps, mesh=mesh,
             )
         )

@@ -241,16 +241,33 @@ def test_grad_accumulation_matches_full_batch():
     )
 
 
-def test_remat_matches_plain_step(tmp_path):
-    """jax.checkpoint only changes WHEN activations are computed, not what —
-    the loss trajectory must match the plain step."""
+@pytest.mark.parametrize("strategy", ["full", "blocks"])
+def test_remat_matches_plain_step(tmp_path, strategy):
+    """Rematerialization (whole-forward jax.checkpoint, or per-residual-block
+    nn.remat) only changes WHEN activations are computed, not what — the loss
+    trajectory must match the plain step."""
     cfg_a = _tiny_cfg(os.path.join(str(tmp_path), "a"), num_epochs=2, num_classes=200)
     sa = train(cfg_a)
     cfg_b = _tiny_cfg(
-        os.path.join(str(tmp_path), "b"), num_epochs=2, num_classes=200, remat=True
+        os.path.join(str(tmp_path), "b"), num_epochs=2, num_classes=200, remat=strategy
     )
     sb = train(cfg_b)
     np.testing.assert_allclose(sa.epoch_losses, sb.epoch_losses, rtol=1e-4)
+
+
+def test_remat_blocks_param_tree_unchanged():
+    """nn.remat must not change parameter paths — checkpoints and the
+    torchvision converter depend on them."""
+    from mpi_pytorch_tpu.models import create_model_bundle
+
+    _, plain = create_model_bundle("resnet18", 10, image_size=32)
+    _, blocks = create_model_bundle("resnet18", 10, image_size=32, remat_blocks=True)
+    assert jax.tree_util.tree_structure(plain) == jax.tree_util.tree_structure(blocks)
+
+
+def test_remat_blocks_rejects_non_resnet():
+    with pytest.raises(ValueError, match="resnet family"):
+        Config(remat="blocks", model_name="alexnet").validate_config()
 
 
 def test_accum_config_validation():

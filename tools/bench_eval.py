@@ -1,0 +1,94 @@
+"""Inference/evaluation throughput: batched sharded forward, images/sec/chip.
+
+The reference's second driver is a 4-stage MPI inference pipeline whose
+predict stage runs ONE image per forward per rank
+(``evaluation_pipeline.py:132-159``). This framework collapses it into one
+jitted batched forward over all chips (``evaluate.py``); this tool measures
+that forward with the harness shared with ``tools/bench_zoo.py`` and prints
+one JSON line per batch size.
+
+Timing note: the eval step outputs only scalars, and scalar futures can
+resolve early through the remote-PJRT relay (see bench.py). The timed loop
+therefore chains every step's metrics into one on-device accumulator and
+blocks on that — the final value depends on every step, so it cannot be
+ready before the work is.
+
+Run: ``python tools/bench_eval.py [--model resnet18] [--batches 256,1024,4096]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from bench_zoo import NUM_CLASSES, build_state_and_batch  # noqa: E402
+
+
+def bench_eval(model_name: str, batch_per_chip: int, image: int, steps: int, warmup: int):
+    from mpi_pytorch_tpu.train.step import make_eval_step
+    from mpi_pytorch_tpu.utils.hardware import peak_bf16_tflops, step_flops
+
+    mesh, state, device_batch, n_chips, batch = build_state_and_batch(
+        model_name, batch_per_chip, image
+    )
+    eval_step = make_eval_step(jnp.bfloat16)
+    compiled = eval_step.lower(state, device_batch).compile()
+    flops = step_flops(compiled)
+
+    add = jax.jit(lambda acc, m: acc + m["loss"] + m["count"])
+
+    acc = jnp.zeros((), jnp.float32)
+    for _ in range(warmup + 1):  # +1 so the accumulator add is compiled too
+        acc = add(acc, compiled(state, device_batch))
+    jax.block_until_ready(acc)
+
+    acc = jnp.zeros((), jnp.float32)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        acc = add(acc, compiled(state, device_batch))
+    jax.block_until_ready(acc)  # depends on every step above
+    dt = time.perf_counter() - t0
+
+    ips = steps * batch / dt
+    tflops_per_chip = flops * steps / dt / 1e12  # cost analysis is per-device
+    peak = peak_bf16_tflops(jax.devices()[0])
+    rec = {
+        "metric": f"{model_name} eval images/sec/chip (bf16, {NUM_CLASSES} classes, {image}px)",
+        "batch_per_chip": batch_per_chip,
+        "chips": n_chips,
+        "images_per_sec_per_chip": round(ips / n_chips, 1),
+        "step_ms": round(dt / steps * 1e3, 2),
+        "tflops_per_chip": round(tflops_per_chip, 2),
+    }
+    if peak and flops > 0:
+        rec["mfu_pct"] = round(100.0 * tflops_per_chip / peak, 1)
+    return rec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18")
+    ap.add_argument("--image", type=int, default=128)
+    ap.add_argument("--batches", default="256,1024,4096")
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=5)
+    args = ap.parse_args()
+    for b in (x.strip() for x in args.batches.split(",") if x.strip()):
+        try:
+            rec = bench_eval(args.model, int(b), args.image, args.steps, args.warmup)
+        except Exception as e:
+            rec = {"model": args.model, "batch_per_chip": b,
+                   "error": f"{type(e).__name__}: {e}"[:300]}
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

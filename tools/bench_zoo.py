@@ -48,17 +48,17 @@ ZOO = {
 }
 
 
-def bench_one(model_name: str, batch_per_chip: int, image: int, steps: int, warmup: int):
+def build_state_and_batch(model_name: str, batch_per_chip: int, image: int):
+    """Shared harness setup (also used by tools/bench_eval.py): mesh, placed
+    train state, and a random sharded device batch."""
     from mpi_pytorch_tpu.config import Config
     from mpi_pytorch_tpu.models import create_model_bundle
     from mpi_pytorch_tpu.parallel.mesh import create_mesh, shard_batch
     from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
-    from mpi_pytorch_tpu.train.step import make_train_step, place_state_on_mesh
-    from mpi_pytorch_tpu.utils.hardware import peak_bf16_tflops, step_flops
+    from mpi_pytorch_tpu.train.step import place_state_on_mesh
 
     n_chips = jax.device_count()
     batch = batch_per_chip * n_chips
-
     mesh = create_mesh(Config().mesh)
     bundle, variables = create_model_bundle(
         model_name, NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=image,
@@ -69,12 +69,23 @@ def bench_one(model_name: str, batch_per_chip: int, image: int, steps: int, warm
         tx=make_optimizer(4e-4), rng=jax.random.PRNGKey(1),
     )
     state = place_state_on_mesh(state, mesh)
-    step = make_train_step(jnp.bfloat16)
-
     rng = np.random.default_rng(0)
-    images = rng.standard_normal((batch, image, image, 3), np.float32)
-    labels = rng.integers(0, NUM_CLASSES, size=(batch,)).astype(np.int32)
-    device_batch = shard_batch((images, labels), mesh)
+    device_batch = shard_batch(
+        (rng.standard_normal((batch, image, image, 3), np.float32),
+         rng.integers(0, NUM_CLASSES, size=(batch,)).astype(np.int32)),
+        mesh,
+    )
+    return mesh, state, device_batch, n_chips, batch
+
+
+def bench_one(model_name: str, batch_per_chip: int, image: int, steps: int, warmup: int):
+    from mpi_pytorch_tpu.train.step import make_train_step
+    from mpi_pytorch_tpu.utils.hardware import peak_bf16_tflops, step_flops
+
+    mesh, state, device_batch, n_chips, batch = build_state_and_batch(
+        model_name, batch_per_chip, image
+    )
+    step = make_train_step(jnp.bfloat16)
 
     compiled = step.lower(state, device_batch).compile()
     flops_per_step = step_flops(compiled)
