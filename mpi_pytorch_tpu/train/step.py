@@ -243,6 +243,18 @@ def make_cached_train_step(mesh, compute_dtype=jnp.bfloat16, remat: bool = False
     return cached_step
 
 
+def _gather_batch(mesh, compute_dtype, dataset, labels_all, idx, valid):
+    """Index-gather a batch from the HBM-resident dataset, shard-constrained
+    onto the data axis — THE shared ingest of the cached train, scanned-epoch,
+    and cached eval steps, so none can drift from the others."""
+    images = jnp.take(dataset, idx, axis=0).astype(compute_dtype)
+    images = lax.with_sharding_constraint(
+        images, NamedSharding(mesh, P(mesh.axis_names[0]))
+    )
+    labels = jnp.where(valid, jnp.take(labels_all, idx), -1)
+    return images, labels
+
+
 def _cached_batch_step(
     mesh, compute_dtype, state, dataset, labels_all, idx, valid, remat: bool = False
 ):
@@ -250,11 +262,7 @@ def _cached_batch_step(
     cached mode and the scanned-epoch mode, so the two can never drift
     numerically (the trainer's FLOPs accounting and the scan≡cached test
     both rely on the per-step program equalling the scan body)."""
-    images = jnp.take(dataset, idx, axis=0).astype(compute_dtype)
-    images = lax.with_sharding_constraint(
-        images, NamedSharding(mesh, P(mesh.axis_names[0]))
-    )
-    labels = jnp.where(valid, jnp.take(labels_all, idx), -1)
+    images, labels = _gather_batch(mesh, compute_dtype, dataset, labels_all, idx, valid)
     rng = jax.random.fold_in(state.rng, state.step)
     loss, logits, new_bs, grads = _loss_and_updates(state, images, labels, rng, remat=remat)
     new_state = _apply_updates(state, grads, new_bs)
@@ -298,6 +306,41 @@ def make_scanned_epoch(mesh, compute_dtype=jnp.bfloat16, remat: bool = False) ->
 
 
 @functools.lru_cache(maxsize=None)
+def make_cached_eval_step(mesh, compute_dtype=jnp.bfloat16) -> Callable:
+    """Eval forward over the DEVICE-RESIDENT dataset: gather the batch by
+    index like ``make_cached_train_step``, then the ``make_eval_step`` math.
+    With ``val_on_train=True`` (the reference's default validation semantics,
+    ``main.py:104-112``) the cached train set is reused as-is, so per-epoch
+    validation costs zero host decode and zero H2D traffic."""
+
+    @jax.jit
+    def cached_eval_step(state: TrainState, dataset, labels_all, idx, valid):
+        images, labels = _gather_batch(mesh, compute_dtype, dataset, labels_all, idx, valid)
+        return _eval_metrics(state, images, labels, compute_dtype)
+
+    return cached_eval_step
+
+
+def _eval_metrics(state: TrainState, images, labels, compute_dtype):
+    """Shared eval math of the streaming and cached eval steps."""
+    valid = labels >= 0
+    safe_labels = jnp.maximum(labels, 0)
+    logits = state.apply_fn(state.variables, images.astype(compute_dtype), train=False)
+    # The barrier pins a real f32 boundary: without it XLA fuses the
+    # upcast into the softmax chain and evaluates logsumexp at bf16
+    # precision, which yields per-example CE errors of ±3e-3 — enough to
+    # report (impossible) negative eval losses on a converged model
+    # (measured: batch loss-sums off by ±0.4 vs the eager computation).
+    logits = lax.optimization_barrier(logits.astype(jnp.float32))
+    per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, safe_labels)
+    return {
+        "loss": jnp.sum(per_ex * valid),
+        "correct": jnp.sum((jnp.argmax(logits, axis=-1) == labels) & valid),
+        "count": jnp.sum(valid.astype(jnp.int32)),
+    }
+
+
+@functools.lru_cache(maxsize=None)
 def make_eval_step(compute_dtype=jnp.bfloat16) -> Callable:
     """Batched eval forward (≙ validation loop body ``main.py:173-182`` and
     the predict stage ``evaluation_pipeline.py:149-158``, batched).
@@ -310,21 +353,7 @@ def make_eval_step(compute_dtype=jnp.bfloat16) -> Callable:
         images, labels = batch
         # labels < 0 mark padding rows (tail batches padded to a static
         # shape so XLA never recompiles; see trainer.evaluate_manifest).
-        valid = labels >= 0
-        safe_labels = jnp.maximum(labels, 0)
-        logits = state.apply_fn(state.variables, images.astype(compute_dtype), train=False)
-        # The barrier pins a real f32 boundary: without it XLA fuses the
-        # upcast into the softmax chain and evaluates logsumexp at bf16
-        # precision, which yields per-example CE errors of ±3e-3 — enough to
-        # report (impossible) negative eval losses on a converged model
-        # (measured: batch loss-sums off by ±0.4 vs the eager computation).
-        logits = lax.optimization_barrier(logits.astype(jnp.float32))
-        per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, safe_labels)
-        return {
-            "loss": jnp.sum(per_ex * valid),
-            "correct": jnp.sum((jnp.argmax(logits, axis=-1) == labels) & valid),
-            "count": jnp.sum(valid.astype(jnp.int32)),
-        }
+        return _eval_metrics(state, images, labels, compute_dtype)
 
     return eval_step
 

@@ -33,6 +33,7 @@ from mpi_pytorch_tpu.models import create_model_bundle
 from mpi_pytorch_tpu.parallel.mesh import create_mesh, shard_batch
 from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
 from mpi_pytorch_tpu.train.step import (
+    make_cached_eval_step,
     make_cached_train_step,
     make_eval_step,
     make_scanned_epoch,
@@ -308,18 +309,48 @@ def evaluate_manifest(cfg: Config, state: TrainState, mesh, manifest) -> tuple[f
         native_decode=cfg.native_decode,
         decode_prescale=cfg.decode_prescale,
     )
+    n_steps = global_step_count(len(manifest), host_batch, drop_remainder=False)
+    return _accumulate_eval(
+        eval_step(state, shard_batch(pad_batch(images, labels, host_batch), mesh))
+        for images, labels in synchronized_batches(loader, 0, n_steps)
+    )
+
+
+def _accumulate_eval(metric_batches) -> tuple[float, float]:
+    """Fold per-batch eval metrics into (accuracy, mean_loss) — the one
+    accounting shared by the streaming and cached eval paths."""
     correct = total = 0
     loss_sum = 0.0
-    n_steps = global_step_count(len(manifest), host_batch, drop_remainder=False)
-    for images, labels in synchronized_batches(loader, 0, n_steps):
-        images, labels = pad_batch(images, labels, host_batch)
-        m = eval_step(state, shard_batch((images, labels), mesh))
+    for m in metric_batches:
         correct += int(m["correct"])
         total += int(m["count"])
         loss_sum += float(m["loss"])
     if total == 0:
         return 0.0, float("nan")
     return correct / total, loss_sum / total
+
+
+def evaluate_cached(cfg: Config, state: TrainState, mesh, dataset, labels) -> tuple[float, float]:
+    """Batched eval over a DEVICE-RESIDENT dataset → (accuracy, mean_loss).
+    Same semantics as ``evaluate_manifest`` but zero host decode / H2D per
+    call — per-epoch validation over an HBM-cached val set (with
+    ``val_on_train=True``, the reference's default, the val set IS the
+    already-cached train set)."""
+    eval_step = make_cached_eval_step(mesh, _dtype(cfg.compute_dtype))
+    host_batch = cfg.batch_size // jax.process_count()
+    n = int(dataset.shape[0])
+
+    def metric_batches():
+        for start in range(0, n, host_batch):
+            idx = np.arange(start, min(start + host_batch, n), dtype=np.int32)
+            valid = np.ones(len(idx), bool)
+            pad = host_batch - len(idx)
+            if pad > 0:
+                idx = np.concatenate([idx, np.zeros(pad, np.int32)])
+                valid = np.concatenate([valid, np.zeros(pad, bool)])
+            yield eval_step(state, dataset, labels, idx, valid)
+
+    return _accumulate_eval(metric_batches())
 
 
 def train(cfg: Config) -> TrainSummary:
@@ -579,7 +610,12 @@ def train(cfg: Config) -> TrainSummary:
                 # TRAIN manifest (main.py:104-112; SURVEY §3); val_on_train=False
                 # gives the honest test-split validation.
                 val_manifest = train_manifest if cfg.val_on_train else test_manifest
-                acc, vloss = evaluate_manifest(cfg, state, mesh, val_manifest)
+                if cfg.device_cache and cfg.val_on_train:
+                    # The cached train set IS the val set (main.py:104-112
+                    # semantics): validate straight out of HBM.
+                    acc, vloss = evaluate_cached(cfg, state, mesh, dataset, labels_all)
+                else:
+                    acc, vloss = evaluate_manifest(cfg, state, mesh, val_manifest)
                 summary.val_accuracy = acc
                 logger.info("Accuracy of the network: %.4f (val_on_train=%s)", acc, cfg.val_on_train)
                 metrics.write({"kind": "val", "epoch": epoch, "accuracy": acc, "loss": vloss})

@@ -265,6 +265,27 @@ def test_remat_blocks_param_tree_unchanged():
     assert jax.tree_util.tree_structure(plain) == jax.tree_util.tree_structure(blocks)
 
 
+def test_cached_eval_matches_streaming_eval(tmp_path):
+    """evaluate_cached (HBM-resident val set) must agree with
+    evaluate_manifest (streaming decode) — same masking, same accounting."""
+    from mpi_pytorch_tpu.train.trainer import (
+        build_device_cache,
+        build_training,
+        evaluate_cached,
+        evaluate_manifest,
+    )
+    from mpi_pytorch_tpu.train.step import place_state_on_mesh
+
+    cfg = _tiny_cfg(str(tmp_path), num_classes=200, debug_sample_size=96, batch_size=32)
+    mesh, bundle, state, (train_manifest, _, loader) = build_training(cfg)
+    state = place_state_on_mesh(state, mesh)
+    dataset, labels = build_device_cache(cfg, loader, mesh)
+    acc_c, loss_c = evaluate_cached(cfg, state, mesh, dataset, labels)
+    acc_s, loss_s = evaluate_manifest(cfg, state, mesh, train_manifest)
+    assert acc_c == acc_s
+    np.testing.assert_allclose(loss_c, loss_s, rtol=1e-5)
+
+
 def test_remat_blocks_rejects_non_resnet():
     with pytest.raises(ValueError, match="resnet family"):
         Config(remat="blocks", model_name="alexnet").validate_config()

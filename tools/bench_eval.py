@@ -37,7 +37,7 @@ def bench_eval(model_name: str, batch_per_chip: int, image: int, steps: int, war
     from mpi_pytorch_tpu.utils.hardware import peak_bf16_tflops, step_flops
 
     mesh, state, device_batch, n_chips, batch = build_state_and_batch(
-        model_name, batch_per_chip, image
+        model_name, batch_per_chip, image, optimizer=False
     )
     eval_step = make_eval_step(jnp.bfloat16)
     compiled = eval_step.lower(state, device_batch).compile()
@@ -61,7 +61,8 @@ def bench_eval(model_name: str, batch_per_chip: int, image: int, steps: int, war
     tflops_per_chip = flops * steps / dt / 1e12  # cost analysis is per-device
     peak = peak_bf16_tflops(jax.devices()[0])
     rec = {
-        "metric": f"{model_name} eval images/sec/chip (bf16, {NUM_CLASSES} classes, {image}px)",
+        "metric": f"eval images/sec/chip (bf16, {NUM_CLASSES} classes, {image}px)",
+        "model": model_name,
         "batch_per_chip": batch_per_chip,
         "chips": n_chips,
         "images_per_sec_per_chip": round(ips / n_chips, 1),
@@ -85,7 +86,7 @@ def main() -> None:
         try:
             rec = bench_eval(args.model, int(b), args.image, args.steps, args.warmup)
         except Exception as e:
-            rec = {"model": args.model, "batch_per_chip": b,
+            rec = {"model": args.model, "batch_per_chip": int(b),
                    "error": f"{type(e).__name__}: {e}"[:300]}
         print(json.dumps(rec), flush=True)
 

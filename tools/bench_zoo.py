@@ -48,9 +48,14 @@ ZOO = {
 }
 
 
-def build_state_and_batch(model_name: str, batch_per_chip: int, image: int):
+def build_state_and_batch(
+    model_name: str, batch_per_chip: int, image: int, optimizer: bool = True
+):
     """Shared harness setup (also used by tools/bench_eval.py): mesh, placed
-    train state, and a random sharded device batch."""
+    train state, and a random sharded device batch. ``optimizer=False`` skips
+    the Adam moment trees (~2x params of f32 HBM) for forward-only benches."""
+    import optax
+
     from mpi_pytorch_tpu.config import Config
     from mpi_pytorch_tpu.models import create_model_bundle
     from mpi_pytorch_tpu.parallel.mesh import create_mesh, shard_batch
@@ -66,7 +71,8 @@ def build_state_and_batch(model_name: str, batch_per_chip: int, image: int):
     )
     state = TrainState.create(
         apply_fn=bundle.model.apply, variables=variables,
-        tx=make_optimizer(4e-4), rng=jax.random.PRNGKey(1),
+        tx=make_optimizer(4e-4) if optimizer else optax.identity(),
+        rng=jax.random.PRNGKey(1),
     )
     state = place_state_on_mesh(state, mesh)
     rng = np.random.default_rng(0)
