@@ -105,8 +105,9 @@ class Config:
     # Rematerialization strategy: "none" | "full" | "blocks".
     # "full" wraps the whole forward in jax.checkpoint (measured NOT to pay
     # for these CNNs — docs/RESULTS.md §4b); "blocks" checkpoints each
-    # residual block (resnet family), recomputing one block at a time during
-    # backward — the placement that can actually cut activation memory.
+    # residual block / dense layer (resnet18/34, densenet121), recomputing
+    # one block at a time during backward — the placement that can actually
+    # cut activation memory.
     remat: str = "none"
     # Gradient accumulation: split each batch into this many microbatches,
     # accumulate count-weighted gradients over a lax.scan, apply ONE
@@ -202,11 +203,15 @@ class Config:
         if self.remat not in ("none", "full", "blocks"):
             raise ValueError(f"remat must be none|full|blocks, got {self.remat!r}")
         if self.remat == "blocks":
-            from mpi_pytorch_tpu.models.registry import supports_remat_blocks
+            from mpi_pytorch_tpu.models.registry import (
+                REMAT_BLOCKS_MODELS,
+                supports_remat_blocks,
+            )
 
             if not supports_remat_blocks(self.model_name):
                 raise ValueError(
-                    "remat='blocks' is implemented for the resnet family only; "
+                    f"remat='blocks' is not implemented for {self.model_name!r} "
+                    f"(supported: {', '.join(REMAT_BLOCKS_MODELS)}); "
                     "use remat='full' or 'none'"
                 )
         if self.accum_steps < 1:

@@ -73,6 +73,11 @@ class DenseNet(nn.Module):
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     bn_axis_name: str | None = None
+    # Checkpoint each DenseLayer (nn.remat): densenet is the most
+    # activation-heavy zoo member (every layer's concat input stays live for
+    # backward); per-layer recompute caps that at one layer's activations.
+    # Param tree paths are unchanged (lifted transforms preserve scopes).
+    remat_blocks: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -86,10 +91,15 @@ class DenseNet(nn.Module):
         x = nn.relu(x)
         x = max_pool(x, 3, 2, padding=1)
 
+        layer_cls = (
+            nn.remat(DenseLayer, static_argnums=(2,))  # (self, x, train)
+            if self.remat_blocks
+            else DenseLayer
+        )
         features = self.num_init_features
         for i, n_layers in enumerate(self.block_config):
             for j in range(n_layers):
-                x = DenseLayer(
+                x = layer_cls(
                     growth_rate=self.growth_rate, dtype=self.dtype,
                     param_dtype=self.param_dtype, bn_axis_name=self.bn_axis_name,
                     name=f"denseblock{i + 1}_layer{j + 1}",

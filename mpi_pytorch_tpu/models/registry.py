@@ -61,9 +61,12 @@ def available_models() -> tuple[str, ...]:
 
 
 # Architectures whose factories accept remat_blocks (per-block nn.remat).
-# THE owner of this capability check — config validation defers here.
+# THE owner of this capability — config validation and error messages defer here.
+REMAT_BLOCKS_MODELS = ("resnet18", "resnet34", "densenet121")
+
+
 def supports_remat_blocks(model_name: str) -> bool:
-    return model_name in ("resnet18", "resnet34")
+    return model_name in REMAT_BLOCKS_MODELS
 
 
 def initialize_model(
@@ -90,8 +93,9 @@ def initialize_model(
     if remat_blocks:
         if not supports_remat_blocks(model_name):
             raise ValueError(
-                f"remat='blocks' is implemented for the resnet family only "
-                f"(got {model_name!r}); use remat='full' or 'none'"
+                f"remat='blocks' is not implemented for {model_name!r} "
+                f"(supported: {', '.join(REMAT_BLOCKS_MODELS)}); "
+                "use remat='full' or 'none'"
             )
         kw["remat_blocks"] = True
     model = factory(num_classes, **kw)

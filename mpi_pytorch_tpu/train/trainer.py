@@ -205,15 +205,19 @@ def synchronized_batches(loader: DataLoader, epoch: int, n_steps: int):
             it.close()  # stops the producer thread on early exit / truncation
 
 
-def cached_index_batches(cfg: Config, n: int, host_batch: int, epoch: int, n_steps: int):
+def cached_index_batches(
+    cfg: Config, n: int, host_batch: int, epoch: int, n_steps: int,
+    shuffle: bool | None = None,
+):
     """Per-epoch (idx [B] int32, valid [B] bool) batches for the
     device-cache path. The permutation uses the same ``(seed, epoch)`` rng
     discipline as ``DataLoader.epoch``, so a cached run and a streaming run
     walk the data in the same order; tail indices repeat real rows
-    (the ``_cyclic_fill`` policy) with ``valid=False``."""
+    (the ``_cyclic_fill`` policy) with ``valid=False``. ``shuffle=False``
+    gives the ordered walk the cached eval path uses."""
     from mpi_pytorch_tpu.data.pipeline import epoch_order
 
-    order = epoch_order(cfg.seed, epoch, n, cfg.shuffle)
+    order = epoch_order(cfg.seed, epoch, n, cfg.shuffle if shuffle is None else shuffle)
     for step_i in range(n_steps):
         idx = order[step_i * host_batch : (step_i + 1) * host_batch]
         valid = np.ones(len(idx), bool)
@@ -339,18 +343,13 @@ def evaluate_cached(cfg: Config, state: TrainState, mesh, dataset, labels) -> tu
     eval_step = make_cached_eval_step(mesh, _dtype(cfg.compute_dtype))
     host_batch = cfg.batch_size // jax.process_count()
     n = int(dataset.shape[0])
-
-    def metric_batches():
-        for start in range(0, n, host_batch):
-            idx = np.arange(start, min(start + host_batch, n), dtype=np.int32)
-            valid = np.ones(len(idx), bool)
-            pad = host_batch - len(idx)
-            if pad > 0:
-                idx = np.concatenate([idx, np.zeros(pad, np.int32)])
-                valid = np.concatenate([valid, np.zeros(pad, bool)])
-            yield eval_step(state, dataset, labels, idx, valid)
-
-    return _accumulate_eval(metric_batches())
+    n_steps = -(-n // host_batch)
+    return _accumulate_eval(
+        eval_step(state, dataset, labels, idx, valid)
+        for idx, valid in cached_index_batches(
+            cfg, n, host_batch, epoch=0, n_steps=n_steps, shuffle=False
+        )
+    )
 
 
 def train(cfg: Config) -> TrainSummary:

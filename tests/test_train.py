@@ -282,13 +282,29 @@ def test_cached_eval_matches_streaming_eval(tmp_path):
     dataset, labels = build_device_cache(cfg, loader, mesh)
     acc_c, loss_c = evaluate_cached(cfg, state, mesh, dataset, labels)
     acc_s, loss_s = evaluate_manifest(cfg, state, mesh, train_manifest)
-    assert acc_c == acc_s
+    # The two paths compile different HLO; allow one argmax tie-flip of slack
+    # (the loss check concedes the same numeric divergence via rtol).
+    assert abs(acc_c - acc_s) <= 1.0 / len(train_manifest) + 1e-9
     np.testing.assert_allclose(loss_c, loss_s, rtol=1e-5)
 
 
 def test_remat_blocks_rejects_non_resnet():
-    with pytest.raises(ValueError, match="resnet family"):
+    with pytest.raises(ValueError, match="not implemented for"):
         Config(remat="blocks", model_name="alexnet").validate_config()
+
+
+def test_remat_blocks_densenet_tree_and_forward():
+    """densenet block remat: unchanged param tree, same forward output."""
+    import jax.numpy as jnp
+    from mpi_pytorch_tpu.models import create_model_bundle
+
+    b_plain, v_plain = create_model_bundle("densenet121", 10, image_size=32)
+    b_remat, v_remat = create_model_bundle("densenet121", 10, image_size=32, remat_blocks=True)
+    assert jax.tree_util.tree_structure(v_plain) == jax.tree_util.tree_structure(v_remat)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    out_plain = b_plain.model.apply(v_plain, x, train=False)
+    out_remat = b_remat.model.apply(v_plain, x, train=False)
+    np.testing.assert_allclose(np.asarray(out_plain), np.asarray(out_remat), atol=1e-5)
 
 
 def test_accum_config_validation():
