@@ -49,11 +49,13 @@ ZOO = {
 
 
 def build_state_and_batch(
-    model_name: str, batch_per_chip: int, image: int, optimizer: bool = True
+    model_name: str, batch_per_chip: int, image: int, optimizer: bool = True,
+    remat_blocks: bool = False,
 ):
-    """Shared harness setup (also used by tools/bench_eval.py): mesh, placed
-    train state, and a random sharded device batch. ``optimizer=False`` skips
-    the Adam moment trees (~2x params of f32 HBM) for forward-only benches."""
+    """Shared harness setup (also used by tools/bench_eval.py and
+    tools/profile_step.py): mesh, placed train state, and a random sharded
+    device batch. ``optimizer=False`` skips the Adam moment trees (~2x params
+    of f32 HBM) for forward-only benches."""
     import optax
 
     from mpi_pytorch_tpu.config import Config
@@ -67,7 +69,7 @@ def build_state_and_batch(
     mesh = create_mesh(Config().mesh)
     bundle, variables = create_model_bundle(
         model_name, NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=image,
-        dtype=jnp.bfloat16, param_dtype=jnp.float32,
+        dtype=jnp.bfloat16, param_dtype=jnp.float32, remat_blocks=remat_blocks,
     )
     state = TrainState.create(
         apply_fn=bundle.model.apply, variables=variables,
@@ -84,6 +86,27 @@ def build_state_and_batch(
     return mesh, state, device_batch, n_chips, batch
 
 
+def timed_train_steps(compiled, state, device_batch, steps, warmup, trace_dir=""):
+    """Warmup then time ``steps`` calls of a compiled train step, blocking on
+    the DONATED STATE, not a metrics scalar — scalar futures can resolve
+    early through the remote-PJRT relay and overstate throughput (bench.py).
+    Optionally wraps the timed steps in a jax.profiler trace."""
+    for _ in range(warmup):
+        state, _ = compiled(state, device_batch)
+    jax.block_until_ready(state.params)
+
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, _ = compiled(state, device_batch)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+    if trace_dir:
+        jax.profiler.stop_trace()
+    return dt, state
+
+
 def bench_one(model_name: str, batch_per_chip: int, image: int, steps: int, warmup: int):
     from mpi_pytorch_tpu.train.step import make_train_step
     from mpi_pytorch_tpu.utils.hardware import peak_bf16_tflops, step_flops
@@ -95,18 +118,7 @@ def bench_one(model_name: str, batch_per_chip: int, image: int, steps: int, warm
 
     compiled = step.lower(state, device_batch).compile()
     flops_per_step = step_flops(compiled)
-
-    for _ in range(warmup):
-        state, _ = compiled(state, device_batch)
-    # Block on the donated state, not a metrics scalar: scalars can resolve
-    # early through the remote-PJRT relay and overstate throughput (bench.py).
-    jax.block_until_ready(state.params)
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, _ = compiled(state, device_batch)
-    jax.block_until_ready(state.params)
-    dt = time.perf_counter() - t0
+    dt, state = timed_train_steps(compiled, state, device_batch, steps, warmup)
 
     ips = steps * batch / dt
     tflops_per_chip = flops_per_step * steps / dt / 1e12  # cost analysis is per-device

@@ -1,0 +1,89 @@
+"""One-command step profiler: XLA trace + memory/FLOPs summary for any zoo
+model's train step.
+
+SURVEY §5 tracing row: the reference's only instrumentation is MPI.Wtime
+epoch pairs (``main.py:145,158``). The trainer already embeds jax.profiler
+tracing (``--profile-dir``); this tool profiles ONE step in isolation so a
+kernel investigation doesn't need a training run:
+
+    python tools/profile_step.py --model resnet18 --batch 2048 \
+        [--trace-dir /tmp/trace] [--accum 1] [--remat none|full|blocks]
+
+Prints a JSON summary (step ms, img/s/chip, per-chip TFLOP/s, MFU, HBM
+argument/output/temp sizes from XLA's memory analysis) and, with
+--trace-dir, writes a TensorBoard-viewable XLA trace of the timed steps.
+Setup and timing discipline are shared with tools/bench_zoo.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from bench_zoo import build_state_and_batch, timed_train_steps  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18")
+    ap.add_argument("--batch", type=int, default=2048, help="per chip")
+    ap.add_argument("--image", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--accum", type=int, default=1)
+    ap.add_argument("--remat", default="none", choices=["none", "full", "blocks"])
+    ap.add_argument("--trace-dir", default="", help="write a jax.profiler trace here")
+    args = ap.parse_args()
+
+    from mpi_pytorch_tpu.models.registry import supports_remat_blocks
+    from mpi_pytorch_tpu.train.step import make_train_step
+    from mpi_pytorch_tpu.utils.hardware import peak_bf16_tflops, step_flops
+
+    if args.remat == "blocks" and not supports_remat_blocks(args.model):
+        ap.error(f"--remat blocks not implemented for {args.model}")
+
+    mesh, state, device_batch, n_chips, batch = build_state_and_batch(
+        args.model, args.batch, args.image, remat_blocks=(args.remat == "blocks")
+    )
+    step = make_train_step(
+        jnp.bfloat16, remat=(args.remat == "full"), accum_steps=args.accum, mesh=mesh
+    )
+    compiled = step.lower(state, device_batch).compile()
+    mem = compiled.memory_analysis()
+    flops = step_flops(compiled)
+
+    dt, state = timed_train_steps(
+        compiled, state, device_batch, args.steps, args.warmup, trace_dir=args.trace_dir
+    )
+
+    peak = peak_bf16_tflops(jax.devices()[0])
+    tflops_per_chip = flops * args.steps / dt / 1e12
+    summary = {
+        "model": args.model,
+        "batch_per_chip": args.batch,
+        "accum_steps": args.accum,
+        "remat": args.remat,
+        "chips": n_chips,
+        "step_ms": round(dt / args.steps * 1e3, 2),
+        "images_per_sec_per_chip": round(args.steps * batch / dt / n_chips, 1),
+        "tflops_per_chip": round(tflops_per_chip, 2),
+        "hbm_args_gb": round(getattr(mem, "argument_size_in_bytes", 0) / 1e9, 2),
+        "hbm_output_gb": round(getattr(mem, "output_size_in_bytes", 0) / 1e9, 2),
+        "hbm_temp_gb": round(getattr(mem, "temp_size_in_bytes", 0) / 1e9, 2),
+    }
+    if peak and flops > 0:
+        summary["mfu_pct"] = round(100.0 * tflops_per_chip / peak, 1)
+    if args.trace_dir:
+        summary["trace_dir"] = args.trace_dir
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()
