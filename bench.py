@@ -21,13 +21,52 @@ relay and overstate throughput by >5×.
 from __future__ import annotations
 
 import json
+import os
+import threading
 import time
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 REFERENCE_IMG_PER_SEC_PER_WORKER = 4.4  # BASELINE.md, training.log:1268-1275
+
+# TPU backend initialization (the first jax.devices() call) blocks
+# INDEFINITELY when the device relay is wedged — observed live in this
+# environment. The driver needs one JSON line either way, so a watchdog
+# turns "hang forever" into a diagnosable failure. Disarmed once the
+# backend is up; the benchmark itself is uninterrupted.
+try:
+    BACKEND_TIMEOUT_S = int(os.environ.get("MPT_BENCH_BACKEND_TIMEOUT_S", "600"))
+except ValueError:
+    BACKEND_TIMEOUT_S = 600
+if BACKEND_TIMEOUT_S <= 0:  # 0/negative would fire instantly, not disable
+    BACKEND_TIMEOUT_S = 600
+
+
+def _arm_backend_watchdog() -> threading.Event:
+    armed = threading.Event()
+
+    def fire() -> None:
+        if armed.wait(BACKEND_TIMEOUT_S):
+            return
+        print(
+            json.dumps(
+                {
+                    "metric": "resnet18 train images/sec/chip",
+                    "value": 0.0,
+                    "unit": "images/sec/chip",
+                    "vs_baseline": 0.0,
+                    "error": (
+                        f"device backend failed to initialize within "
+                        f"{BACKEND_TIMEOUT_S}s (wedged TPU relay?)"
+                    ),
+                },
+            ),
+            flush=True,
+        )
+        os._exit(3)
+
+    threading.Thread(target=fire, daemon=True).start()
+    return armed
 
 MODEL = "resnet18"
 NUM_CLASSES = 64500   # utils.py:39
@@ -40,6 +79,13 @@ WARMUP_STEPS = 5
 MEASURE_STEPS = 30
 
 def main() -> None:
+    backend_up = _arm_backend_watchdog()
+    import jax
+    import jax.numpy as jnp
+
+    jax.devices()  # force backend init under the watchdog
+    backend_up.set()
+
     from mpi_pytorch_tpu.config import Config
     from mpi_pytorch_tpu.models import create_model_bundle
     from mpi_pytorch_tpu.parallel.mesh import create_mesh, shard_batch
