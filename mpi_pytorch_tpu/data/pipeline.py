@@ -120,6 +120,7 @@ class DataLoader:
         image_dtype: str = "float32",
         native_decode: bool = True,
         decode_prescale: int = 2,
+        host_cache: bool = False,
     ):
         self.manifest = manifest
         self.batch_size = batch_size
@@ -131,6 +132,17 @@ class DataLoader:
         self.num_workers = max(1, num_workers)
         self.prefetch = max(1, prefetch)
         self.decode_prescale = decode_prescale
+        # Decode the whole shard ONCE into host RAM (first epoch), then serve
+        # every later epoch by slicing — zero decode cost after epoch 0, at
+        # the price of n_images × H × W × 3 × dtype host memory. Works
+        # per-host (multi-host safe) and for datasets bigger than HBM —
+        # the middle ground between streaming and the device cache.
+        self.host_cache = host_cache
+        self._cache_images: np.ndarray | None = None
+        self._cache_filled: np.ndarray | None = None  # [n] bool, rows decoded
+        self._cache_complete = False
+        self._fill_thread: threading.Thread | None = None  # in-flight filler
+        self._cache_fill_error: BaseException | None = None  # undelivered
         # Native C++ batched ingest (mpi_pytorch_tpu/native): one GIL-released
         # call decodes the whole batch on C threads. Auto-falls back to the
         # PIL thread pool when the toolchain/libjpeg is unavailable.
@@ -192,6 +204,40 @@ class DataLoader:
             )
         return np.stack(list(pool.map(self._load_one, idx)))
 
+    def wait_cache_complete(self) -> bool:
+        """Join any in-flight cache-filling thread (the backfill keeps
+        running after an early consumer close), then surface a decode error
+        the closed consumer never saw. True when the cache is complete."""
+        t = self._fill_thread
+        if t is not None and t.is_alive():
+            t.join()
+        if self._cache_fill_error is not None:
+            err, self._cache_fill_error = self._cache_fill_error, None
+            raise err
+        return self._cache_complete
+
+    def adopt_cache(self, other: "DataLoader") -> bool:
+        """Share ``other``'s completed host cache (by reference) when the two
+        loaders walk the same data the same way — e.g. the validation loader
+        adopting the train loader's cache under ``val_on_train`` semantics,
+        instead of decoding a second full copy of the identical shard."""
+        if (
+            other._cache_images is not None
+            and other._cache_complete
+            and len(other.manifest) == len(self.manifest)
+            and other.manifest.filenames == self.manifest.filenames
+            and other.manifest.img_dir == self.manifest.img_dir
+            and other.image_size == self.image_size
+            and other.image_dtype == self.image_dtype
+            and other.synthetic == self.synthetic
+            and other.native_decode == self.native_decode
+            and other.decode_prescale == self.decode_prescale
+        ):
+            self._cache_images = other._cache_images
+            self._cache_complete = True
+            return True
+        return False
+
     def epoch(self, epoch: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         """Iterate one epoch of batches, prefetched in the background."""
         n = len(self.manifest)
@@ -200,18 +246,62 @@ class DataLoader:
         if nb == 0:
             return iter(())
 
+        if self.host_cache:
+            # Serialize with an in-flight filling epoch: two producers over
+            # the same cache arrays would double-decode the shard (and the
+            # join is exactly the remaining decode work either way).
+            self.wait_cache_complete()
+
+        if self.host_cache and self._cache_complete:
+            # Slicing RAM is not worth a producer thread; the (seed, epoch)
+            # order is identical to the streaming walk, so trajectories match.
+            cache = self._cache_images
+            labels = self.manifest.labels
+
+            def cached_gen() -> Iterator[tuple[np.ndarray, np.ndarray]]:
+                for b in range(nb):
+                    idx = order[b * self.batch_size : (b + 1) * self.batch_size]
+                    yield cache[idx], labels[idx]
+
+            return cached_gen()
+
+        # Cache-as-you-stream: the filling epoch IS a normal streaming epoch
+        # (decode overlapped with the consumer via the producer thread), with
+        # each decoded batch additionally scattered into the cache array and
+        # marked in a filled mask. Whatever the epoch never visits — tail
+        # rows under drop_remainder, whole batches when the consumer stops
+        # early (multi-host globally-truncated step counts close the iterator
+        # after n_steps) — is backfilled at the end, in the background if the
+        # consumer is already gone, so the cache ALWAYS completes.
+        fill_cache = self.host_cache
+        if fill_cache and self._cache_images is None:
+            self._cache_images = np.empty(
+                (n, *self.image_size, 3), self.image_dtype
+            )
+            self._cache_filled = np.zeros(n, bool)
+
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
 
-        def put_or_abandon(item) -> None:
+        def put_or_abandon(item) -> bool:
             # Bounded put that gives up once the consumer is gone — never
-            # blocks forever on a full queue.
+            # blocks forever on a full queue. Returns whether it enqueued.
             while not stop.is_set():
                 try:
                     q.put(item, timeout=0.5)
-                    return
+                    return True
                 except queue.Full:
                     continue
+            return False
+
+        def decode_one_batch(idx, pool):
+            stacked = self._load_batch(idx, pool)
+            if stacked.dtype != self.image_dtype:
+                stacked = stacked.astype(self.image_dtype)
+            if fill_cache:
+                self._cache_images[idx] = stacked
+                self._cache_filled[idx] = True
+            return stacked
 
         def producer() -> None:
             error = None
@@ -219,18 +309,33 @@ class DataLoader:
                 with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
                     for b in range(nb):
                         if stop.is_set():
-                            return
+                            break  # consumer gone; still backfill the cache below
                         idx = order[b * self.batch_size : (b + 1) * self.batch_size]
-                        stacked = self._load_batch(idx, pool)
-                        if stacked.dtype != self.image_dtype:
-                            stacked = stacked.astype(self.image_dtype)
-                        put_or_abandon((stacked, self.manifest.labels[idx]))
+                        put_or_abandon(
+                            (decode_one_batch(idx, pool), self.manifest.labels[idx])
+                        )
+                    if fill_cache and not self._cache_complete:
+                        # Backfill whatever this epoch didn't decode. With a
+                        # live consumer this is at most the drop_remainder
+                        # tail (sub-batch, done before the sentinel); after an
+                        # early close it runs in the background — the stopped
+                        # consumer isn't waiting on the queue.
+                        missing = np.nonzero(~self._cache_filled)[0]
+                        for s in range(0, len(missing), self.batch_size):
+                            decode_one_batch(missing[s : s + self.batch_size], pool)
+                        self._cache_complete = True
             except BaseException as e:  # surface decode errors to the consumer
                 error = e
             finally:
-                put_or_abandon(error)  # None sentinel, or the exception to re-raise
+                # None sentinel, or the exception to re-raise. If the
+                # consumer is already gone (early close), park the error for
+                # wait_cache_complete() so a backfill failure is never silent.
+                if not put_or_abandon(error) and error is not None:
+                    self._cache_fill_error = error
 
         t = threading.Thread(target=producer, daemon=True)
+        if fill_cache:
+            self._fill_thread = t
         t.start()
 
         def gen() -> Iterator[tuple[np.ndarray, np.ndarray]]:

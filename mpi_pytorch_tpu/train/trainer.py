@@ -102,6 +102,7 @@ def build_training(cfg: Config, mesh=None):
         image_dtype=cfg.input_dtype,
         native_decode=cfg.native_decode,
         decode_prescale=cfg.decode_prescale,
+        host_cache=cfg.host_cache,
     )
 
     bundle, variables = create_model_bundle(
@@ -295,14 +296,16 @@ def build_device_cache(cfg: Config, loader: DataLoader, mesh):
     return dataset, labels
 
 
-def evaluate_manifest(cfg: Config, state: TrainState, mesh, manifest) -> tuple[float, float]:
-    """Batched sharded eval over a manifest → (accuracy, mean_loss).
-    ≙ the rank-0 validation loop (``main.py:173-185``), but using every chip."""
-    eval_step = make_eval_step(_dtype(cfg.compute_dtype))
-    host_batch = cfg.batch_size // jax.process_count()
-    loader = DataLoader(
+def make_eval_loader(cfg: Config, manifest, host_cache: bool = False) -> DataLoader:
+    """The eval/validation DataLoader over this host's shard of ``manifest``.
+    ``host_cache`` defaults OFF: a one-shot evaluation streams through the
+    data once, so pre-decoding a full shard-sized cache would cost strictly
+    more. Per-epoch validation passes ``cfg.host_cache`` and reuses ONE
+    loader across epochs (the loader owns the cache) — and under
+    ``val_on_train`` it adopts the train loader's cache outright."""
+    return DataLoader(
         manifest.shard(jax.process_count(), jax.process_index()),
-        batch_size=host_batch,
+        batch_size=cfg.batch_size // jax.process_count(),
         image_size=cfg.image_size,
         shuffle=False,
         drop_remainder=False,
@@ -312,7 +315,20 @@ def evaluate_manifest(cfg: Config, state: TrainState, mesh, manifest) -> tuple[f
         image_dtype=cfg.input_dtype,
         native_decode=cfg.native_decode,
         decode_prescale=cfg.decode_prescale,
+        host_cache=host_cache,
     )
+
+
+def evaluate_manifest(
+    cfg: Config, state: TrainState, mesh, manifest, loader: DataLoader | None = None
+) -> tuple[float, float]:
+    """Batched sharded eval over a manifest → (accuracy, mean_loss).
+    ≙ the rank-0 validation loop (``main.py:173-185``), but using every chip.
+    Pass a ``make_eval_loader`` instance to reuse its host cache across calls."""
+    eval_step = make_eval_step(_dtype(cfg.compute_dtype))
+    host_batch = cfg.batch_size // jax.process_count()
+    if loader is None:
+        loader = make_eval_loader(cfg, manifest)
     n_steps = global_step_count(len(manifest), host_batch, drop_remainder=False)
     return _accumulate_eval(
         eval_step(state, shard_batch(pad_batch(images, labels, host_batch), mesh))
@@ -398,6 +414,7 @@ def train(cfg: Config) -> TrainSummary:
     # MFU logging (SURVEY §5 — the reference has only wall-clock timers).
     n_steps = global_step_count(len(train_manifest), host_batch, cfg.drop_remainder)
     dataset = labels_all = None
+    val_loader = None  # built lazily, then reused so its host cache persists
     if cfg.device_cache:
         if jax.process_count() > 1:
             raise ValueError(
@@ -614,7 +631,25 @@ def train(cfg: Config) -> TrainSummary:
                     # semantics): validate straight out of HBM.
                     acc, vloss = evaluate_cached(cfg, state, mesh, dataset, labels_all)
                 else:
-                    acc, vloss = evaluate_manifest(cfg, state, mesh, val_manifest)
+                    if val_loader is None:
+                        val_loader = make_eval_loader(
+                            cfg, val_manifest, host_cache=cfg.host_cache
+                        )
+                    if (
+                        cfg.host_cache
+                        and cfg.val_on_train
+                        and not val_loader._cache_complete
+                    ):
+                        # Same shard, same decode params: share the train
+                        # loader's cache instead of decoding a second copy.
+                        # Join the train loader's background backfill first —
+                        # it finishes in bounded time, and adopting beats
+                        # starting a duplicate full-shard decode.
+                        loader.wait_cache_complete()
+                        val_loader.adopt_cache(loader)
+                    acc, vloss = evaluate_manifest(
+                        cfg, state, mesh, val_manifest, loader=val_loader
+                    )
                 summary.val_accuracy = acc
                 logger.info("Accuracy of the network: %.4f (val_on_train=%s)", acc, cfg.val_on_train)
                 metrics.write({"kind": "val", "epoch": epoch, "accuracy": acc, "loss": vloss})

@@ -177,3 +177,115 @@ def test_loader_bfloat16_batches():
     dl32 = DataLoader(m, batch_size=8, image_size=(16, 16), synthetic=True, shuffle=False)
     imgs32, _ = next(iter(dl32.epoch(0)))
     np.testing.assert_allclose(imgs.astype(np.float32), imgs32, atol=0.02, rtol=0.02)
+
+
+def test_host_cache_adoption():
+    """adopt_cache shares a completed cache by reference only when the two
+    loaders walk identical data; mismatches refuse."""
+    from mpi_pytorch_tpu.data.manifest import Manifest
+    from mpi_pytorch_tpu.data.pipeline import DataLoader
+
+    m = Manifest(
+        filenames=tuple(f"f{i}" for i in range(8)),
+        labels=np.arange(8, dtype=np.int32),
+        category_ids=np.arange(8),
+        img_dir="unused",
+    )
+    a = DataLoader(m, batch_size=4, image_size=(16, 16), shuffle=False,
+                   synthetic=True, host_cache=True)
+    for _ in a.epoch(0):
+        pass
+    assert a._cache_complete
+
+    b = DataLoader(m, batch_size=4, image_size=(16, 16), shuffle=False,
+                   synthetic=True, host_cache=True)
+    assert b.adopt_cache(a)
+    assert b._cache_images is a._cache_images
+
+    c = DataLoader(m, batch_size=4, image_size=(8, 8), shuffle=False,
+                   synthetic=True, host_cache=True)
+    assert not c.adopt_cache(a)  # different image size: refuse
+
+
+def test_host_cache_completes_after_early_close():
+    """The multi-host globally-truncated step count closes the epoch iterator
+    before the loader is exhausted; the cache must still complete (in the
+    background) so 'decode once' holds on the default drop_remainder path."""
+    import time
+
+    from mpi_pytorch_tpu.data.manifest import Manifest
+    from mpi_pytorch_tpu.data.pipeline import DataLoader
+
+    m = Manifest(
+        filenames=tuple(f"f{i}" for i in range(10)),
+        labels=np.arange(10, dtype=np.int32),
+        category_ids=np.arange(10),
+        img_dir="unused",
+    )
+    dl = DataLoader(m, batch_size=4, image_size=(16, 16), shuffle=False,
+                    drop_remainder=True, synthetic=True, host_cache=True)
+    it = dl.epoch(0)
+    next(it)       # consume ONE of the two full batches
+    it.close()     # early close, as synchronized_batches does after n_steps
+    deadline = time.monotonic() + 30
+    while not dl._cache_complete and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert dl._cache_complete
+    assert dl._cache_filled.all()
+    # next epoch serves from the cache (fast slice path)
+    batches = list(dl.epoch(1))
+    assert len(batches) == 2
+
+
+def test_host_cache_backfill_error_surfaces(tmp_path):
+    """A decode failure in the post-close backfill must not be silent: the
+    next epoch (or wait_cache_complete) raises it."""
+    from mpi_pytorch_tpu.data.manifest import Manifest
+    from mpi_pytorch_tpu.data.pipeline import DataLoader
+
+    img_dir = tmp_path / "img"
+    img_dir.mkdir()
+    from PIL import Image
+
+    names = []
+    for i in range(10):
+        name = f"f{i}.jpg"
+        if i < 8:  # the last two (the drop_remainder tail) stay missing
+            Image.new("RGB", (32, 32)).save(img_dir / name)
+        names.append(name)
+    m = Manifest(
+        filenames=tuple(names), labels=np.arange(10, dtype=np.int32),
+        category_ids=np.arange(10), img_dir=str(img_dir),
+    )
+    dl = DataLoader(m, batch_size=4, image_size=(16, 16), shuffle=False,
+                    drop_remainder=True, synthetic=False, host_cache=True)
+    it = dl.epoch(0)
+    next(it)
+    next(it)  # both full batches decode fine (files 0-7)
+    it.close()  # backfill of the missing tail files now fails in background
+    with pytest.raises(Exception):
+        dl.wait_cache_complete()
+    assert not dl._cache_complete
+
+
+def test_host_cache_next_epoch_waits_for_backfill():
+    """epoch(N+1) must not race the still-running backfill of epoch N: it
+    joins the filler and then serves from the completed cache."""
+    from mpi_pytorch_tpu.data.manifest import Manifest
+    from mpi_pytorch_tpu.data.pipeline import DataLoader
+
+    m = Manifest(
+        filenames=tuple(f"f{i}" for i in range(10)),
+        labels=np.arange(10, dtype=np.int32),
+        category_ids=np.arange(10),
+        img_dir="unused",
+    )
+    dl = DataLoader(m, batch_size=4, image_size=(16, 16), shuffle=False,
+                    drop_remainder=True, synthetic=True, host_cache=True)
+    it = dl.epoch(0)
+    next(it)
+    it.close()  # backfill continues in the background
+    batches = list(dl.epoch(1))  # joins the filler, then slices the cache
+    assert dl._cache_complete
+    assert dl._fill_thread is None or not dl._fill_thread.is_alive()
+    assert len(batches) == 2

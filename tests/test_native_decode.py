@@ -162,6 +162,24 @@ def test_loader_native_path_matches_pil_path(tmp_path):
         assert _pixel_diff(ni, pi) < 1.5
 
 
+def test_loader_host_cache_matches_direct_decode(tmp_path):
+    """host_cache composed with native decode: identical batches to direct
+    per-epoch decode, and repeat epochs serve from the cache byte-for-byte."""
+    m = _jpeg_manifest(tmp_path)
+    kw = dict(batch_size=4, image_size=(128, 128), shuffle=True, seed=3,
+              drop_remainder=False, native_decode=True, decode_prescale=0)
+    direct = list(DataLoader(m, **kw).epoch(1))
+    cached_loader = DataLoader(m, **kw, host_cache=True)
+    first = list(cached_loader.epoch(1))
+    again = list(cached_loader.epoch(1))
+    assert len(direct) == len(first) == len(again) == 3
+    for (di, dl), (fi, fl), (ai, al) in zip(direct, first, again):
+        np.testing.assert_array_equal(dl, fl)
+        np.testing.assert_array_equal(di, fi)
+        np.testing.assert_array_equal(fi, ai)
+        np.testing.assert_array_equal(fl, al)
+
+
 def test_env_kill_switch():
     # The switch is latched at first load(), and this process has already
     # loaded the library — exercise it in a fresh interpreter.

@@ -159,6 +159,23 @@ def test_device_cache_matches_streaming(tmp_path):
     np.testing.assert_allclose(sa.epoch_losses, sb.epoch_losses, rtol=1e-4)
 
 
+def test_host_cache_matches_streaming(tmp_path):
+    """host_cache=True (decode the shard once into host RAM, slice epochs)
+    must reproduce the streaming loss trajectory and validation accuracy —
+    same (seed, epoch) walk, same padding semantics."""
+    kw = dict(num_epochs=2, num_classes=200, debug_sample_size=128,
+              drop_remainder=False, validate=True)
+    sa = train(_tiny_cfg(os.path.join(str(tmp_path), "a"), **kw))
+    sb = train(_tiny_cfg(os.path.join(str(tmp_path), "b"), **kw, host_cache=True))
+    np.testing.assert_allclose(sa.epoch_losses, sb.epoch_losses, rtol=1e-4)
+    assert sa.val_accuracy == sb.val_accuracy
+
+
+def test_host_and_device_cache_exclusive():
+    with pytest.raises(ValueError, match="host_cache and device_cache"):
+        Config(host_cache=True, device_cache=True).validate_config()
+
+
 def test_scan_epoch_matches_per_step_cache(tmp_path):
     """scan_epoch=True (the whole epoch as ONE compiled lax.scan over the
     device cache) must reproduce the per-step cached trajectory — same
