@@ -61,6 +61,44 @@ def main() -> None:
     assert np.isfinite(loss), loss
     print(f"DIST_OK {loss:.6f}", flush=True)
 
+    # Full multi-host trainer run with host_cache on an UNEVEN shard split:
+    # debug_sample_size=29 → int(29*0.8) = 23 TRAIN images (the debug-mode
+    # 80/20 split, main.py:77-79) over 2 hosts → array_split shards of 12 and
+    # 11; with host_batch 4 and drop_remainder the global step count is
+    # (23//2)//4 = 2, so host 0's loader (12//4 = 3 batches) is closed EARLY
+    # every epoch — exercising the cache backfill thread,
+    # wait_cache_complete serialization, and the val-loader cache adoption,
+    # across real process boundaries.
+    import os
+
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.train.trainer import train
+
+    scratch = os.environ["MPT_TEST_SCRATCH"]  # per-run tmp dir from the parent
+    cfg = Config(
+        model_name="resnet18", num_classes=1000, batch_size=8, num_epochs=2,
+        debug=True, debug_sample_size=29, synthetic_data=True,
+        host_cache=True, drop_remainder=True, compute_dtype="float32",
+        width=32, height=32, validate=True, val_on_train=True,
+        checkpoint_every_epochs=0, log_every_steps=0, metrics_file="",
+        log_file=os.path.join(scratch, f"train_{jax.process_index()}.log"),
+        checkpoint_dir=os.path.join(scratch, f"ckpt_{jax.process_index()}"),
+    )
+    cfg.validate_config()
+    summary = train(cfg)
+    assert summary.epochs_run == 2, summary.epochs_run
+    # Prove the scenario is the intended one: host 0's shard (12 images)
+    # yields one more drop-remainder batch than the global step count, so
+    # its epoch iterator was closed early and the cache completed via the
+    # background backfill.
+    if jax.process_index() == 0:
+        from mpi_pytorch_tpu.train.trainer import build_training
+
+        _, _, _, (_, _, check_loader) = build_training(cfg)
+        assert len(check_loader) == 3, len(check_loader)  # > n_steps == 2
+    losses = " ".join(f"{l:.6f}" for l in summary.epoch_losses)
+    print(f"TRAIN_OK {losses} acc {summary.val_accuracy:.4f}", flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -21,7 +21,7 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_distributed_train_step():
+def test_two_process_distributed_train_step(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     port = _free_port()
     procs = []
@@ -40,6 +40,7 @@ def test_two_process_distributed_train_step():
         env["JAX_NUM_PROCESSES"] = "2"
         env["JAX_PROCESS_ID"] = str(pid)
         env["MPT_MULTIHOST"] = "1"
+        env["MPT_TEST_SCRATCH"] = str(tmp_path)
         procs.append(
             subprocess.Popen(
                 [sys.executable, os.path.join(repo, "tests", "distributed_child.py")],
@@ -68,3 +69,14 @@ def test_two_process_distributed_train_step():
     assert len(losses) == 2, outs
     # both processes saw different local data; the all-reduce made them agree
     assert losses[0] == losses[1]
+    # The full multi-host trainer run (host_cache, uneven shards, early-close
+    # backfill, cached-val adoption): both processes must complete and agree
+    # on the globally-reduced per-epoch losses and validation accuracy.
+    train_lines = [
+        line
+        for out in outs
+        for line in out.splitlines()
+        if line.startswith("TRAIN_OK")
+    ]
+    assert len(train_lines) == 2, outs
+    assert train_lines[0] == train_lines[1], train_lines
