@@ -1,0 +1,87 @@
+"""Loss-op parity with the reference's actual loss (``nn.CrossEntropyLoss``,
+``main.py:56,150``), checked against real torch on CPU, plus the padding-mask
+and inception-aux semantics the framework adds."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mpi_pytorch_tpu.ops.losses import (
+    AUX_LOSS_WEIGHT,
+    accuracy_count,
+    classification_loss,
+    cross_entropy,
+    valid_count,
+)
+
+torch = pytest.importorskip("torch")
+
+
+def _rand(b=16, c=50, seed=0):
+    rng = np.random.default_rng(seed)
+    logits = rng.normal(size=(b, c)).astype(np.float32)
+    labels = rng.integers(0, c, size=(b,)).astype(np.int32)
+    return logits, labels
+
+
+def test_cross_entropy_matches_torch():
+    logits, labels = _rand()
+    ours = float(cross_entropy(jnp.asarray(logits), jnp.asarray(labels)))
+    theirs = float(
+        torch.nn.CrossEntropyLoss()(
+            torch.from_numpy(logits), torch.from_numpy(labels.astype(np.int64))
+        )
+    )
+    np.testing.assert_allclose(ours, theirs, rtol=1e-6)
+
+
+def test_cross_entropy_big_head_matches_torch():
+    # The reference's actual head size: softmax over 64 500 logits in f32.
+    logits, labels = _rand(b=4, c=64500, seed=1)
+    ours = float(cross_entropy(jnp.asarray(logits), jnp.asarray(labels)))
+    theirs = float(
+        torch.nn.CrossEntropyLoss()(
+            torch.from_numpy(logits), torch.from_numpy(labels.astype(np.int64))
+        )
+    )
+    np.testing.assert_allclose(ours, theirs, rtol=1e-5)
+
+
+def test_padding_rows_are_masked():
+    logits, labels = _rand()
+    padded_labels = labels.copy()
+    padded_labels[10:] = -1  # padding marker
+    ours = float(cross_entropy(jnp.asarray(logits), jnp.asarray(padded_labels)))
+    # torch's own masking convention (ignore_index) must agree
+    theirs = float(
+        torch.nn.CrossEntropyLoss(ignore_index=-1)(
+            torch.from_numpy(logits), torch.from_numpy(padded_labels.astype(np.int64))
+        )
+    )
+    np.testing.assert_allclose(ours, theirs, rtol=1e-6)
+    assert int(valid_count(jnp.asarray(padded_labels))) == 10
+
+
+def test_all_padding_batch_is_zero_loss_not_nan():
+    logits, _ = _rand()
+    labels = np.full(16, -1, np.int32)
+    assert float(cross_entropy(jnp.asarray(logits), jnp.asarray(labels))) == 0.0
+    assert int(valid_count(jnp.asarray(labels))) == 0
+    assert int(accuracy_count(jnp.asarray(logits), jnp.asarray(labels))) == 0
+
+
+def test_inception_aux_weighting():
+    logits, labels = _rand(seed=2)
+    aux, _ = _rand(seed=3)
+    total = float(classification_loss((jnp.asarray(logits), jnp.asarray(aux)), jnp.asarray(labels)))
+    main = float(cross_entropy(jnp.asarray(logits), jnp.asarray(labels)))
+    auxl = float(cross_entropy(jnp.asarray(aux), jnp.asarray(labels)))
+    np.testing.assert_allclose(total, main + AUX_LOSS_WEIGHT * auxl, rtol=1e-6)
+
+
+def test_accuracy_count_matches_manual():
+    logits, labels = _rand(seed=4)
+    labels[3] = -1
+    manual = int(np.sum((np.argmax(logits, axis=-1) == labels) & (labels >= 0)))
+    assert int(accuracy_count(jnp.asarray(logits), jnp.asarray(labels))) == manual
