@@ -6,6 +6,12 @@ from mpi_pytorch_tpu.ops.losses import (
     cross_entropy,
     valid_count,
 )
+from mpi_pytorch_tpu.ops.moe import (
+    dense_moe,
+    init_moe_params,
+    moe_ffn,
+    moe_forward,
+)
 from mpi_pytorch_tpu.ops.ring_attention import (
     full_attention,
     ring_attention,
@@ -18,9 +24,13 @@ __all__ = [
     "accuracy_count",
     "classification_loss",
     "cross_entropy",
+    "dense_moe",
     "full_attention",
     "fused_head_ce",
     "head_ce_reference",
+    "init_moe_params",
+    "moe_ffn",
+    "moe_forward",
     "ring_attention",
     "ring_self_attention",
     "ulysses_attention",

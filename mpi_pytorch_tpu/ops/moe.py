@@ -1,0 +1,179 @@
+"""Mixture-of-Experts FFN with expert parallelism (EP) over a mesh axis.
+
+The reference has no MoE (its seven CNNs are dense, ``models.py:16-101``;
+SURVEY §2c lists EP as absent), but a complete TPU-native parallelism matrix
+needs the strategy: experts are sharded over an ``expert`` mesh axis and
+tokens travel to their experts over the ICI via ``lax.all_to_all`` — the
+canonical TPU MoE dataflow (dispatch → all-to-all → local expert FFNs →
+all-to-all back → combine).
+
+Routing is Mesh-TensorFlow-style static-capacity top-k:
+
+- gate logits over all ``E`` experts, softmax, top-k choice per token;
+- each expert accepts at most ``capacity`` tokens *per shard* (XLA needs
+  static shapes — overflow tokens are dropped from that expert's
+  contribution, exactly like production TPU MoEs; their combine weight is 0
+  so the token simply passes less signal through);
+- dispatch/combine are one-hot tensors ``[T, E, C]``, so dispatch is an
+  einsum (MXU work, not scatter).
+
+The auxiliary load-balance loss (Shazeer et al.: ``E · Σ_e f_e · p̄_e``)
+is returned alongside the output; add it to the task loss with a small
+coefficient to keep routing uniform.
+
+tests/test_moe.py asserts the 8-shard EP result equals a dense single-device
+evaluation of the same routing, values and gradients.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def init_moe_params(rng, d_model: int, d_hidden: int, num_experts: int) -> dict:
+    """Gate + per-expert two-layer FFN params. Expert-axis-leading leaves
+    (``w1 [E, d, h]`` etc.) so EP sharding is a leading-axis PartitionSpec."""
+    kg, k1, k2 = jax.random.split(rng, 3)
+    scale1 = (2.0 / d_model) ** 0.5
+    scale2 = (2.0 / d_hidden) ** 0.5
+    return {
+        "gate": jax.random.normal(kg, (d_model, num_experts), jnp.float32)
+        * (1.0 / d_model**0.5),
+        "w1": jax.random.normal(k1, (num_experts, d_model, d_hidden), jnp.float32)
+        * scale1,
+        "b1": jnp.zeros((num_experts, d_hidden), jnp.float32),
+        "w2": jax.random.normal(k2, (num_experts, d_hidden, d_model), jnp.float32)
+        * scale2,
+        "b2": jnp.zeros((num_experts, d_model), jnp.float32),
+    }
+
+
+def _routing(gate_logits, k: int, capacity: int):
+    """Top-k static-capacity routing → (dispatch [T,E,C], combine [T,E,C],
+    aux load-balance loss). Pure function of the gate logits; shared by the
+    EP path and the dense reference so the two can never disagree."""
+    t, e = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    # Fill per-expert capacity slots choice-by-choice: the j-th choices of
+    # all tokens are assigned after every (j-1)-th choice, tokens in order —
+    # a deterministic, priority-respecting slotting (standard MTF semantics).
+    taken = jnp.zeros((e,), jnp.int32)  # slots already used per expert
+    masked = probs
+    for _ in range(k):
+        choice = jnp.argmax(masked, axis=-1)  # [T]
+        gatew = jnp.take_along_axis(probs, choice[:, None], axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.int32)  # [T, E]
+        # Position of each token within its chosen expert's buffer.
+        pos = taken[choice] + (jnp.cumsum(onehot, axis=0) - onehot)[
+            jnp.arange(t), choice
+        ]
+        keep = pos < capacity
+        oh = (
+            jax.nn.one_hot(choice, e, dtype=jnp.float32)[:, :, None]
+            * jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity)[:, None, :]
+            * keep[:, None, None]
+        )
+        dispatch = dispatch + oh
+        combine = combine + oh * gatew[:, None, None]
+        taken = taken + jnp.sum(onehot, axis=0)
+        masked = jnp.where(jax.nn.one_hot(choice, e, dtype=bool), -jnp.inf, masked)
+
+    # Load-balance aux (Shazeer): fraction of token-routings landing on e
+    # (all k choices, normalized by k) × mean gate prob for e, summed, ×E.
+    frac = jnp.mean(dispatch.sum(-1), axis=0)  # [E] tokens-per-expert / T
+    aux = e * jnp.sum(frac / max(k, 1) * jnp.mean(probs, axis=0))
+    return dispatch, combine, aux
+
+
+def dense_moe(params: dict, x, *, k: int = 2, capacity: int | None = None):
+    """Single-device reference MoE (also the EP-free fallback): same routing,
+    experts applied by einsum over the full expert axis. Returns (y, aux)."""
+    t = x.shape[0]
+    e = params["gate"].shape[1]
+    capacity = capacity if capacity is not None else t
+    dispatch, combine, aux = _routing(x @ params["gate"], k, capacity)
+    xin = jnp.einsum("tec,td->ecd", dispatch, x)
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xin, params["w1"]) + params["b1"][:, None])
+    out = jnp.einsum("ech,ehd->ecd", h, params["w2"]) + params["b2"][:, None]
+    return jnp.einsum("ecd,tec->td", out, combine).astype(x.dtype), aux
+
+
+def moe_ffn(params: dict, x, *, axis_name: str, k: int = 2, capacity: int):
+    """Per-shard expert-parallel MoE. Must run inside an SPMD context binding
+    ``axis_name`` (size n): ``x [t_local, d]`` is the shard's tokens;
+    ``params['w1']/['b1']/['w2']/['b2']`` hold only the shard's ``E/n`` local
+    experts (leading axis sharded); ``params['gate']`` is replicated.
+
+    Dataflow per shard: route against ALL ``E`` experts → buffers
+    ``[E, C, d]`` → tiled ``all_to_all`` regroups to ``[E/n, n·C, d]`` (my
+    experts, every shard's tokens) → local expert FFNs → inverse
+    ``all_to_all`` → weighted combine. Returns ``(y [t_local, d], aux)``
+    with ``aux`` pmean'd across shards.
+    """
+    dispatch, combine, aux = _routing(x @ params["gate"], k, capacity)
+
+    xin = jnp.einsum("tec,td->ecd", dispatch, x)  # [E, C, d]
+    # → [E/n, n*C, d]: shard i keeps rows for ITS experts from every shard.
+    xin = lax.all_to_all(xin, axis_name, split_axis=0, concat_axis=1, tiled=True)
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edh->ech", xin, params["w1"]) + params["b1"][:, None]
+    )
+    out = jnp.einsum("ech,ehd->ecd", h, params["w2"]) + params["b2"][:, None]
+    # Inverse regroup: back to [E, C, d] rows for MY tokens.
+    out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0, tiled=True)
+    y = jnp.einsum("ecd,tec->td", out, combine).astype(x.dtype)
+    return y, lax.pmean(aux, axis_name)
+
+
+@functools.lru_cache(maxsize=None)
+def _moe_jit(mesh, axis, k, capacity):
+    pspec = {
+        "gate": P(),
+        "w1": P(axis),
+        "b1": P(axis),
+        "w2": P(axis),
+        "b2": P(axis),
+    }
+    fn = shard_map(
+        functools.partial(moe_ffn, axis_name=axis, k=k, capacity=capacity),
+        mesh=mesh,
+        in_specs=(pspec, P(axis)),
+        out_specs=(P(axis), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def moe_forward(
+    params: dict,
+    x,
+    mesh: Mesh,
+    *,
+    expert_axis: str | None = None,
+    k: int = 2,
+    capacity: int | None = None,
+):
+    """Driver-facing wrapper: tokens ``[T, d]`` sharded over ``expert_axis``
+    (EP=DP layout — each shard routes its own tokens), experts sharded over
+    the same axis. ``capacity`` defaults to tokens-per-shard (no drops when
+    routing is balanced within 1×). Returns ``(y [T, d], aux_loss)``."""
+    expert_axis = expert_axis or mesh.axis_names[0]
+    n = mesh.shape[expert_axis]
+    t = x.shape[0]
+    e = params["gate"].shape[1]
+    if t % n or e % n:
+        raise ValueError(
+            f"'{expert_axis}' axis size {n} must divide both "
+            f"tokens ({t}) and experts ({e})"
+        )
+    capacity = capacity if capacity is not None else t // n
+    return _moe_jit(mesh, expert_axis, k, capacity)(params, x)

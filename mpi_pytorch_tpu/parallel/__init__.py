@@ -5,11 +5,19 @@ from mpi_pytorch_tpu.parallel.mesh import (
     param_specs,
     shard_batch,
 )
+from mpi_pytorch_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_forward,
+    stack_stage_params,
+)
 
 __all__ = [
     "collectives",
     "create_mesh",
     "named_shardings",
     "param_specs",
+    "pipeline_apply",
+    "pipeline_forward",
     "shard_batch",
+    "stack_stage_params",
 ]

@@ -1,0 +1,161 @@
+"""Model-layer pipeline parallelism (PP) over a mesh axis.
+
+The reference's only "pipeline" is its 4-stage MPI *preprocessing* stream
+(``evaluation_pipeline.py:162-199``) — it never pipelines model layers
+(SURVEY §2c: "No model-layer pipelining anywhere"). This module supplies the
+missing strategy the TPU-native way, completing the framework's parallelism
+matrix (DP, TP, SP-ring, SP-Ulysses, EP, ZeRO-1, and PP here): a model too
+large for one chip is split into S equal stages laid out along a ``pipe``
+mesh axis, and microbatches stream through the stages GPipe-style, with
+``lax.ppermute`` shifting activations stage→stage+1 over the ICI while every
+stage computes on a different microbatch.
+
+Semantics and scope:
+
+- **Homogeneous stages.** The activation buffer that rides the ring must have
+  one static shape, so each stage maps activations of shape ``[mb, ...]`` to
+  the same shape — the layout of stacked transformer blocks / residual MLP
+  trunks (how production TPU pipelines are laid out). The CNN zoo's
+  down-sampling trunks are served by DP/TP instead; PP exists for the deep
+  homogeneous-trunk regime.
+- **GPipe fill-drain schedule.** ``M`` microbatches over ``S`` stages run in
+  ``M + S - 1`` ticks; the bubble fraction is ``(S-1)/(M+S-1)`` — choose
+  ``M >> S`` to amortize. All microbatch activations are live at once on each
+  stage (GPipe memory model); pass ``remat=True`` to re-derive each stage's
+  internals in the backward instead.
+- **Exact autodiff.** The whole schedule is a differentiable ``lax.scan`` over
+  ``ppermute``s; ``jax.grad`` through :func:`pipeline_forward` yields exactly
+  the gradients of the equivalent un-pipelined ``S``-deep stack (the transpose
+  of a forward shift is the reverse shift — XLA emits the backward drain
+  automatically). tests/test_pipeline.py asserts values and grads.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mpi_pytorch_tpu.parallel import collectives
+
+
+def stack_stage_params(per_stage_params: list) -> object:
+    """Stack a list of S per-stage param pytrees into one pytree whose leaves
+    carry a leading stage axis — the layout ``pipeline_forward`` shards over
+    the ``pipe`` mesh axis (stage s's slice lands on device s)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params
+    )
+
+
+def pipeline_apply(
+    stage_params,
+    x,
+    *,
+    axis_name: str,
+    stage_fn,
+    remat: bool = False,
+):
+    """Per-shard GPipe pipeline. Must run inside an SPMD context binding
+    ``axis_name``; each shard holds ONE stage's params (leading stage axis of
+    size 1, squeezed here) and the full microbatched input ``x`` of shape
+    ``[M, mb, ...]`` (only stage 0 reads it).
+
+    ``stage_fn(params, activation) -> activation`` must preserve the
+    activation shape. Returns ``[M, mb, ...]`` — the last stage's outputs,
+    broadcast to every shard (masked psum, the same trick as
+    ``collectives.broadcast_from``).
+    """
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    params_local = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    num_micro = x.shape[0]
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    # stage s+1 receives what stage s just produced; the last stage's send is
+    # dropped (no (S-1, 0) edge — outputs leave via the masked psum below).
+    perm = [(i, i + 1) for i in range(n - 1)]
+
+    def tick(carry, t):
+        buf, outs = carry
+        # At tick t, stage s processes microbatch (t - s): stage 0 reads
+        # microbatch t from x; stage s>0 reads the activation ppermute'd in
+        # from stage s-1 at the end of tick t-1 (microbatch t-1-(s-1) = t-s).
+        mb_idx = t - me
+        inp = jnp.where(me == 0, x[jnp.clip(mb_idx, 0, num_micro - 1)], buf)
+        out = fn(params_local, inp)
+        # Zero out out-of-range ticks (fill/drain bubbles) so the masked psum
+        # and the backward accumulate exactly the scheduled work.
+        valid = (mb_idx >= 0) & (mb_idx < num_micro)
+        out = jnp.where(valid, out, jnp.zeros_like(out))
+        # Only the last stage records finished microbatches; other stages
+        # (and bubble ticks) write back the slot's existing value.
+        slot = jnp.clip(mb_idx, 0, num_micro - 1)
+        prev = lax.dynamic_index_in_dim(outs, slot, 0, keepdims=False)
+        keep = (me == n - 1) & valid
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(keep, out, prev), slot, 0
+        )
+        buf = lax.ppermute(out, axis_name, perm)
+        return (buf, outs), None
+
+    buf0 = jnp.zeros_like(x[0])
+    outs0 = jnp.zeros_like(x)
+    (_, outs), _ = lax.scan(
+        tick, (buf0, outs0), jnp.arange(num_micro + n - 1)
+    )
+    # Last stage holds the real outputs; broadcast them to every shard.
+    return collectives.broadcast_from(outs, axis=axis_name, root=n - 1)
+
+
+@functools.lru_cache(maxsize=None)
+def _pp_jit(mesh, pipe_axis, stage_fn, remat):
+    fn = shard_map(
+        functools.partial(
+            pipeline_apply, axis_name=pipe_axis, stage_fn=stage_fn, remat=remat
+        ),
+        mesh=mesh,
+        in_specs=(P(pipe_axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def pipeline_forward(
+    stacked_params,
+    x,
+    mesh: Mesh,
+    *,
+    stage_fn,
+    num_microbatches: int,
+    pipe_axis: str | None = None,
+    remat: bool = False,
+):
+    """Driver-facing wrapper: run ``[B, ...]`` inputs through an S-stage
+    pipeline laid out on ``pipe_axis`` of ``mesh``.
+
+    ``stacked_params``'s leaves lead with the stage axis (see
+    :func:`stack_stage_params`); its size must equal the mesh axis size. The
+    batch is split into ``num_microbatches`` equal microbatches (B divisible
+    by it). ``stage_fn`` must be a module-level function (it keys the jit
+    cache). Returns ``[B, ...]`` outputs, differentiable w.r.t. params and x.
+    """
+    pipe_axis = pipe_axis or mesh.axis_names[0]
+    n = mesh.shape[pipe_axis]
+    lead = {p.shape[0] for p in jax.tree_util.tree_leaves(stacked_params)}
+    if lead != {n}:
+        raise ValueError(
+            f"stacked stage axis {lead} must equal mesh axis "
+            f"'{pipe_axis}' size {n}"
+        )
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} not divisible by {num_microbatches} microbatches")
+    micro = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+    out = _pp_jit(mesh, pipe_axis, stage_fn, remat)(stacked_params, micro)
+    return out.reshape(b, *out.shape[2:])
