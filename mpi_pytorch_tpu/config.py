@@ -15,7 +15,8 @@ import os
 from dataclasses import dataclass, field
 from typing import Any, Sequence
 
-# Architectures with full parity to the reference zoo (``models.py:30-95``).
+# Architectures with full parity to the reference zoo (``models.py:30-95``),
+# plus the beyond-parity vit_* family (sequence models; SP-capable encoder).
 SUPPORTED_MODELS = (
     "resnet18",
     "resnet34",
@@ -24,6 +25,8 @@ SUPPORTED_MODELS = (
     "squeezenet1_0",
     "densenet121",
     "inception_v3",
+    "vit_s16",
+    "vit_b16",
 )
 
 # ImageNet normalization constants (reference ``main.py:62-65``).
@@ -105,7 +108,8 @@ class Config:
     # Rematerialization strategy: "none" | "full" | "blocks".
     # "full" wraps the whole forward in jax.checkpoint (measured NOT to pay
     # for these CNNs — docs/RESULTS.md §4b); "blocks" checkpoints each
-    # residual block / dense layer (resnet18/34, densenet121), recomputing
+    # residual block / dense layer / encoder block (resnet18/34,
+    # densenet121, vit_s16/b16 — registry.REMAT_BLOCKS_MODELS), recomputing
     # one block at a time during backward — the placement that can actually
     # cut activation memory.
     remat: str = "none"

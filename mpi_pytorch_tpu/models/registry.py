@@ -30,10 +30,13 @@ from mpi_pytorch_tpu.models.inception import inception_v3
 from mpi_pytorch_tpu.models.resnet import resnet18, resnet34
 from mpi_pytorch_tpu.models.squeezenet import squeezenet1_0
 from mpi_pytorch_tpu.models.vgg import vgg11_bn
+from mpi_pytorch_tpu.models.vit import vit_b16, vit_s16
 
 # name → (factory, canonical input size). Input sizes mirror models.py
 # (:37,:45,:54,:63,:72,:81,:95); as in the reference they are advisory — the
 # config's resize wins (main.py:64) — except inception which truly needs 299.
+# The vit_* family is beyond reference parity (the reference has no
+# attention): its encoder can run the SP strategies inside training.
 _REGISTRY: dict[str, tuple[Callable[..., nn.Module], int]] = {
     "resnet18": (resnet18, 224),
     "resnet34": (resnet34, 128),
@@ -42,7 +45,16 @@ _REGISTRY: dict[str, tuple[Callable[..., nn.Module], int]] = {
     "squeezenet1_0": (squeezenet1_0, 224),
     "densenet121": (densenet121, 224),
     "inception_v3": (inception_v3, 299),
+    "vit_s16": (vit_s16, 224),
+    "vit_b16": (vit_b16, 224),
 }
+
+# Architectures with no BatchNorm (their factories take no bn_axis_name).
+BN_FREE_MODELS = ("alexnet", "squeezenet1_0", "vit_s16", "vit_b16")
+
+# Architectures whose factories accept sp_strategy/sp_mesh (sequence models
+# that can run the SP attention strategies inside training).
+SP_MODELS = ("vit_s16", "vit_b16")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,7 +74,7 @@ def available_models() -> tuple[str, ...]:
 
 # Architectures whose factories accept remat_blocks (per-block nn.remat).
 # THE owner of this capability — config validation and error messages defer here.
-REMAT_BLOCKS_MODELS = ("resnet18", "resnet34", "densenet121")
+REMAT_BLOCKS_MODELS = ("resnet18", "resnet34", "densenet121", "vit_s16", "vit_b16")
 
 
 def supports_remat_blocks(model_name: str) -> bool:
@@ -80,6 +92,8 @@ def initialize_model(
     bn_axis_name: str | None = None,
     pretrained_dir: str = "pretrained",
     remat_blocks: bool = False,
+    sp_strategy: str = "none",
+    sp_mesh: Any = None,
 ) -> tuple[nn.Module, int]:
     """Reference-parity signature (``models.py:16``): returns (model, input_size)."""
     if model_name not in _REGISTRY:
@@ -88,8 +102,21 @@ def initialize_model(
         )
     factory, input_size = _REGISTRY[model_name]
     kw: dict[str, Any] = dict(dtype=dtype, param_dtype=param_dtype)
-    if model_name not in ("alexnet", "squeezenet1_0"):  # the BN-free architectures
+    if model_name not in BN_FREE_MODELS:
         kw["bn_axis_name"] = bn_axis_name
+    if sp_strategy != "none":
+        if model_name not in SP_MODELS:
+            raise ValueError(
+                f"sp_strategy={sp_strategy!r} applies only to sequence models "
+                f"({', '.join(SP_MODELS)}); {model_name!r} has no sequence axis"
+            )
+        if sp_mesh is None:
+            raise ValueError(
+                f"sp_strategy={sp_strategy!r} requires sp_mesh (the mesh whose "
+                "first axis shards the sequence)"
+            )
+        kw["sp_strategy"] = sp_strategy
+        kw["sp_mesh"] = sp_mesh
     if remat_blocks:
         if not supports_remat_blocks(model_name):
             raise ValueError(
@@ -130,12 +157,14 @@ def create_model_bundle(
     bn_axis_name: str | None = None,
     pretrained_dir: str = "pretrained",
     remat_blocks: bool = False,
+    sp_strategy: str = "none",
+    sp_mesh: Any = None,
 ) -> tuple[ModelBundle, dict]:
     """Full-fat factory: returns the bundle plus initialized variables."""
     model, canonical = initialize_model(
         model_name, num_classes, feature_extract, use_pretrained,
         dtype=dtype, param_dtype=param_dtype, bn_axis_name=bn_axis_name,
-        remat_blocks=remat_blocks,
+        remat_blocks=remat_blocks, sp_strategy=sp_strategy, sp_mesh=sp_mesh,
     )
     size = image_size or (299 if model_name == "inception_v3" else 128)
     rng = rng if rng is not None else jax.random.PRNGKey(0)

@@ -1,0 +1,178 @@
+"""Vision Transformer in Flax (NHWC patches, TPU-native) — the zoo's
+sequence-model family.
+
+The reference zoo is seven CNNs (``models.py:16-101``); it has no attention
+anywhere (SURVEY §2c). This family goes beyond parity to make the
+framework's long-context machinery part of the *training path* rather than
+standalone ops: the encoder's attention dispatches, per config, to plain
+full attention, ring attention (``ops/ring_attention.py``), or Ulysses
+all-to-all (``ops/ulysses.py``) — the same exact-numerics SP strategies,
+now inside a trainable classifier that plugs into the standard
+``initialize_model``/trainer/checkpoint stack like any CNN.
+
+Architecture: patch-embed conv → learned position embeddings → pre-LN
+encoder blocks (MHA + GELU MLP, residual) → final LN → global average pool
+→ ``head`` Dense. GAP instead of a class token keeps the token count equal
+to the patch count, so the sequence axis divides evenly over an SP mesh
+axis (a class token would make S = P+1, coprime with any ring size).
+All blocks are homogeneous [B, S, hidden] → [B, S, hidden] maps — exactly
+the stage shape ``parallel/pipeline.py`` pipelines.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+from jax import nn as jnn
+
+from mpi_pytorch_tpu.models.common import Dtype
+
+
+class MultiHeadAttention(nn.Module):
+    """MHA whose core attention is pluggable: ``sp_strategy`` of ``none``
+    (single-device full attention), ``ring``, or ``ulysses`` (both SP
+    strategies shard the sequence over ``sp_mesh``'s first axis)."""
+
+    num_heads: int
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    sp_strategy: str = "none"
+    sp_mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        from mpi_pytorch_tpu.ops.ring_attention import (
+            full_attention,
+            ring_self_attention,
+        )
+        from mpi_pytorch_tpu.ops.ulysses import ulysses_self_attention
+
+        hidden = x.shape[-1]
+        if hidden % self.num_heads:
+            raise ValueError(f"hidden {hidden} not divisible by {self.num_heads} heads")
+        head_dim = hidden // self.num_heads
+        proj = lambda name: nn.DenseGeneral(
+            (self.num_heads, head_dim), dtype=self.dtype,
+            param_dtype=self.param_dtype, name=name,
+        )
+        q, k, v = proj("q")(x), proj("k")(x), proj("v")(x)
+        if self.sp_strategy == "none":
+            out = full_attention(q, k, v)
+        elif self.sp_strategy == "ring":
+            out = ring_self_attention(q, k, v, self.sp_mesh)
+        elif self.sp_strategy == "ulysses":
+            out = ulysses_self_attention(q, k, v, self.sp_mesh)
+        else:
+            raise ValueError(f"unknown sp_strategy {self.sp_strategy!r}")
+        return nn.DenseGeneral(
+            hidden, axis=(-2, -1), dtype=self.dtype,
+            param_dtype=self.param_dtype, name="out",
+        )(out)
+
+
+class EncoderBlock(nn.Module):
+    """Pre-LN transformer block: x + MHA(LN(x)); x + MLP(LN(x))."""
+
+    num_heads: int
+    mlp_dim: int
+    dropout: float = 0.0
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    sp_strategy: str = "none"
+    sp_mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        ln = lambda name: nn.LayerNorm(
+            dtype=self.dtype, param_dtype=self.param_dtype, name=name
+        )
+        y = MultiHeadAttention(
+            num_heads=self.num_heads, dtype=self.dtype,
+            param_dtype=self.param_dtype, sp_strategy=self.sp_strategy,
+            sp_mesh=self.sp_mesh, name="attn",
+        )(ln("ln1")(x))
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        x = x + y
+
+        z = ln("ln2")(x)
+        z = nn.Dense(
+            self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype,
+            name="mlp1",
+        )(z)
+        z = jnn.gelu(z)
+        z = nn.Dense(
+            x.shape[-1], dtype=self.dtype, param_dtype=self.param_dtype,
+            name="mlp2",
+        )(z)
+        z = nn.Dropout(self.dropout, deterministic=not train)(z)
+        return x + z
+
+
+class VisionTransformer(nn.Module):
+    num_classes: int
+    patch_size: int = 16
+    hidden: int = 384
+    depth: int = 12
+    num_heads: int = 6
+    mlp_dim: int = 1536
+    dropout: float = 0.0
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    # Checkpoint each encoder block (nn.remat), same lever as the resnets'
+    # remat_blocks: backward recomputes one homogeneous block at a time.
+    remat_blocks: bool = False
+    sp_strategy: str = "none"
+    sp_mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        p = self.patch_size
+        if x.shape[1] % p or x.shape[2] % p:
+            raise ValueError(f"image {x.shape[1]}x{x.shape[2]} not divisible by patch {p}")
+        x = nn.Conv(
+            self.hidden, (p, p), strides=(p, p), padding="VALID",
+            dtype=self.dtype, param_dtype=self.param_dtype, name="patch_embed",
+        )(x)
+        b, gh, gw, c = x.shape
+        x = x.reshape(b, gh * gw, c)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, gh * gw, c),
+            self.param_dtype,
+        )
+        x = x + pos.astype(x.dtype)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+
+        block_cls = (
+            nn.remat(EncoderBlock, static_argnums=(2,))  # (self, x, train)
+            if self.remat_blocks
+            else EncoderBlock
+        )
+        for i in range(self.depth):
+            x = block_cls(
+                num_heads=self.num_heads, mlp_dim=self.mlp_dim,
+                dropout=self.dropout, dtype=self.dtype,
+                param_dtype=self.param_dtype, sp_strategy=self.sp_strategy,
+                sp_mesh=self.sp_mesh, name=f"block{i}",
+            )(x, train)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln")(x)
+        x = x.mean(axis=1)  # GAP over tokens (see module docstring)
+        return nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype,
+            name="head",
+        )(x)
+
+
+def vit_s16(num_classes: int, **kw: Any) -> VisionTransformer:
+    """ViT-Small/16: 384 hidden, 12 blocks, 6 heads."""
+    return VisionTransformer(num_classes=num_classes, **kw)
+
+
+def vit_b16(num_classes: int, **kw: Any) -> VisionTransformer:
+    """ViT-Base/16: 768 hidden, 12 blocks, 12 heads."""
+    return VisionTransformer(
+        num_classes=num_classes, hidden=768, num_heads=12, mlp_dim=3072, **kw
+    )
