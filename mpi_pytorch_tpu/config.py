@@ -27,6 +27,7 @@ SUPPORTED_MODELS = (
     "inception_v3",
     "vit_s16",
     "vit_b16",
+    "vit_moe_s16",
 )
 
 # ImageNet normalization constants (reference ``main.py:62-65``).

@@ -11,11 +11,11 @@ from mpi_pytorch_tpu.models.registry import (
 from mpi_pytorch_tpu.models.resnet import ResNet, resnet18, resnet34
 from mpi_pytorch_tpu.models.squeezenet import SqueezeNet, squeezenet1_0
 from mpi_pytorch_tpu.models.vgg import VGG, vgg11_bn
-from mpi_pytorch_tpu.models.vit import VisionTransformer, vit_b16, vit_s16
+from mpi_pytorch_tpu.models.vit import VisionTransformer, vit_b16, vit_moe_s16, vit_s16
 
 __all__ = [
     "AlexNet", "DenseNet", "InceptionV3", "ModelBundle", "ResNet", "SqueezeNet", "VGG",
     "VisionTransformer", "alexnet", "available_models", "create_model_bundle",
     "densenet121", "inception_v3", "init_variables", "initialize_model", "resnet18",
-    "resnet34", "squeezenet1_0", "vgg11_bn", "vit_b16", "vit_s16",
+    "resnet34", "squeezenet1_0", "vgg11_bn", "vit_b16", "vit_moe_s16", "vit_s16",
 ]

@@ -30,7 +30,7 @@ from mpi_pytorch_tpu.models.inception import inception_v3
 from mpi_pytorch_tpu.models.resnet import resnet18, resnet34
 from mpi_pytorch_tpu.models.squeezenet import squeezenet1_0
 from mpi_pytorch_tpu.models.vgg import vgg11_bn
-from mpi_pytorch_tpu.models.vit import vit_b16, vit_s16
+from mpi_pytorch_tpu.models.vit import vit_b16, vit_moe_s16, vit_s16
 
 # name → (factory, canonical input size). Input sizes mirror models.py
 # (:37,:45,:54,:63,:72,:81,:95); as in the reference they are advisory — the
@@ -47,14 +47,19 @@ _REGISTRY: dict[str, tuple[Callable[..., nn.Module], int]] = {
     "inception_v3": (inception_v3, 299),
     "vit_s16": (vit_s16, 224),
     "vit_b16": (vit_b16, 224),
+    "vit_moe_s16": (vit_moe_s16, 224),
 }
 
 # Architectures with no BatchNorm (their factories take no bn_axis_name).
-BN_FREE_MODELS = ("alexnet", "squeezenet1_0", "vit_s16", "vit_b16")
+BN_FREE_MODELS = ("alexnet", "squeezenet1_0", "vit_s16", "vit_b16", "vit_moe_s16")
 
 # Architectures whose factories accept sp_strategy/sp_mesh (sequence models
 # that can run the SP attention strategies inside training).
-SP_MODELS = ("vit_s16", "vit_b16")
+SP_MODELS = ("vit_s16", "vit_b16", "vit_moe_s16")
+
+# Architectures with MoE MLPs (their factories accept ep_mesh for expert
+# parallelism; their train loss includes the sown load-balance aux term).
+MOE_MODELS = ("vit_moe_s16",)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -94,6 +99,7 @@ def initialize_model(
     remat_blocks: bool = False,
     sp_strategy: str = "none",
     sp_mesh: Any = None,
+    ep_mesh: Any = None,
 ) -> tuple[nn.Module, int]:
     """Reference-parity signature (``models.py:16``): returns (model, input_size)."""
     if model_name not in _REGISTRY:
@@ -117,6 +123,13 @@ def initialize_model(
             )
         kw["sp_strategy"] = sp_strategy
         kw["sp_mesh"] = sp_mesh
+    if ep_mesh is not None:
+        if model_name not in MOE_MODELS:
+            raise ValueError(
+                f"ep_mesh applies only to MoE models ({', '.join(MOE_MODELS)}); "
+                f"{model_name!r} has no experts to shard"
+            )
+        kw["ep_mesh"] = ep_mesh
     if remat_blocks:
         if not supports_remat_blocks(model_name):
             raise ValueError(
@@ -141,7 +154,11 @@ def init_variables(
     dummy = jnp.zeros((batch_size, input_size, input_size, 3), jnp.float32)
     p_rng, d_rng = jax.random.split(rng)
     init_fn = jax.jit(lambda rngs, x: model.init(rngs, x, train=True))
-    return jax.device_get(init_fn({"params": p_rng, "dropout": d_rng}, dummy))
+    variables = jax.device_get(init_fn({"params": p_rng, "dropout": d_rng}, dummy))
+    # MoE models sow their load-balance aux into a "losses" collection even
+    # at init; it is a per-apply output, not model state — drop it.
+    variables.pop("losses", None)
+    return variables
 
 
 def create_model_bundle(
@@ -159,12 +176,14 @@ def create_model_bundle(
     remat_blocks: bool = False,
     sp_strategy: str = "none",
     sp_mesh: Any = None,
+    ep_mesh: Any = None,
 ) -> tuple[ModelBundle, dict]:
     """Full-fat factory: returns the bundle plus initialized variables."""
     model, canonical = initialize_model(
         model_name, num_classes, feature_extract, use_pretrained,
         dtype=dtype, param_dtype=param_dtype, bn_axis_name=bn_axis_name,
         remat_blocks=remat_blocks, sp_strategy=sp_strategy, sp_mesh=sp_mesh,
+        ep_mesh=ep_mesh,
     )
     size = image_size or (299 if model_name == "inception_v3" else 128)
     rng = rng if rng is not None else jax.random.PRNGKey(0)

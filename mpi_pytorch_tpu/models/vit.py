@@ -72,8 +72,64 @@ class MultiHeadAttention(nn.Module):
         )(out)
 
 
+class MoEMlp(nn.Module):
+    """MoE replacement for the encoder MLP: top-k routed expert FFNs over
+    the tokens of the whole batch ([B, S, d] flattened to [B·S, d]).
+
+    With ``ep_mesh`` set, experts are sharded over the mesh's first axis and
+    tokens travel by ``all_to_all`` (``ops/moe.py`` expert parallelism);
+    without it, the dense single-device evaluation of the same routing runs.
+    The load-balance aux loss is sown into the ``losses`` collection, which
+    the train step sums into the total loss (``train/step.py``)."""
+
+    num_experts: int
+    mlp_dim: int
+    k: int = 2
+    capacity: int | None = None
+    aux_weight: float = 0.01
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    ep_mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        from mpi_pytorch_tpu.ops.moe import dense_moe, moe_forward
+
+        b, s, d = x.shape
+        e, h = self.num_experts, self.mlp_dim
+        init = nn.initializers.normal
+        params = {
+            "gate": self.param("gate", init(d**-0.5), (d, e), self.param_dtype),
+            "w1": self.param("w1", init((2.0 / d) ** 0.5), (e, d, h), self.param_dtype),
+            "b1": self.param("b1", nn.initializers.zeros, (e, h), self.param_dtype),
+            "w2": self.param("w2", init((2.0 / h) ** 0.5), (e, h, d), self.param_dtype),
+            "b2": self.param("b2", nn.initializers.zeros, (e, d), self.param_dtype),
+        }
+        params = {k_: v.astype(self.dtype) for k_, v in params.items()}
+        tokens = x.reshape(b * s, d)
+        # Default capacity: 2x the perfectly-balanced per-expert load (the
+        # standard capacity_factor=2 headroom). The op-level defaults
+        # (capacity = all tokens) are exact but size the [T, E, C] dispatch
+        # tensor quadratically in T — unusable at training batch sizes.
+        if self.ep_mesh is not None:
+            n = self.ep_mesh.shape[self.ep_mesh.axis_names[0]]
+            cap = self.capacity or max(1, (2 * self.k * (b * s // n)) // e)
+            y, aux = moe_forward(
+                params, tokens, self.ep_mesh, k=self.k, capacity=cap
+            )
+        else:
+            cap = self.capacity or max(1, (2 * self.k * b * s) // e)
+            y, aux = dense_moe(params, tokens, k=self.k, capacity=cap)
+        self.sow(
+            "losses", "moe_aux", self.aux_weight * aux,
+            reduce_fn=lambda a, b_: a + b_, init_fn=lambda: jnp.zeros((), jnp.float32),
+        )
+        return y.reshape(b, s, d)
+
+
 class EncoderBlock(nn.Module):
-    """Pre-LN transformer block: x + MHA(LN(x)); x + MLP(LN(x))."""
+    """Pre-LN transformer block: x + MHA(LN(x)); x + MLP(LN(x)). The MLP is
+    dense by default, or an expert-parallel MoE when ``num_experts > 0``."""
 
     num_heads: int
     mlp_dim: int
@@ -82,6 +138,10 @@ class EncoderBlock(nn.Module):
     param_dtype: Dtype = jnp.float32
     sp_strategy: str = "none"
     sp_mesh: Any = None
+    num_experts: int = 0
+    moe_k: int = 2
+    moe_capacity: int | None = None
+    ep_mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
@@ -97,15 +157,23 @@ class EncoderBlock(nn.Module):
         x = x + y
 
         z = ln("ln2")(x)
-        z = nn.Dense(
-            self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype,
-            name="mlp1",
-        )(z)
-        z = jnn.gelu(z)
-        z = nn.Dense(
-            x.shape[-1], dtype=self.dtype, param_dtype=self.param_dtype,
-            name="mlp2",
-        )(z)
+        if self.num_experts > 0:
+            z = MoEMlp(
+                num_experts=self.num_experts, mlp_dim=self.mlp_dim,
+                k=self.moe_k, capacity=self.moe_capacity,
+                dtype=self.dtype, param_dtype=self.param_dtype,
+                ep_mesh=self.ep_mesh, name="moe",
+            )(z)
+        else:
+            z = nn.Dense(
+                self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype,
+                name="mlp1",
+            )(z)
+            z = jnn.gelu(z)
+            z = nn.Dense(
+                x.shape[-1], dtype=self.dtype, param_dtype=self.param_dtype,
+                name="mlp2",
+            )(z)
         z = nn.Dropout(self.dropout, deterministic=not train)(z)
         return x + z
 
@@ -125,6 +193,14 @@ class VisionTransformer(nn.Module):
     remat_blocks: bool = False
     sp_strategy: str = "none"
     sp_mesh: Any = None
+    # MoE: every `moe_every`-th block (0-indexed blocks moe_every-1,
+    # 2·moe_every-1, ...; =2 → the odd blocks) swaps its dense MLP for a
+    # `num_experts`-expert MoE. 0 disables.
+    moe_every: int = 0
+    num_experts: int = 8
+    moe_k: int = 2
+    moe_capacity: int | None = None
+    ep_mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -152,11 +228,15 @@ class VisionTransformer(nn.Module):
             else EncoderBlock
         )
         for i in range(self.depth):
+            is_moe = self.moe_every > 0 and i % self.moe_every == self.moe_every - 1
             x = block_cls(
                 num_heads=self.num_heads, mlp_dim=self.mlp_dim,
                 dropout=self.dropout, dtype=self.dtype,
                 param_dtype=self.param_dtype, sp_strategy=self.sp_strategy,
-                sp_mesh=self.sp_mesh, name=f"block{i}",
+                sp_mesh=self.sp_mesh,
+                num_experts=self.num_experts if is_moe else 0,
+                moe_k=self.moe_k, moe_capacity=self.moe_capacity,
+                ep_mesh=self.ep_mesh, name=f"block{i}",
             )(x, train)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln")(x)
         x = x.mean(axis=1)  # GAP over tokens (see module docstring)
@@ -176,3 +256,11 @@ def vit_b16(num_classes: int, **kw: Any) -> VisionTransformer:
     return VisionTransformer(
         num_classes=num_classes, hidden=768, num_heads=12, mlp_dim=3072, **kw
     )
+
+
+def vit_moe_s16(num_classes: int, **kw: Any) -> VisionTransformer:
+    """ViT-Small/16 with 8-expert top-2 MoE MLPs in every other block —
+    the EP training-path model (dense routing until ``ep_mesh`` is set)."""
+    kw.setdefault("moe_every", 2)
+    kw.setdefault("num_experts", 8)
+    return VisionTransformer(num_classes=num_classes, **kw)

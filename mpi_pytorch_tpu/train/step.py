@@ -49,20 +49,18 @@ def _loss_and_updates(state: TrainState, images, labels, rng, remat: bool = Fals
 
     def loss_fn(params):
         variables = {"params": params}
-        # NB: mutable=[] would still make flax return an (out, {}) tuple;
-        # mutable=False is the "plain output" mode for BN-free models.
-        mutable: Any = False
+        # "losses" collects model-internal auxiliary losses (MoE load-balance
+        # terms, models/vit.py MoEMlp.sow); empty for every other model.
+        mutable = ["losses"]
         if state.batch_stats is not None:
             variables["batch_stats"] = state.batch_stats
-            mutable = ["batch_stats"]
-        out = state.apply_fn(
+            mutable.append("batch_stats")
+        out, updated = state.apply_fn(
             variables, images, train=True, rngs={"dropout": rng}, mutable=mutable
         )
-        new_bs = None
-        if mutable:
-            out, updated = out
-            new_bs = updated["batch_stats"]
-        loss = classification_loss(out, labels)
+        new_bs = updated["batch_stats"] if state.batch_stats is not None else None
+        aux = sum(jnp.sum(v) for v in jax.tree_util.tree_leaves(updated.get("losses", {})))
+        loss = classification_loss(out, labels) + aux
         logits = out[0] if isinstance(out, tuple) else out
         return loss, (new_bs, logits)
 

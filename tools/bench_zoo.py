@@ -48,6 +48,7 @@ ZOO = {
     # vit at 128px/patch16 = 64 tokens; large batches keep the MXU fed.
     "vit_s16": (2048, 128),
     "vit_b16": (1024, 128),
+    "vit_moe_s16": (1024, 128),
 }
 
 
