@@ -76,16 +76,22 @@ class MoEMlp(nn.Module):
     """MoE replacement for the encoder MLP: top-k routed expert FFNs over
     the tokens of the whole batch ([B, S, d] flattened to [B·S, d]).
 
-    With ``ep_mesh`` set, experts are sharded over the mesh's first axis and
-    tokens travel by ``all_to_all`` (``ops/moe.py`` expert parallelism);
-    without it, the dense single-device evaluation of the same routing runs.
-    The load-balance aux loss is sown into the ``losses`` collection, which
-    the train step sums into the total loss (``train/step.py``)."""
+    Routing is group-wise (``group_size`` tokens per group, ``capacity``
+    slots per expert PER GROUP — see ``ops/moe.py`` ``_grouped_routing`` for
+    why that is the scalable dispatch). With ``ep_mesh`` set, experts are
+    sharded over the mesh's first axis and tokens travel by ``all_to_all``;
+    without it, the dense evaluation of the same grouped routing runs. The
+    group clamps to the per-shard token count under EP, so the two layouts
+    compute the same function whenever ``group_size`` ≤ tokens/shard (and
+    the no-drop tests assert it). The load-balance aux loss is sown into the
+    ``losses`` collection, which the train step sums into the total loss
+    (``train/step.py``)."""
 
     num_experts: int
     mlp_dim: int
     k: int = 2
-    capacity: int | None = None
+    capacity: int | None = None  # per routing group; None → 2x balanced load
+    group_size: int = 64  # tokens per routing group (see ops/moe.py grouping)
     aux_weight: float = 0.01
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
@@ -107,19 +113,33 @@ class MoEMlp(nn.Module):
         }
         params = {k_: v.astype(self.dtype) for k_, v in params.items()}
         tokens = x.reshape(b * s, d)
-        # Default capacity: 2x the perfectly-balanced per-expert load (the
-        # standard capacity_factor=2 headroom). The op-level defaults
-        # (capacity = all tokens) are exact but size the [T, E, C] dispatch
-        # tensor quadratically in T — unusable at training batch sizes.
+        # Tokens route in fixed-size groups (ops/moe.py _grouped_routing):
+        # the [G, g, E, C] dispatch stays linear in token count. Default
+        # capacity: 2x the perfectly-balanced per-group load (the standard
+        # capacity_factor=2 headroom); overflow tokens in a group are
+        # dropped from that expert (combine weight 0) like production MoEs.
         if self.ep_mesh is not None:
             n = self.ep_mesh.shape[self.ep_mesh.axis_names[0]]
-            cap = self.capacity or max(1, (2 * self.k * (b * s // n)) // e)
+            g = min(self.group_size, b * s // n)
+            cap = (
+                self.capacity
+                if self.capacity is not None
+                else max(1, (2 * self.k * g) // e)
+            )
             y, aux = moe_forward(
-                params, tokens, self.ep_mesh, k=self.k, capacity=cap
+                params, tokens, self.ep_mesh, k=self.k, capacity=cap,
+                group_size=g,
             )
         else:
-            cap = self.capacity or max(1, (2 * self.k * b * s) // e)
-            y, aux = dense_moe(params, tokens, k=self.k, capacity=cap)
+            g = min(self.group_size, b * s)
+            cap = (
+                self.capacity
+                if self.capacity is not None
+                else max(1, (2 * self.k * g) // e)
+            )
+            y, aux = dense_moe(
+                params, tokens, k=self.k, capacity=cap, group_size=g
+            )
         self.sow(
             "losses", "moe_aux", self.aux_weight * aux,
             reduce_fn=lambda a, b_: a + b_, init_fn=lambda: jnp.zeros((), jnp.float32),
@@ -141,6 +161,7 @@ class EncoderBlock(nn.Module):
     num_experts: int = 0
     moe_k: int = 2
     moe_capacity: int | None = None
+    moe_group_size: int = 64
     ep_mesh: Any = None
 
     @nn.compact
@@ -161,6 +182,7 @@ class EncoderBlock(nn.Module):
             z = MoEMlp(
                 num_experts=self.num_experts, mlp_dim=self.mlp_dim,
                 k=self.moe_k, capacity=self.moe_capacity,
+                group_size=self.moe_group_size,
                 dtype=self.dtype, param_dtype=self.param_dtype,
                 ep_mesh=self.ep_mesh, name="moe",
             )(z)
@@ -200,6 +222,7 @@ class VisionTransformer(nn.Module):
     num_experts: int = 8
     moe_k: int = 2
     moe_capacity: int | None = None
+    moe_group_size: int = 64
     ep_mesh: Any = None
 
     @nn.compact
@@ -236,6 +259,7 @@ class VisionTransformer(nn.Module):
                 sp_mesh=self.sp_mesh,
                 num_experts=self.num_experts if is_moe else 0,
                 moe_k=self.moe_k, moe_capacity=self.moe_capacity,
+                moe_group_size=self.moe_group_size,
                 ep_mesh=self.ep_mesh, name=f"block{i}",
             )(x, train)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln")(x)

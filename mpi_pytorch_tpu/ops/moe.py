@@ -94,48 +94,99 @@ def _routing(gate_logits, k: int, capacity: int):
     return dispatch, combine, aux
 
 
-def dense_moe(params: dict, x, *, k: int = 2, capacity: int | None = None):
+def _grouped_routing(gate_logits, k: int, capacity: int, group_size: int):
+    """Group-wise routing: tokens are routed in independent groups of
+    ``group_size``, each with its own ``capacity`` slots per expert. This is
+    what makes the one-hot dispatch scale: per-group dispatch is [g, E, C]
+    with C ∝ g, so the total [G, g, E, C] tensor is LINEAR in token count
+    (ungrouped [T, E, C] with C ∝ T is quadratic — unusable at training
+    batch sizes). Returns dispatch/combine [G, g, E, C] and the aux loss
+    averaged over groups."""
+    t = gate_logits.shape[0]
+    if t % group_size:
+        raise ValueError(f"tokens {t} not divisible by group_size {group_size}")
+    grouped = gate_logits.reshape(t // group_size, group_size, -1)
+    dispatch, combine, aux = jax.vmap(
+        lambda gl: _routing(gl, k, capacity)
+    )(grouped)
+    return dispatch, combine, jnp.mean(aux)
+
+
+def dense_moe(
+    params: dict,
+    x,
+    *,
+    k: int = 2,
+    capacity: int | None = None,
+    group_size: int | None = None,
+):
     """Single-device reference MoE (also the EP-free fallback): same routing,
-    experts applied by einsum over the full expert axis. Returns (y, aux)."""
-    t = x.shape[0]
-    e = params["gate"].shape[1]
-    capacity = capacity if capacity is not None else t
-    dispatch, combine, aux = _routing(x @ params["gate"], k, capacity)
-    xin = jnp.einsum("tec,td->ecd", dispatch, x)
-    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xin, params["w1"]) + params["b1"][:, None])
-    out = jnp.einsum("ech,ehd->ecd", h, params["w2"]) + params["b2"][:, None]
-    return jnp.einsum("ecd,tec->td", out, combine).astype(x.dtype), aux
+    experts applied by einsum over the full expert axis. Returns (y, aux).
+
+    ``group_size`` routes tokens in independent fixed-size groups; capacity
+    is then PER GROUP. Defaults: one group of all tokens, capacity =
+    group size (no drops). See ``_grouped_routing`` for why grouping is the
+    scalable form."""
+    t, d = x.shape
+    g = group_size if group_size is not None else t
+    capacity = capacity if capacity is not None else g
+    dispatch, combine, aux = _grouped_routing(x @ params["gate"], k, capacity, g)
+    xg = x.reshape(t // g, g, d)
+    xin = jnp.einsum("gtec,gtd->gecd", dispatch, xg)
+    h = jax.nn.gelu(
+        jnp.einsum("gecd,edh->gech", xin, params["w1"]) + params["b1"][None, :, None]
+    )
+    out = jnp.einsum("gech,ehd->gecd", h, params["w2"]) + params["b2"][None, :, None]
+    y = jnp.einsum("gecd,gtec->gtd", out, combine)
+    return y.reshape(t, d).astype(x.dtype), aux
 
 
-def moe_ffn(params: dict, x, *, axis_name: str, k: int = 2, capacity: int):
+def moe_ffn(
+    params: dict,
+    x,
+    *,
+    axis_name: str,
+    k: int = 2,
+    capacity: int,
+    group_size: int | None = None,
+):
     """Per-shard expert-parallel MoE. Must run inside an SPMD context binding
     ``axis_name`` (size n): ``x [t_local, d]`` is the shard's tokens;
     ``params['w1']/['b1']/['w2']/['b2']`` hold only the shard's ``E/n`` local
     experts (leading axis sharded); ``params['gate']`` is replicated.
 
-    Dataflow per shard: route against ALL ``E`` experts → buffers
-    ``[E, C, d]`` → tiled ``all_to_all`` regroups to ``[E/n, n·C, d]`` (my
-    experts, every shard's tokens) → local expert FFNs → inverse
-    ``all_to_all`` → weighted combine. Returns ``(y [t_local, d], aux)``
-    with ``aux`` pmean'd across shards.
+    Dataflow per shard: route against ALL ``E`` experts (group-wise, capacity
+    per group — see ``_grouped_routing``) → buffers ``[E, G·C, d]`` → tiled
+    ``all_to_all`` regroups to ``[E/n, n·G·C, d]`` (my experts, every shard's
+    slots) → local expert FFNs → inverse ``all_to_all`` → weighted combine.
+    Returns ``(y [t_local, d], aux)`` with ``aux`` pmean'd across shards.
     """
-    dispatch, combine, aux = _routing(x @ params["gate"], k, capacity)
+    t, d = x.shape
+    e = params["gate"].shape[1]
+    g = group_size if group_size is not None else t
+    dispatch, combine, aux = _grouped_routing(x @ params["gate"], k, capacity, g)
 
-    xin = jnp.einsum("tec,td->ecd", dispatch, x)  # [E, C, d]
-    # → [E/n, n*C, d]: shard i keeps rows for ITS experts from every shard.
+    xg = x.reshape(t // g, g, d)
+    xin = jnp.einsum("gtec,gtd->gecd", dispatch, xg)  # [G, E, C, d]
+    # Fold groups into the slot axis so the all_to_all sees one [E, G*C, d]
+    # buffer (expert compute is position-agnostic along slots).
+    n_groups, _, cap = xin.shape[0], xin.shape[1], xin.shape[2]
+    xin = xin.transpose(1, 0, 2, 3).reshape(e, n_groups * cap, d)
+    # → [E/n, n*G*C, d]: shard i keeps rows for ITS experts from every shard.
     xin = lax.all_to_all(xin, axis_name, split_axis=0, concat_axis=1, tiled=True)
     h = jax.nn.gelu(
         jnp.einsum("ecd,edh->ech", xin, params["w1"]) + params["b1"][:, None]
     )
     out = jnp.einsum("ech,ehd->ecd", h, params["w2"]) + params["b2"][:, None]
-    # Inverse regroup: back to [E, C, d] rows for MY tokens.
+    # Inverse regroup: back to [E, G*C, d] rows for MY tokens.
     out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0, tiled=True)
-    y = jnp.einsum("ecd,tec->td", out, combine).astype(x.dtype)
+    out = out.reshape(e, n_groups, cap, d).transpose(1, 0, 2, 3)
+    y = jnp.einsum("gecd,gtec->gtd", out, combine).reshape(t, d).astype(x.dtype)
     return y, lax.pmean(aux, axis_name)
 
 
 @functools.lru_cache(maxsize=None)
-def _moe_jit(mesh, axis, k, capacity):
+def _moe_jit(mesh, axis, k, capacity, group_size):
     pspec = {
         "gate": P(),
         "w1": P(axis),
@@ -144,7 +195,9 @@ def _moe_jit(mesh, axis, k, capacity):
         "b2": P(axis),
     }
     fn = shard_map(
-        functools.partial(moe_ffn, axis_name=axis, k=k, capacity=capacity),
+        functools.partial(
+            moe_ffn, axis_name=axis, k=k, capacity=capacity, group_size=group_size
+        ),
         mesh=mesh,
         in_specs=(pspec, P(axis)),
         out_specs=(P(axis), P()),
@@ -161,11 +214,14 @@ def moe_forward(
     expert_axis: str | None = None,
     k: int = 2,
     capacity: int | None = None,
+    group_size: int | None = None,
 ):
     """Driver-facing wrapper: tokens ``[T, d]`` sharded over ``expert_axis``
     (EP=DP layout — each shard routes its own tokens), experts sharded over
-    the same axis. ``capacity`` defaults to tokens-per-shard (no drops when
-    routing is balanced within 1×). Returns ``(y [T, d], aux_loss)``."""
+    the same axis. ``group_size`` (clamped to the per-shard token count)
+    routes in independent groups; ``capacity`` is PER GROUP and defaults to
+    the group size (no drops when routing is balanced within 1×). Returns
+    ``(y [T, d], aux_loss)``."""
     expert_axis = expert_axis or mesh.axis_names[0]
     n = mesh.shape[expert_axis]
     t = x.shape[0]
@@ -175,5 +231,7 @@ def moe_forward(
             f"'{expert_axis}' axis size {n} must divide both "
             f"tokens ({t}) and experts ({e})"
         )
-    capacity = capacity if capacity is not None else t // n
-    return _moe_jit(mesh, expert_axis, k, capacity)(params, x)
+    t_local = t // n
+    g = min(group_size, t_local) if group_size is not None else t_local
+    capacity = capacity if capacity is not None else g
+    return _moe_jit(mesh, expert_axis, k, capacity, g)(params, x)
