@@ -120,6 +120,15 @@ class Config:
     # activation memory. (BN stats update per microbatch.) Streaming auto
     # mode only.
     accum_steps: int = 1
+    # Sequence parallelism inside the vit_* family's encoder attention:
+    # "none" | "ring" | "ulysses". Builds a ("seq", "_") mesh over all
+    # devices and shards every attention call's sequence axis over it
+    # (ops/ring_attention.py, ops/ulysses.py). vit models only.
+    sp_strategy: str = "none"
+    # Expert parallelism for MoE models (vit_moe_s16): shard the experts
+    # over all devices on an ("expert", "_") mesh; tokens travel by
+    # all_to_all (ops/moe.py). MoE models only.
+    expert_parallel: bool = False
 
     # --- input pipeline ---
     shuffle: bool = True
@@ -217,6 +226,10 @@ class Config:
             )
         if self.remat not in ("none", "full", "blocks"):
             raise ValueError(f"remat must be none|full|blocks, got {self.remat!r}")
+        if self.sp_strategy not in ("none", "ring", "ulysses"):
+            raise ValueError(
+                f"sp_strategy must be none|ring|ulysses, got {self.sp_strategy!r}"
+            )
         if self.remat == "blocks":
             from mpi_pytorch_tpu.models.registry import (
                 REMAT_BLOCKS_MODELS,

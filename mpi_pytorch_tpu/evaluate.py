@@ -36,7 +36,7 @@ from mpi_pytorch_tpu import checkpoint as ckpt
 from mpi_pytorch_tpu.config import Config, parse_config
 from mpi_pytorch_tpu.data import load_manifests
 from mpi_pytorch_tpu.models import create_model_bundle
-from mpi_pytorch_tpu.parallel.mesh import create_mesh
+from mpi_pytorch_tpu.parallel.mesh import create_mesh, flat_mesh
 from mpi_pytorch_tpu.train.state import TrainState
 from mpi_pytorch_tpu.train.trainer import evaluate_manifest
 from mpi_pytorch_tpu.utils.logging import MetricsWriter, init_logger
@@ -67,6 +67,9 @@ def build_inference(cfg: Config, mesh=None):
         dtype=compute_dtype,
         param_dtype=jnp.float32,
         pretrained_dir=cfg.pretrained_dir,
+        sp_strategy=cfg.sp_strategy,
+        sp_mesh=flat_mesh(mesh, "seq") if cfg.sp_strategy != "none" else None,
+        ep_mesh=flat_mesh(mesh, "expert") if cfg.expert_parallel else None,
     )
     state = TrainState.create(
         apply_fn=bundle.model.apply,

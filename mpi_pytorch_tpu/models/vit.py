@@ -99,7 +99,7 @@ class MoEMlp(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        from mpi_pytorch_tpu.ops.moe import dense_moe, moe_forward
+        from mpi_pytorch_tpu.ops.moe import dense_moe, moe_forward, pick_group_size
 
         b, s, d = x.shape
         e, h = self.num_experts, self.mlp_dim
@@ -114,29 +114,29 @@ class MoEMlp(nn.Module):
         params = {k_: v.astype(self.dtype) for k_, v in params.items()}
         tokens = x.reshape(b * s, d)
         # Tokens route in fixed-size groups (ops/moe.py _grouped_routing):
-        # the [G, g, E, C] dispatch stays linear in token count. Default
-        # capacity: 2x the perfectly-balanced per-group load (the standard
-        # capacity_factor=2 headroom); overflow tokens in a group are
-        # dropped from that expert (combine weight 0) like production MoEs.
+        # the [G, g, E, C] dispatch stays linear in token count. The group
+        # is the largest divisor of the (per-shard) token count that fits
+        # group_size; default capacity is 2x the perfectly-balanced
+        # per-group load (the standard capacity_factor=2 headroom) —
+        # overflow tokens in a group are dropped from that expert (combine
+        # weight 0) like production MoEs.
+        n = (
+            self.ep_mesh.shape[self.ep_mesh.axis_names[0]]
+            if self.ep_mesh is not None
+            else 1
+        )
+        g = pick_group_size(b * s // n, self.group_size)
+        cap = (
+            self.capacity
+            if self.capacity is not None
+            else max(1, (2 * self.k * g) // e)
+        )
         if self.ep_mesh is not None:
-            n = self.ep_mesh.shape[self.ep_mesh.axis_names[0]]
-            g = min(self.group_size, b * s // n)
-            cap = (
-                self.capacity
-                if self.capacity is not None
-                else max(1, (2 * self.k * g) // e)
-            )
             y, aux = moe_forward(
                 params, tokens, self.ep_mesh, k=self.k, capacity=cap,
                 group_size=g,
             )
         else:
-            g = min(self.group_size, b * s)
-            cap = (
-                self.capacity
-                if self.capacity is not None
-                else max(1, (2 * self.k * g) // e)
-            )
             y, aux = dense_moe(
                 params, tokens, k=self.k, capacity=cap, group_size=g
             )

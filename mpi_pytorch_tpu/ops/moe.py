@@ -94,6 +94,19 @@ def _routing(gate_logits, k: int, capacity: int):
     return dispatch, combine, aux
 
 
+def pick_group_size(tokens: int, group_size: int | None) -> int:
+    """Largest divisor of ``tokens`` that is <= ``group_size`` (all-tokens
+    when None). Grouped routing needs the token count to split into equal
+    groups; blind clamping to min(group_size, tokens) crashes on token
+    counts that are not multiples of the requested group."""
+    if group_size is None or group_size >= tokens:
+        return tokens
+    g = max(1, group_size)
+    while tokens % g:
+        g -= 1
+    return g
+
+
 def _grouped_routing(gate_logits, k: int, capacity: int, group_size: int):
     """Group-wise routing: tokens are routed in independent groups of
     ``group_size``, each with its own ``capacity`` slots per expert. This is
@@ -231,7 +244,6 @@ def moe_forward(
             f"'{expert_axis}' axis size {n} must divide both "
             f"tokens ({t}) and experts ({e})"
         )
-    t_local = t // n
-    g = min(group_size, t_local) if group_size is not None else t_local
+    g = pick_group_size(t // n, group_size)
     capacity = capacity if capacity is not None else g
     return _moe_jit(mesh, expert_axis, k, capacity, g)(params, x)

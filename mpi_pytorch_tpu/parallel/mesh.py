@@ -34,6 +34,14 @@ def create_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
     return Mesh(arr, (cfg.data_axis, cfg.model_axis))
 
 
+def flat_mesh(mesh: Mesh, axis: str) -> Mesh:
+    """A one-axis mesh over the SAME devices as ``mesh``, for the in-model
+    SP/EP wrappers (they shard sequence/experts over their own axis name
+    while the surrounding step stays batch-sharded over ``data``)."""
+    devices = mesh.devices.reshape(-1)
+    return Mesh(np.asarray(devices).reshape(len(devices), 1), (axis, "_"))
+
+
 def is_head_kernel(path_keys: tuple) -> tuple[bool, bool]:
     """(is_head_param, is_kernel) for a param path. Head layers are named
     ``head``/``aux_head`` across the whole zoo (models/common.py)."""

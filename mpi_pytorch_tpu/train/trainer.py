@@ -30,7 +30,7 @@ from mpi_pytorch_tpu import checkpoint as ckpt
 from mpi_pytorch_tpu.config import Config
 from mpi_pytorch_tpu.data import DataLoader, load_manifests
 from mpi_pytorch_tpu.models import create_model_bundle
-from mpi_pytorch_tpu.parallel.mesh import create_mesh, shard_batch
+from mpi_pytorch_tpu.parallel.mesh import create_mesh, flat_mesh, shard_batch
 from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
 from mpi_pytorch_tpu.train.step import (
     make_cached_eval_step,
@@ -120,6 +120,9 @@ def build_training(cfg: Config, mesh=None):
         bn_axis_name=mesh.axis_names[0] if (cfg.sync_batchnorm and cfg.spmd_mode) else None,
         pretrained_dir=cfg.pretrained_dir,
         remat_blocks=(cfg.remat == "blocks"),
+        sp_strategy=cfg.sp_strategy,
+        sp_mesh=flat_mesh(mesh, "seq") if cfg.sp_strategy != "none" else None,
+        ep_mesh=flat_mesh(mesh, "expert") if cfg.expert_parallel else None,
     )
     tx = make_optimizer(cfg.learning_rate, bundle.trainable_mask)
     state = TrainState.create(

@@ -132,6 +132,18 @@ def test_moe_grouped_ep_matches_grouped_dense(mesh, params):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
 
 
+def test_pick_group_size_always_divides():
+    from mpi_pytorch_tpu.ops.moe import pick_group_size
+
+    assert pick_group_size(64, None) == 64
+    assert pick_group_size(64, 64) == 64
+    assert pick_group_size(200, 64) == 50  # largest divisor <= 64
+    assert pick_group_size(1936, 64) == 44
+    assert pick_group_size(7, 4) == 1  # prime: one token per group
+    for t, g in [(200, 64), (1936, 64), (7, 4), (30, 8)]:
+        assert t % pick_group_size(t, g) == 0
+
+
 def test_moe_rejects_indivisible(mesh, params):
     with pytest.raises(ValueError, match="divide"):
         moe_forward(params, _x()[:63], mesh, expert_axis="expert")

@@ -125,6 +125,40 @@ def test_vit_trains_through_standard_step():
     assert losses[-1] < losses[0]
 
 
+def test_trainer_config_wires_sp_and_ep(tmp_path):
+    """--sp-strategy / --expert-parallel reach the model through
+    build_training: the bundle's model carries the strategy and a
+    seq/expert mesh over the training mesh's devices (numerics of those
+    paths are covered by the module-level SP/EP equality tests)."""
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.train.trainer import build_training
+
+    cfg = Config(
+        model_name="vit_s16", num_classes=1000, batch_size=8,
+        width=64, height=64, synthetic_data=True, sp_strategy="ring",
+        checkpoint_dir=str(tmp_path), validate=False,
+    )
+    _, bundle, _, _ = build_training(cfg)
+    assert bundle.model.sp_strategy == "ring"
+    assert bundle.model.sp_mesh.axis_names[0] == "seq"
+
+    cfg2 = Config(
+        model_name="vit_moe_s16", num_classes=1000, batch_size=8,
+        width=64, height=64, synthetic_data=True, expert_parallel=True,
+        checkpoint_dir=str(tmp_path), validate=False,
+    )
+    _, bundle2, _, _ = build_training(cfg2)
+    assert bundle2.model.ep_mesh.axis_names[0] == "expert"
+    assert bundle2.model.moe_every == 2
+
+
+def test_config_rejects_bad_sp_strategy():
+    from mpi_pytorch_tpu.config import Config
+
+    with pytest.raises(ValueError, match="sp_strategy"):
+        Config(sp_strategy="rings").validate_config()
+
+
 def test_registry_rejects_sp_on_cnn():
     with pytest.raises(ValueError, match="vit"):
         initialize_model("resnet18", 10, sp_strategy="ring")

@@ -113,6 +113,24 @@ def test_moe_vit_trains_through_standard_step():
     assert losses[-1] < losses[0]
 
 
+def test_moe_vit_handles_awkward_token_counts():
+    """Token counts that are not multiples of the default routing group
+    (e.g. 20px/patch4 → 25 tokens/image, batch 8 → 200 tokens) pick the
+    largest dividing group instead of crashing."""
+    model = VisionTransformer(
+        num_classes=10, patch_size=4, hidden=64, depth=2, num_heads=4,
+        mlp_dim=128, moe_every=2, num_experts=8,
+    )
+    x = jnp.asarray(
+        np.random.default_rng(5).standard_normal((8, 20, 20, 3)), jnp.float32
+    )
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    variables.pop("losses", None)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (8, 10)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
 def test_registry_rejects_ep_on_dense_model(ep_mesh):
     with pytest.raises(ValueError, match="MoE"):
         initialize_model("vit_s16", 10, ep_mesh=ep_mesh)
