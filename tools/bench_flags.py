@@ -1,0 +1,107 @@
+"""XLA-flag sweep for the headline benchmark (MFU lever hunting).
+
+Runs ``bench.py`` in a fresh child interpreter per flag set (XLA latches
+``XLA_FLAGS`` at backend init, so flags can't be changed in-process), parses
+each run's one-line JSON, and prints a ranked table. The flag sets below are
+the standard TPU levers worth checking for a conv workload; add more on the
+command line:
+
+    python tools/bench_flags.py                       # sweep the builtin sets
+    python tools/bench_flags.py --flags "--xla_tpu_scoped_vmem_limit_kib=65536"
+
+Each child inherits ``MPT_BENCH_BACKEND_TIMEOUT_S`` (default 600), so a
+wedged device relay produces an error row rather than a hang.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (label, extra XLA flags). Baseline first; each candidate is one lever.
+SWEEP: list[tuple[str, str]] = [
+    ("baseline", ""),
+    # Latency-hiding scheduler: overlaps async copies/collectives with
+    # compute; mostly a multi-chip lever but can reorder HBM prefetches.
+    ("latency-hiding", "--xla_tpu_enable_latency_hiding_scheduler=true"),
+    # More VMEM for fusion scratch: lets XLA form larger fusions before
+    # spilling to HBM (default is model-dependent).
+    ("vmem-64M", "--xla_tpu_scoped_vmem_limit_kib=65536"),
+    # Aggressive while-loop/all-reduce fusion knobs.
+    ("fusion-aggr", "--xla_tpu_enable_aggressive_loop_fusion=true"),
+]
+
+
+def run_one(label: str, extra_flags: str) -> dict:
+    env = dict(os.environ)
+    base = env.get("XLA_FLAGS", "")
+    env["XLA_FLAGS"] = f"{base} {extra_flags}".strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=1800,
+        )
+    except subprocess.TimeoutExpired:
+        # One wedged flag set must not discard the completed results.
+        return {
+            "value": 0.0, "error": "child exceeded 1800s (hung past backend init)",
+            "label": label, "flags": extra_flags,
+        }
+    line = ""
+    for out_line in (proc.stdout or "").splitlines()[::-1]:
+        if out_line.startswith("{"):
+            line = out_line
+            break
+    try:
+        rec = json.loads(line)
+    except (json.JSONDecodeError, ValueError):
+        stderr_tail = (proc.stderr or "").strip().splitlines()[-3:]
+        rec = {
+            "value": 0.0,
+            "error": f"no JSON (rc={proc.returncode}): " + " | ".join(stderr_tail),
+        }
+    rec["label"] = label
+    rec["flags"] = extra_flags
+    return rec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--flags", action="append", default=[],
+        help="extra flag set to sweep (repeatable); label = the flags string",
+    )
+    ap.add_argument(
+        "--sets", default=None,
+        help="comma-separated subset of builtin set labels to run",
+    )
+    args = ap.parse_args()
+    # --sets filters only the BUILTIN sets; explicit --flags always run.
+    sweep = SWEEP
+    if args.sets is not None:
+        wanted = set(args.sets.split(","))
+        sweep = [s for s in sweep if s[0] in wanted]
+    sweep = sweep + [(f, f) for f in args.flags]
+
+    results = []
+    for label, flags in sweep:
+        print(f"== {label}: {flags or '(none)'}", file=sys.stderr, flush=True)
+        results.append(run_one(label, flags))
+        r = results[-1]
+        print(
+            f"   -> {r.get('value', 0.0):.0f} img/s  mfu={r.get('mfu_pct', '?')}%"
+            + (f"  ERROR: {r['error']}" if "error" in r else ""),
+            file=sys.stderr, flush=True,
+        )
+
+    results.sort(key=lambda r: -float(r.get("value", 0.0)))
+    print(json.dumps(results, indent=2))
+
+
+if __name__ == "__main__":
+    main()
