@@ -194,17 +194,20 @@ def test_spmd_zoo_model_matches_manual_mpi_step():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
-def test_tp_head_step_runs_and_matches_dp():
+@pytest.mark.parametrize("model", ["resnet18", "vit_s16"])
+def test_tp_head_step_runs_and_matches_dp(model):
     """dp=4 × tp=2: same loss/params as pure DP (TP must be numerically
-    transparent)."""
-    bundle, state, batch = _setup(sgd=True)
+    transparent). Covers a CNN head and the ViT family's Dense head — the
+    path-based head sharding rule (parallel/mesh.py param_specs) matches
+    both by the shared 'head' naming."""
+    bundle, state, batch = _setup(model, sgd=True)
     mesh_dp = create_mesh(MeshConfig())
     step = make_train_step(compute_dtype=jnp.float32)
     s_dp, m_dp = step(
         place_state_on_mesh(state, mesh_dp), shard_batch(batch, mesh_dp)
     )
 
-    bundle2, state2, _ = _setup(sgd=True)
+    bundle2, state2, _ = _setup(model, sgd=True)
     mesh_tp = create_mesh(MeshConfig(model_parallel=2))
     step2 = make_train_step(compute_dtype=jnp.float32)
     s_tp, m_tp = step2(

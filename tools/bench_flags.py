@@ -85,6 +85,13 @@ def main() -> None:
     sweep = SWEEP
     if args.sets is not None:
         wanted = set(args.sets.split(","))
+        known = {s[0] for s in SWEEP}
+        unknown = wanted - known
+        if unknown:
+            ap.error(
+                f"unknown --sets label(s) {sorted(unknown)}; "
+                f"builtin sets: {sorted(known)}"
+            )
         sweep = [s for s in sweep if s[0] in wanted]
     sweep = sweep + [(f, f) for f in args.flags]
 
