@@ -90,6 +90,14 @@ class Config:
     batch_size: int = 128  # GLOBAL batch size (split across data-parallel devices)
     learning_rate: float = 4e-4
     num_epochs: int = 10
+    # Beyond reference parity (it hard-codes Adam at a fixed rate,
+    # main.py:125): optimizer adam|sgd|adamw, schedule constant|cosine|
+    # warmup_cosine (cosine decays to 0 over the run's total step count,
+    # computed by the trainer).
+    optimizer: str = "adam"
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
+    weight_decay: float = 0.0
 
     # --- precision / TPU ---
     compute_dtype: str = "bfloat16"  # MXU-native; params stay float32
@@ -230,6 +238,27 @@ class Config:
             raise ValueError(
                 f"sp_strategy must be none|ring|ulysses, got {self.sp_strategy!r}"
             )
+        if self.optimizer not in ("adam", "sgd", "adamw"):
+            raise ValueError(f"optimizer must be adam|sgd|adamw, got {self.optimizer!r}")
+        if self.lr_schedule not in ("constant", "cosine", "warmup_cosine"):
+            raise ValueError(
+                "lr_schedule must be constant|cosine|warmup_cosine, "
+                f"got {self.lr_schedule!r}"
+            )
+        # Reject silently-ignored combinations: training quietly without the
+        # decay/warmup the user asked for is worse than an error.
+        if self.weight_decay != 0.0 and self.optimizer != "adamw":
+            raise ValueError(
+                f"weight_decay={self.weight_decay} only applies to "
+                f"optimizer='adamw' (got {self.optimizer!r})"
+            )
+        if self.warmup_steps != 0 and self.lr_schedule != "warmup_cosine":
+            raise ValueError(
+                f"warmup_steps={self.warmup_steps} only applies to "
+                f"lr_schedule='warmup_cosine' (got {self.lr_schedule!r})"
+            )
+        if self.warmup_steps < 0:
+            raise ValueError(f"warmup_steps must be >= 0, got {self.warmup_steps}")
         if self.remat == "blocks":
             from mpi_pytorch_tpu.models.registry import (
                 REMAT_BLOCKS_MODELS,

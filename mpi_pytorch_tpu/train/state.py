@@ -48,12 +48,63 @@ class TrainState(struct.PyTreeNode):
 
 
 def make_optimizer(
-    learning_rate: float, trainable_mask: Any | None = None
+    learning_rate: float,
+    trainable_mask: Any | None = None,
+    *,
+    optimizer: str = "adam",
+    lr_schedule: str = "constant",
+    warmup_steps: int = 0,
+    total_steps: int | None = None,
+    weight_decay: float = 0.0,
 ) -> optax.GradientTransformation:
-    """Adam(lr) (≙ ``main.py:125``). With ``feature_extract``, non-head params
-    get zero updates — the optax expression of ``requires_grad=False``
-    (reference ``models.py:5-13``)."""
-    tx = optax.adam(learning_rate)
+    """Optimizer factory. Defaults reproduce the reference exactly:
+    Adam(lr) with a constant rate (≙ ``main.py:125``). Beyond parity:
+
+    - ``optimizer``: ``adam`` | ``sgd`` (momentum 0.9) | ``adamw``
+      (decoupled ``weight_decay``);
+    - ``lr_schedule``: ``constant`` | ``cosine`` (decay to 0 over
+      ``total_steps``) | ``warmup_cosine`` (linear warmup over
+      ``warmup_steps`` then cosine) — schedules are optax schedule
+      functions, evaluated inside the jitted step from the optimizer
+      state's own step counter;
+    - ``feature_extract``: with ``trainable_mask``, non-head params get
+      zero updates — the optax expression of ``requires_grad=False``
+      (reference ``models.py:5-13``).
+    """
+    if lr_schedule == "constant":
+        lr: Any = learning_rate
+    elif lr_schedule in ("cosine", "warmup_cosine"):
+        if not total_steps or total_steps <= 0:
+            raise ValueError(f"lr_schedule={lr_schedule!r} requires total_steps > 0")
+        warmup = warmup_steps if lr_schedule == "warmup_cosine" else 0
+        if warmup < 0:
+            raise ValueError(f"warmup_steps must be >= 0, got {warmup}")
+        if warmup >= total_steps:
+            raise ValueError(
+                f"warmup_steps ({warmup}) must be < the run's total step "
+                f"count ({total_steps}); shorten the warmup or train longer"
+            )
+        if warmup > 0:
+            lr = optax.warmup_cosine_decay_schedule(
+                init_value=0.0, peak_value=learning_rate,
+                warmup_steps=warmup, decay_steps=total_steps,
+            )
+        else:
+            lr = optax.cosine_decay_schedule(learning_rate, decay_steps=total_steps)
+    else:
+        raise ValueError(
+            f"lr_schedule must be constant|cosine|warmup_cosine, got {lr_schedule!r}"
+        )
+
+    if optimizer == "adam":
+        tx = optax.adam(lr)
+    elif optimizer == "sgd":
+        tx = optax.sgd(lr, momentum=0.9)
+    elif optimizer == "adamw":
+        tx = optax.adamw(lr, weight_decay=weight_decay)
+    else:
+        raise ValueError(f"optimizer must be adam|sgd|adamw, got {optimizer!r}")
+
     if trainable_mask is None:
         return tx
     labels = jax.tree_util.tree_map(lambda t: "train" if t else "freeze", trainable_mask)

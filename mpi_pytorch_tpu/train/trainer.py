@@ -124,7 +124,21 @@ def build_training(cfg: Config, mesh=None):
         sp_mesh=flat_mesh(mesh, "seq") if cfg.sp_strategy != "none" else None,
         ep_mesh=flat_mesh(mesh, "expert") if cfg.expert_parallel else None,
     )
-    tx = make_optimizer(cfg.learning_rate, bundle.trainable_mask)
+    # Total optimizer steps for cosine-style schedules: the globally-computed
+    # per-epoch step count (identical on every host) x epochs.
+    total_steps = (
+        global_step_count(len(train_manifest), host_batch, cfg.drop_remainder)
+        * cfg.num_epochs
+    )
+    tx = make_optimizer(
+        cfg.learning_rate,
+        bundle.trainable_mask,
+        optimizer=cfg.optimizer,
+        lr_schedule=cfg.lr_schedule,
+        warmup_steps=cfg.warmup_steps,
+        total_steps=total_steps,
+        weight_decay=cfg.weight_decay,
+    )
     state = TrainState.create(
         apply_fn=bundle.model.apply,
         variables=variables,

@@ -351,3 +351,71 @@ def test_feature_extract_freezes_backbone(tmp_path):
     # backbone unchanged, head moved
     np.testing.assert_array_equal(before["conv1"]["kernel"], after["conv1"]["kernel"])
     assert not np.array_equal(before["head"]["kernel"], after["head"]["kernel"])
+
+
+def test_make_optimizer_variants_and_schedules():
+    """adam|sgd|adamw x constant|cosine|warmup_cosine: each produces finite
+    updates, cosine's update magnitude shrinks toward the end of the run,
+    and bad names raise."""
+    import jax.numpy as jnp
+
+    from mpi_pytorch_tpu.train.state import make_optimizer
+
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+
+    for opt in ("adam", "sgd", "adamw"):
+        tx = make_optimizer(1e-2, optimizer=opt)
+        st = tx.init(params)
+        upd, _ = tx.update(grads, st, params)
+        assert all(
+            np.all(np.isfinite(np.asarray(u))) for u in jax.tree_util.tree_leaves(upd)
+        )
+
+    # Cosine: step-100 update is much smaller than step-0 update (lr -> 0).
+    tx = make_optimizer(1e-2, optimizer="sgd", lr_schedule="cosine", total_steps=100)
+    st = tx.init(params)
+    upd0, st = tx.update(grads, st, params)
+    for _ in range(98):
+        _, st = tx.update(grads, st, params)
+    upd_last, _ = tx.update(grads, st, params)
+    assert abs(float(upd_last["w"][0, 0])) < 0.05 * abs(float(upd0["w"][0, 0]))
+
+    # Warmup: the first update is (near) zero, the peak is reached later.
+    tx = make_optimizer(
+        1e-2, optimizer="sgd", lr_schedule="warmup_cosine",
+        warmup_steps=10, total_steps=100,
+    )
+    st = tx.init(params)
+    upd0, _ = tx.update(grads, st, params)
+    assert abs(float(upd0["w"][0, 0])) < 1e-4
+
+    with pytest.raises(ValueError, match="total_steps"):
+        make_optimizer(1e-2, lr_schedule="cosine")
+    with pytest.raises(ValueError, match="optimizer"):
+        make_optimizer(1e-2, optimizer="rmsprop")
+    with pytest.raises(ValueError, match="lr_schedule"):
+        make_optimizer(1e-2, lr_schedule="linear")
+
+
+def test_config_rejects_bad_optimizer_fields():
+    from mpi_pytorch_tpu.config import Config
+
+    with pytest.raises(ValueError, match="optimizer"):
+        Config(optimizer="rmsprop").validate_config()
+    with pytest.raises(ValueError, match="lr_schedule"):
+        Config(lr_schedule="linear").validate_config()
+
+
+def test_config_rejects_ignored_optimizer_combos():
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.train.state import make_optimizer
+
+    with pytest.raises(ValueError, match="weight_decay"):
+        Config(weight_decay=0.01).validate_config()  # adam ignores it
+    with pytest.raises(ValueError, match="warmup_steps"):
+        Config(warmup_steps=10, lr_schedule="cosine").validate_config()
+    with pytest.raises(ValueError, match="must be <"):
+        make_optimizer(
+            1e-2, lr_schedule="warmup_cosine", warmup_steps=200, total_steps=100
+        )
