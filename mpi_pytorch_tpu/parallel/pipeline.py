@@ -113,14 +113,19 @@ def pipeline_apply(
 
 
 @functools.lru_cache(maxsize=None)
-def _pp_jit(mesh, pipe_axis, stage_fn, remat):
+def _pp_jit(mesh, pipe_axis, data_axis, stage_fn, remat):
+    # With a data axis, each microbatch's row dim is sharded over it: the
+    # pipeline runs once per data column (pure batch parallelism inside each
+    # stage), and shard_map's transpose inserts the gradient psum over
+    # ``data`` for the pipe-sharded params — PP×DP from the same schedule.
+    x_spec = P(None, data_axis) if data_axis else P()
     fn = shard_map(
         functools.partial(
             pipeline_apply, axis_name=pipe_axis, stage_fn=stage_fn, remat=remat
         ),
         mesh=mesh,
-        in_specs=(P(pipe_axis), P()),
-        out_specs=P(),
+        in_specs=(P(pipe_axis), x_spec),
+        out_specs=x_spec,
         check_vma=False,
     )
     return jax.jit(fn)
@@ -134,6 +139,7 @@ def pipeline_forward(
     stage_fn,
     num_microbatches: int,
     pipe_axis: str | None = None,
+    data_axis: str | None = None,
     remat: bool = False,
 ):
     """Driver-facing wrapper: run ``[B, ...]`` inputs through an S-stage
@@ -143,7 +149,10 @@ def pipeline_forward(
     :func:`stack_stage_params`); its size must equal the mesh axis size. The
     batch is split into ``num_microbatches`` equal microbatches (B divisible
     by it). ``stage_fn`` must be a module-level function (it keys the jit
-    cache). Returns ``[B, ...]`` outputs, differentiable w.r.t. params and x.
+    cache). ``data_axis`` composes PP with DP: microbatch rows are sharded
+    over that mesh axis (each pipe×data device computes its stage on its
+    batch slice; the axis size must divide the microbatch row count).
+    Returns ``[B, ...]`` outputs, differentiable w.r.t. params and x.
     """
     pipe_axis = pipe_axis or mesh.axis_names[0]
     n = mesh.shape[pipe_axis]
@@ -156,6 +165,23 @@ def pipeline_forward(
     b = x.shape[0]
     if b % num_microbatches:
         raise ValueError(f"batch {b} not divisible by {num_microbatches} microbatches")
-    micro = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
-    out = _pp_jit(mesh, pipe_axis, stage_fn, remat)(stacked_params, micro)
+    mb = b // num_microbatches
+    if data_axis is not None:
+        if data_axis == pipe_axis:
+            raise ValueError(
+                f"data_axis and pipe_axis must differ (both {pipe_axis!r}): "
+                "sharding microbatch rows over the stage axis silently "
+                "pipelines only one row slice"
+            )
+        if data_axis not in mesh.shape:
+            raise ValueError(
+                f"data_axis {data_axis!r} not in mesh axes {tuple(mesh.shape)}"
+            )
+        if mb % mesh.shape[data_axis]:
+            raise ValueError(
+                f"data axis '{data_axis}' size {mesh.shape[data_axis]} must "
+                f"divide the microbatch row count {mb}"
+            )
+    micro = x.reshape(num_microbatches, mb, *x.shape[1:])
+    out = _pp_jit(mesh, pipe_axis, data_axis, stage_fn, remat)(stacked_params, micro)
     return out.reshape(b, *out.shape[2:])
