@@ -113,6 +113,24 @@ def test_moe_vit_trains_through_standard_step():
     assert losses[-1] < losses[0]
 
 
+def test_moe_vit_composes_sp_and_ep(tiny_moe_vit, ep_mesh):
+    """SP attention and EP experts in the SAME blocks: ring-sharded
+    attention + all_to_all-sharded experts compute the same function as the
+    plain dense model (both are execution layouts over one set of params).
+    Requires heads and sequence divisible by the shard count: TINY has 4
+    heads, so ring (no head constraint) is the strategy under test."""
+    model, variables, x = tiny_moe_vit
+    sp_mesh = Mesh(
+        np.asarray(jax.devices()[:8]).reshape(8, 1), ("seq", "unused")
+    )
+    both = VisionTransformer(
+        **TINY, sp_strategy="ring", sp_mesh=sp_mesh, ep_mesh=ep_mesh
+    )
+    got = both.apply(variables, x, train=False)
+    want = model.apply(variables, x, train=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
 def test_moe_vit_handles_awkward_token_counts():
     """Token counts that are not multiples of the default routing group
     (e.g. 20px/patch4 → 25 tokens/image, batch 8 → 200 tokens) pick the
