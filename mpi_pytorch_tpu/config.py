@@ -283,6 +283,16 @@ class Config:
                 f"batch_size {self.batch_size} not divisible by "
                 f"accum_steps {self.accum_steps}"
             )
+        if self.model_name == "inception_v3" and (self.width, self.height) not in (
+            (128, 128),  # the untouched default: image_size upgrades it to 299
+            (299, 299),
+        ):
+            raise ValueError(
+                f"inception_v3 requires 299x299 inputs (aux-logits pooling); "
+                f"an explicit --width/--height/--image-size of "
+                f"{self.width}x{self.height} would be silently overridden — "
+                "drop the flag or pass 299"
+            )
         if self.spmd_mode and self.mesh.model_parallel > 1:
             raise ValueError(
                 "spmd_mode is pure data-parallel (reference-parity shard_map step); "
@@ -359,11 +369,22 @@ def parse_config(argv: Sequence[str] | None = None, **overrides: Any) -> Config:
         env_key = f"MPT_{f.name.upper()}"
         if env_key in os.environ and f.type in casters:
             setattr(cfg, f.name, casters[f.type](os.environ[env_key]))
+    # Env counterpart of the --image-size alias (width AND height).
+    if "MPT_IMAGE_SIZE" in os.environ:
+        cfg.width = cfg.height = int(os.environ["MPT_IMAGE_SIZE"])
 
     parser = argparse.ArgumentParser(description="mpi_pytorch_tpu")
     _add_dataclass_args(parser, Config)
-    args, _ = parser.parse_known_args(argv)
-    for key, val in vars(args).items():
+    # Convenience alias: one flag for square inputs (sets width AND height).
+    parser.add_argument("--image-size", type=int, default=None, dest="image_size_alias")
+    # STRICT parsing: an unknown flag must error, not be silently dropped —
+    # a typo'd --batchsize otherwise trains with the default and no warning.
+    args = parser.parse_args(argv)
+    ns = vars(args)
+    alias = ns.pop("image_size_alias", None)
+    if alias is not None:
+        cfg.width = cfg.height = alias
+    for key, val in ns.items():
         if val is None:
             continue
         if "." in key:

@@ -60,3 +60,34 @@ def test_debug_nans_flag_wires_jax_config():
         assert jax.config.jax_debug_nans is True
     finally:
         jax.config.update("jax_debug_nans", False)
+
+
+def test_unknown_flag_errors_instead_of_silently_dropping():
+    """A typo'd flag must NOT train with defaults: argparse exits with an
+    'unrecognized arguments' error (strict parse_args, not parse_known_args)."""
+    with pytest.raises(SystemExit):
+        parse_config(["--batchsize", "64"])
+
+
+def test_image_size_alias_sets_both_dims():
+    cfg = parse_config(["--image-size", "64"])
+    assert (cfg.width, cfg.height) == (64, 64)
+    # explicit --width/--height still win over the alias
+    cfg = parse_config(["--image-size", "64", "--width", "96"])
+    assert (cfg.width, cfg.height) == (96, 64)
+
+
+def test_image_size_env_alias(monkeypatch):
+    monkeypatch.setenv("MPT_IMAGE_SIZE", "64")
+    cfg = parse_config([])
+    assert (cfg.width, cfg.height) == (64, 64)
+
+
+def test_inception_rejects_explicit_image_size():
+    with pytest.raises(ValueError, match="299"):
+        parse_config(["--model-name", "inception_v3", "--image-size", "64"])
+    # untouched default and explicit 299 both fine
+    assert parse_config(["--model-name", "inception_v3"]).image_size == (299, 299)
+    assert parse_config(
+        ["--model-name", "inception_v3", "--image-size", "299"]
+    ).image_size == (299, 299)
