@@ -369,9 +369,14 @@ def parse_config(argv: Sequence[str] | None = None, **overrides: Any) -> Config:
         env_key = f"MPT_{f.name.upper()}"
         if env_key in os.environ and f.type in casters:
             setattr(cfg, f.name, casters[f.type](os.environ[env_key]))
-    # Env counterpart of the --image-size alias (width AND height).
+    # Env counterpart of the --image-size alias. Like the CLI, the per-dim
+    # form wins: MPT_WIDTH/MPT_HEIGHT each beat MPT_IMAGE_SIZE for their dim.
     if "MPT_IMAGE_SIZE" in os.environ:
-        cfg.width = cfg.height = int(os.environ["MPT_IMAGE_SIZE"])
+        size = int(os.environ["MPT_IMAGE_SIZE"])
+        if "MPT_WIDTH" not in os.environ:
+            cfg.width = size
+        if "MPT_HEIGHT" not in os.environ:
+            cfg.height = size
 
     parser = argparse.ArgumentParser(description="mpi_pytorch_tpu")
     _add_dataclass_args(parser, Config)
@@ -399,6 +404,28 @@ def parse_config(argv: Sequence[str] | None = None, **overrides: Any) -> Config:
             setattr(getattr(cfg, scope), leaf, val)
         else:
             setattr(cfg, key, val)
+
+    # Explicit-dimension check that validate_config cannot do (the dataclass
+    # can't tell an explicit 128 from the untouched default): any explicitly
+    # requested size for inception_v3 other than its required 299 errors —
+    # including 128, which the image_size property would otherwise silently
+    # upgrade.
+    dims_explicit = (
+        alias is not None
+        or ns.get("width") is not None
+        or ns.get("height") is not None
+        or any(k in os.environ for k in ("MPT_IMAGE_SIZE", "MPT_WIDTH", "MPT_HEIGHT"))
+    )
+    if (
+        cfg.model_name == "inception_v3"
+        and dims_explicit
+        and (cfg.width, cfg.height) != (299, 299)
+    ):
+        raise ValueError(
+            f"inception_v3 requires 299x299 inputs (aux-logits pooling); the "
+            f"requested {cfg.width}x{cfg.height} would be silently "
+            "overridden — drop the size flags or pass 299"
+        )
 
     cfg.validate_config()
     return cfg

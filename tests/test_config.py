@@ -91,3 +91,15 @@ def test_inception_rejects_explicit_image_size():
     assert parse_config(
         ["--model-name", "inception_v3", "--image-size", "299"]
     ).image_size == (299, 299)
+
+
+def test_env_image_size_respects_per_dim_env(monkeypatch):
+    monkeypatch.setenv("MPT_IMAGE_SIZE", "64")
+    monkeypatch.setenv("MPT_WIDTH", "96")
+    cfg = parse_config([])
+    assert (cfg.width, cfg.height) == (96, 64)
+
+
+def test_inception_rejects_explicit_128_too():
+    with pytest.raises(ValueError, match="299"):
+        parse_config(["--model-name", "inception_v3", "--image-size", "128"])

@@ -152,6 +152,20 @@ def test_trainer_config_wires_sp_and_ep(tmp_path):
     assert bundle2.model.moe_every == 2
 
 
+def test_inference_config_wires_sp_and_ep(tmp_path):
+    """The eval driver mirrors the trainer's SP/EP model wiring."""
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.evaluate import build_inference
+
+    cfg = Config(
+        model_name="vit_moe_s16", num_classes=1000, batch_size=8,
+        width=64, height=64, synthetic_data=True, expert_parallel=True,
+        checkpoint_dir=str(tmp_path), validate=False,
+    )
+    _, bundle, _, _ = build_inference(cfg)
+    assert bundle.model.ep_mesh.axis_names[0] == "expert"
+
+
 def test_config_rejects_bad_sp_strategy():
     from mpi_pytorch_tpu.config import Config
 
