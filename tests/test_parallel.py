@@ -139,20 +139,22 @@ def test_spmd_grads_match_manual_average():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
-def test_spmd_zoo_model_matches_manual_mpi_step():
-    """One spmd-mode step on a real zoo model (alexnet: BN-free, dropout
-    active) == the reference MPI algorithm computed by hand: each of the 8
-    'ranks' runs forward/backward on its shard with its own dropout stream
-    (rng folded by shard index exactly as the spmd step folds
-    ``lax.axis_index``), grads are averaged, and one identical update is
-    applied (``mpi_avg_grads`` + optimizer.step, ``mpi_tools.py:30-37``)."""
+@pytest.mark.parametrize("zoo_model", ["alexnet", "vit_s16"])
+def test_spmd_zoo_model_matches_manual_mpi_step(zoo_model):
+    """One spmd-mode step on a real zoo model (alexnet: BN-free CNN with
+    dropout active; vit_s16: the attention family) == the reference MPI
+    algorithm computed by hand: each of the 8 'ranks' runs forward/backward
+    on its shard with its own dropout stream (rng folded by shard index
+    exactly as the spmd step folds ``lax.axis_index``), grads are averaged,
+    and one identical update is applied (``mpi_avg_grads`` +
+    optimizer.step, ``mpi_tools.py:30-37``)."""
     import optax
 
     from mpi_pytorch_tpu.ops.losses import classification_loss
 
-    size = 64  # alexnet's conv/pool stack needs more than 32px
+    size = 64 if zoo_model == "alexnet" else 32  # alexnet's pools need >32px
     bundle, variables = create_model_bundle(
-        "alexnet", NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=size
+        zoo_model, NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=size
     )
     model = bundle.model
     tx = optax.sgd(1e-2)
