@@ -25,6 +25,7 @@ SUPPORTED_MODELS = (
     "squeezenet1_0",
     "densenet121",
     "inception_v3",
+    "mobilenet_v2",
     "vit_s16",
     "vit_b16",
     "vit_moe_s16",

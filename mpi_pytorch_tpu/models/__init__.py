@@ -1,6 +1,7 @@
 from mpi_pytorch_tpu.models.alexnet import AlexNet, alexnet
 from mpi_pytorch_tpu.models.densenet import DenseNet, densenet121
 from mpi_pytorch_tpu.models.inception import InceptionV3, inception_v3
+from mpi_pytorch_tpu.models.mobilenet import MobileNetV2, mobilenet_v2
 from mpi_pytorch_tpu.models.registry import (
     ModelBundle,
     available_models,
@@ -14,8 +15,9 @@ from mpi_pytorch_tpu.models.vgg import VGG, vgg11_bn
 from mpi_pytorch_tpu.models.vit import VisionTransformer, vit_b16, vit_moe_s16, vit_s16
 
 __all__ = [
-    "AlexNet", "DenseNet", "InceptionV3", "ModelBundle", "ResNet", "SqueezeNet", "VGG",
-    "VisionTransformer", "alexnet", "available_models", "create_model_bundle",
-    "densenet121", "inception_v3", "init_variables", "initialize_model", "resnet18",
-    "resnet34", "squeezenet1_0", "vgg11_bn", "vit_b16", "vit_moe_s16", "vit_s16",
+    "AlexNet", "DenseNet", "InceptionV3", "MobileNetV2", "ModelBundle", "ResNet",
+    "SqueezeNet", "VGG", "VisionTransformer", "alexnet", "available_models",
+    "create_model_bundle", "densenet121", "inception_v3", "init_variables",
+    "initialize_model", "mobilenet_v2", "resnet18", "resnet34", "squeezenet1_0",
+    "vgg11_bn", "vit_b16", "vit_moe_s16", "vit_s16",
 ]

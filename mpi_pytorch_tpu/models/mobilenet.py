@@ -1,0 +1,119 @@
+"""MobileNetV2 in Flax (NHWC, TPU-native) — beyond-parity zoo member.
+
+The reference zoo stops at its seven torchvision CNNs (``models.py:16-101``).
+MobileNetV2 adds the inverted-residual/depthwise-separable family — the op
+class the rest of the zoo lacks (depthwise 3×3s run on the VPU rather than
+the MXU, so this is also the zoo's bandwidth-bound probe). Architecture per
+the public MobileNetV2 paper: expand 1×1 → depthwise 3×3 → linear project
+1×1, residual when stride 1 and channels match, ReLU6 activations, width
+settings [(1,16,1,1), (6,24,2,2), (6,32,3,2), (6,64,4,2), (6,96,3,1),
+(6,160,3,2), (6,320,1,1)], 1280-wide head conv. Parameter count matches
+torchvision's mobilenet_v2 (3,504,872 at 1000 classes; asserted in
+tests/test_mobilenet.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mpi_pytorch_tpu.models.common import batch_norm, global_avg_pool
+
+# (expansion t, out channels c, repeats n, first stride s)
+_SETTINGS = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+def relu6(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.minimum(nn.relu(x), 6.0)
+
+
+class InvertedResidual(nn.Module):
+    features: int
+    stride: int
+    expand: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        in_ch = x.shape[-1]
+        hidden = in_ch * self.expand
+        bn = lambda name: batch_norm(name, dtype=self.dtype, axis_name=self.bn_axis_name)
+        y = x
+        if self.expand != 1:
+            y = nn.Conv(
+                hidden, (1, 1), use_bias=False, dtype=self.dtype,
+                param_dtype=self.param_dtype, name="expand",
+            )(y)
+            y = relu6(bn("expand_bn")(y, use_running_average=not train))
+        # Depthwise 3x3: feature_group_count == channels puts one filter per
+        # channel (VPU work on TPU — no MXU contraction dimension).
+        y = nn.Conv(
+            hidden, (3, 3), strides=(self.stride, self.stride), padding=1,
+            feature_group_count=hidden, use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="depthwise",
+        )(y)
+        y = relu6(bn("depthwise_bn")(y, use_running_average=not train))
+        # Linear bottleneck: no activation after the projection.
+        y = nn.Conv(
+            self.features, (1, 1), use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="project",
+        )(y)
+        y = bn("project_bn")(y, use_running_average=not train)
+        if self.stride == 1 and in_ch == self.features:
+            y = x + y
+        return y
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: str | None = None
+    dropout: float = 0.2
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        bn = lambda name: batch_norm(name, dtype=self.dtype, axis_name=self.bn_axis_name)
+        x = nn.Conv(
+            32, (3, 3), strides=(2, 2), padding=1, use_bias=False,
+            dtype=self.dtype, param_dtype=self.param_dtype, name="stem",
+        )(x)
+        x = relu6(bn("stem_bn")(x, use_running_average=not train))
+
+        block = 0
+        for t, c, n, s in _SETTINGS:
+            for i in range(n):
+                x = InvertedResidual(
+                    features=c, stride=s if i == 0 else 1, expand=t,
+                    dtype=self.dtype, param_dtype=self.param_dtype,
+                    bn_axis_name=self.bn_axis_name, name=f"block{block}",
+                )(x, train)
+                block += 1
+
+        x = nn.Conv(
+            1280, (1, 1), use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="head_conv",
+        )(x)
+        x = relu6(bn("head_bn")(x, use_running_average=not train))
+        x = global_avg_pool(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype,
+            name="head",
+        )(x)
+
+
+def mobilenet_v2(num_classes: int, **kw: Any) -> MobileNetV2:
+    return MobileNetV2(num_classes=num_classes, **kw)

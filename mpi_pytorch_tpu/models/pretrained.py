@@ -20,6 +20,16 @@ from flax import serialization
 from mpi_pytorch_tpu.models.common import head_filter
 
 
+# Architectures with a torchvision weight mapping (tools/convert_torchvision
+# _MODELS, torch_mapping._module_prefix) — the reference's seven. The
+# beyond-parity families (vit_*, mobilenet_v2) are random-init by design:
+# they have no torchvision-checkpoint counterpart in this codebase.
+CONVERTIBLE_MODELS = (
+    "resnet18", "resnet34", "alexnet", "vgg11_bn",
+    "squeezenet1_0", "densenet121", "inception_v3",
+)
+
+
 def pretrained_path(model_name: str, pretrained_dir: str) -> str:
     return os.path.join(pretrained_dir, f"{model_name}.msgpack")
 
@@ -27,6 +37,13 @@ def pretrained_path(model_name: str, pretrained_dir: str) -> str:
 def load_pretrained(model_name: str, variables: dict, pretrained_dir: str) -> dict:
     """Overlay converted backbone weights onto freshly-initialized variables,
     keeping the head's fresh init (head shape depends on num_classes)."""
+    if model_name not in CONVERTIBLE_MODELS:
+        raise ValueError(
+            f"use_pretrained=True is not available for {model_name!r}: the "
+            "torchvision converter covers the reference's seven architectures "
+            f"({', '.join(CONVERTIBLE_MODELS)}); the beyond-parity families "
+            "train from random init (set use_pretrained=False)."
+        )
     path = pretrained_path(model_name, pretrained_dir)
     if not os.path.exists(path):
         raise FileNotFoundError(

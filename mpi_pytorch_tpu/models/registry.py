@@ -27,6 +27,7 @@ from mpi_pytorch_tpu.models.alexnet import alexnet
 from mpi_pytorch_tpu.models.common import head_filter
 from mpi_pytorch_tpu.models.densenet import densenet121
 from mpi_pytorch_tpu.models.inception import inception_v3
+from mpi_pytorch_tpu.models.mobilenet import mobilenet_v2
 from mpi_pytorch_tpu.models.resnet import resnet18, resnet34
 from mpi_pytorch_tpu.models.squeezenet import squeezenet1_0
 from mpi_pytorch_tpu.models.vgg import vgg11_bn
@@ -45,6 +46,7 @@ _REGISTRY: dict[str, tuple[Callable[..., nn.Module], int]] = {
     "squeezenet1_0": (squeezenet1_0, 224),
     "densenet121": (densenet121, 224),
     "inception_v3": (inception_v3, 299),
+    "mobilenet_v2": (mobilenet_v2, 224),
     "vit_s16": (vit_s16, 224),
     "vit_b16": (vit_b16, 224),
     "vit_moe_s16": (vit_moe_s16, 224),

@@ -45,6 +45,7 @@ ZOO = {
     "squeezenet1_0": (512, 128),
     "densenet121": (1024, 128),
     "inception_v3": (256, 299),
+    "mobilenet_v2": (1024, 128),
     # vit at 128px/patch16 = 64 tokens; large batches keep the MXU fed.
     "vit_s16": (2048, 128),
     "vit_b16": (1024, 128),
