@@ -120,11 +120,31 @@ def latest_checkpoint(ckpt_dir: str) -> str | None:
 
 
 @functools.lru_cache(maxsize=None)
-def _copy_fn():
+def _copy_fn(out_sharding=None):
     # jit output buffers never alias inputs (no donation), so this yields
     # FRESH device arrays — the snapshot the async writer reads while the
-    # training loop donates the originals into the next step.
-    return jax.jit(lambda t: jax.tree_util.tree_map(lambda x: x.copy(), t))
+    # training loop donates the originals into the next step. With
+    # ``out_sharding`` (a replicated NamedSharding) the copy additionally
+    # gathers every leaf onto all devices, which makes ZeRO-sharded Adam
+    # moments and the TP-sharded head process-0-addressable on multi-host
+    # meshes — the all-gather that turns a distributed state into a
+    # checkpointable one.
+    copy = lambda t: jax.tree_util.tree_map(lambda x: x.copy(), t)  # noqa: E731
+    if out_sharding is None:
+        return jax.jit(copy)
+    return jax.jit(copy, out_shardings=out_sharding)
+
+
+def _replicated_sharding(arrays: dict):
+    """``NamedSharding(mesh, P())`` over the mesh the state lives on, or None
+    for states that aren't mesh-placed (plain host/numpy test states)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    for leaf in jax.tree_util.tree_leaves(arrays):
+        s = getattr(leaf, "sharding", None)
+        if isinstance(s, NamedSharding):
+            return NamedSharding(s.mesh, PartitionSpec())
+    return None
 
 
 class AsyncCheckpointer:
@@ -151,13 +171,13 @@ class AsyncCheckpointer:
         EVERY process must call this (the trainer does): the jitted snapshot
         copy is a global SPMD computation on multi-host meshes, so gating it
         to process 0 would diverge the programs the processes run. Only
-        process 0 spawns the writer thread. (Multi-host saves additionally
-        require the persisted arrays to be process-0-addressable, i.e.
-        replicated or host-local — the TP-sharded head under
-        ``mesh.model_parallel > 1`` on multiple hosts is not supported by
-        this writer yet.)"""
+        process 0 spawns the writer thread. Sharded state (ZeRO-1 moments,
+        the TP head) is all-gathered to replicated by the snapshot copy's
+        ``out_shardings``, so the writer's ``device_get`` sees only
+        process-addressable arrays on any number of hosts."""
         self.wait()
-        snapshot = _copy_fn()(_state_arrays(state))
+        arrays = _state_arrays(state)
+        snapshot = _copy_fn(_replicated_sharding(arrays))(arrays)
         jax.block_until_ready(snapshot["params"])  # copy is cheap; be certain
         if process_index() != 0:
             return None

@@ -20,8 +20,9 @@ from flax import serialization
 from mpi_pytorch_tpu.models.common import head_filter
 
 
-# Architectures with a torchvision weight mapping (tools/convert_torchvision
-# _MODELS, torch_mapping._module_prefix) — the reference's seven. The
+# Architectures with a torchvision weight mapping — the reference's seven.
+# Single source of truth: tools/convert_torchvision.py imports this list, and
+# torch_mapping._module_prefix must cover exactly these names. The
 # beyond-parity families (vit_*, mobilenet_v2) are random-init by design:
 # they have no torchvision-checkpoint counterpart in this codebase.
 CONVERTIBLE_MODELS = (

@@ -414,15 +414,6 @@ def train(cfg: Config) -> TrainSummary:
         else:
             logger.info("from_checkpoint=True but no checkpoint found; fresh start")
 
-    if cfg.zero_optimizer and jax.process_count() > 1 and cfg.checkpoint_every_epochs:
-        # Data-axis-sharded moments span other hosts' devices, which the
-        # process-0 checkpoint writer cannot device_get (AsyncCheckpointer
-        # requires persisted arrays to be process-0-addressable).
-        raise ValueError(
-            "zero_optimizer with multi-host checkpointing is not supported yet: "
-            "shard the moments OR checkpoint, not both (set "
-            "checkpoint_every_epochs=0 to disable saves, or zero_optimizer=False)"
-        )
     state = place_state_on_mesh(state, mesh, zero_optimizer=cfg.zero_optimizer)
     host_batch = cfg.batch_size // jax.process_count()
 

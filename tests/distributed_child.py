@@ -80,13 +80,32 @@ def main() -> None:
         debug=True, debug_sample_size=29, synthetic_data=True,
         host_cache=True, drop_remainder=True, compute_dtype="float32",
         width=32, height=32, validate=True, val_on_train=True,
-        checkpoint_every_epochs=0, log_every_steps=0, metrics_file="",
+        # ZeRO-1 moments are data-axis-sharded ACROSS the two processes; the
+        # per-epoch save proves the snapshot's replicated out_shardings
+        # all-gather makes them process-0-addressable (checkpoint.py).
+        zero_optimizer=True, checkpoint_every_epochs=1,
+        log_every_steps=0, metrics_file="",
         log_file=os.path.join(scratch, f"train_{jax.process_index()}.log"),
-        checkpoint_dir=os.path.join(scratch, f"ckpt_{jax.process_index()}"),
+        checkpoint_dir=os.path.join(scratch, "ckpt_shared"),
     )
     cfg.validate_config()
     summary = train(cfg)
     assert summary.epochs_run == 2, summary.epochs_run
+    if jax.process_index() == 0:
+        # The ZeRO-sharded state actually landed on disk, with gathered
+        # (non-zero) Adam moments — not just the replicated params.
+        from flax import serialization as _ser
+
+        from mpi_pytorch_tpu.checkpoint import latest_checkpoint
+
+        path = latest_checkpoint(cfg.checkpoint_dir)
+        assert path is not None
+        with open(path, "rb") as f:
+            raw = _ser.msgpack_restore(f.read())
+        assert int(raw["epoch"]) == 1, raw["epoch"]
+        mu = raw["opt_state"]["0"]["mu"]
+        leaves = jax.tree_util.tree_leaves(mu)
+        assert leaves and any(float(np.abs(l).max()) > 0 for l in leaves)
     # Prove the scenario is the intended one: host 0's shard (12 images)
     # yields one more drop-remainder batch than the global step count, so
     # its epoch iterator was closed early and the cache completed via the

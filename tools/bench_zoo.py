@@ -143,20 +143,58 @@ def bench_one(model_name: str, batch_per_chip: int, image: int, steps: int, warm
     return rec
 
 
+def bench_one_in_child(name: str, steps: int, warmup: int, timeout_s: int) -> dict:
+    """Run one model's bench in a fresh child interpreter with a hard
+    timeout. A wedged TPU relay blocks inside a compile/execute RPC that no
+    in-process watchdog can interrupt (observed: a full-sweep hang with zero
+    rows produced) — killing a child instead turns the wedge into an error
+    row and lets the remaining models run if the relay recovers."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--in-process",
+        "--models", name, "--steps", str(steps), "--warmup", str(warmup),
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, cwd=repo, capture_output=True, text=True, timeout=timeout_s
+        )
+    except subprocess.TimeoutExpired:
+        return {"model": name, "error": f"child exceeded {timeout_s}s (wedged TPU relay?)"}
+    for line in (proc.stdout or "").splitlines()[::-1]:
+        if line.startswith("{"):
+            return json.loads(line)
+    tail = (proc.stderr or "").strip().splitlines()[-3:]
+    return {"model": name, "error": f"no JSON (rc={proc.returncode}): " + " | ".join(tail)}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--models", default=",".join(ZOO), help="comma-separated subset")
     ap.add_argument("--out", default="", help="also write a JSON array to this path")
+    ap.add_argument(
+        "--in-process", action="store_true",
+        help="bench in this process (no per-model watchdog child); the "
+        "default isolates each model in a child with --model-timeout",
+    )
+    ap.add_argument("--model-timeout", type=int, default=1200)
     args = ap.parse_args()
 
     records = []
     for name in (m.strip() for m in args.models.split(",") if m.strip()):
         try:
             batch, image = ZOO[name]  # inside try: a typo'd name must not
-            rec = bench_one(name, batch, image, args.steps, args.warmup)
-        except Exception as e:  # kill the sweep or discard --out
+            if args.in_process:  # kill the sweep or discard --out
+                rec = bench_one(name, batch, image, args.steps, args.warmup)
+            else:
+                rec = bench_one_in_child(
+                    name, args.steps, args.warmup, args.model_timeout
+                )
+        except Exception as e:
             rec = {"model": name, "error": f"{type(e).__name__}: {e}"[:300]}
         records.append(rec)
         print(json.dumps(rec), flush=True)

@@ -38,14 +38,8 @@ from flax import serialization
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from mpi_pytorch_tpu.models import create_model_bundle  # noqa: E402
+from mpi_pytorch_tpu.models.pretrained import CONVERTIBLE_MODELS as _MODELS  # noqa: E402
 from mpi_pytorch_tpu.models.torch_mapping import convert_state_dict  # noqa: E402
-
-# zoo architectures; torchvision factories share these exact names
-# (reference models.py:30-95).
-_MODELS = (
-    "resnet18", "resnet34", "alexnet", "vgg11_bn",
-    "squeezenet1_0", "densenet121", "inception_v3",
-)
 
 
 def fetch_state_dict(model_name: str, state_dict_path: str | None) -> dict:
