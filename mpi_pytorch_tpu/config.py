@@ -115,6 +115,12 @@ class Config:
     # sharded over the data axis instead of replicated — per-device optimizer
     # memory 2×params → 2×params/n. Auto (jit) mode only.
     zero_optimizer: bool = False
+    # ZeRO-3/FSDP-style parameter sharding (beyond reference parity): params
+    # AND their Adam moments sharded over the data axis at rest; XLA
+    # all-gathers each layer's weights at use and reduce-scatters its
+    # gradient — per-device params+optimizer memory 3×params → 3×params/n.
+    # Auto (jit) mode only.
+    fsdp: bool = False
     # Rematerialization strategy: "none" | "full" | "blocks".
     # "full" wraps the whole forward in jax.checkpoint (measured NOT to pay
     # for these CNNs — docs/RESULTS.md §4b); "blocks" checkpoints each
@@ -217,6 +223,12 @@ class Config:
                 "zero_optimizer shards Adam moments via the auto-partitioned "
                 "jit step; the spmd_mode shard_map step replicates its state "
                 "specs, so the two do not compose"
+            )
+        if self.fsdp and self.spmd_mode:
+            raise ValueError(
+                "fsdp shards params via the auto-partitioned jit step; the "
+                "spmd_mode shard_map step replicates its state specs, so the "
+                "two do not compose"
             )
         if self.device_cache and self.spmd_mode:
             raise ValueError(

@@ -50,15 +50,36 @@ def is_head_kernel(path_keys: tuple) -> tuple[bool, bool]:
     return is_head, keys[-1] == "kernel"
 
 
-def param_specs(params: Any, mesh: Mesh) -> Any:
+def shard_first_divisible(shape, axis_name: str, size: int) -> P:
+    """The ZeRO shard-selection rule, shared by FSDP param placement and the
+    ZeRO-1 moment placement (train/step.py): shard the FIRST dimension that
+    divides evenly by the axis size; no divisible dim → replicate."""
+    for i, dim in enumerate(shape):
+        if dim > 0 and dim % size == 0:
+            return P(*([None] * i + [axis_name] + [None] * (len(shape) - i - 1)))
+    return P()
+
+
+def param_specs(params: Any, mesh: Mesh, fsdp: bool = False) -> Any:
     """PartitionSpecs for a param tree: classifier-head kernels column-sharded
     over the ``model`` axis (Megatron-style vocab-parallel classifier), head
-    bias sharded likewise, everything else replicated (pure DP)."""
+    bias sharded likewise, everything else replicated (pure DP).
+
+    ``fsdp`` (ZeRO-3-style, beyond reference parity): every param that would
+    be replicated is instead sharded over the ``data`` axis on its first
+    evenly-divisible dimension. At rest each device then holds 1/n of the
+    weights; inside the jitted step XLA all-gathers each layer's weights just
+    before use and reduce-scatters its gradient — the compiler-native form of
+    fully-sharded data parallelism. Params with no divisible axis (small
+    biases, BN scales) stay replicated."""
     model_axis = mesh.axis_names[1]
+    data_axis, data_size = mesh.axis_names[0], mesh.shape[mesh.axis_names[0]]
 
     def spec(path, leaf):
         is_head, is_kernel = is_head_kernel(path)
         if not is_head or mesh.shape[model_axis] == 1:
+            if fsdp and data_size > 1:
+                return shard_first_divisible(leaf.shape, data_axis, data_size)
             return P()
         if is_kernel:
             # Dense kernel [in, out] or 1×1-conv kernel [kh, kw, in, out]:

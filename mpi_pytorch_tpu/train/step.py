@@ -35,7 +35,11 @@ from jax import shard_map
 
 from mpi_pytorch_tpu.ops.losses import accuracy_count, classification_loss, valid_count
 from mpi_pytorch_tpu.parallel import collectives
-from mpi_pytorch_tpu.parallel.mesh import named_shardings, param_specs
+from mpi_pytorch_tpu.parallel.mesh import (
+    named_shardings,
+    param_specs,
+    shard_first_divisible,
+)
 from mpi_pytorch_tpu.train.state import TrainState
 
 
@@ -356,7 +360,9 @@ def make_eval_step(compute_dtype=jnp.bfloat16) -> Callable:
     return eval_step
 
 
-def place_state_on_mesh(state: TrainState, mesh, zero_optimizer: bool = False) -> TrainState:
+def place_state_on_mesh(
+    state: TrainState, mesh, zero_optimizer: bool = False, fsdp: bool = False
+) -> TrainState:
     """Device-put the state with DP/TP shardings: head column-sharded over
     ``model``, everything else replicated. Opt-state mirrors param shardings
     (Adam moments have the params' tree structure).
@@ -366,8 +372,15 @@ def place_state_on_mesh(state: TrainState, mesh, zero_optimizer: bool = False) -
     ``data`` axis instead of replicated (ZeRO-1 style). The compiler then
     partitions the elementwise optimizer update along the moment sharding
     and gathers the param updates — per-device optimizer memory drops from
-    2×params to 2×params/n with no change to the step function."""
-    specs = param_specs(state.params, mesh)
+    2×params to 2×params/n with no change to the step function.
+
+    ``fsdp`` (ZeRO-3 style): the params THEMSELVES are sharded over the
+    ``data`` axis at rest (``param_specs(..., fsdp=True)``), and the Adam
+    moments follow their params' shardings automatically. XLA all-gathers
+    each layer's weights at use and reduce-scatters its gradient; per-device
+    params+optimizer memory drops from 3×params to 3×params/n. The step
+    function is unchanged — sharding is entirely a placement decision."""
+    specs = param_specs(state.params, mesh, fsdp=fsdp)
     p_shard = named_shardings(specs, mesh)
     rep = NamedSharding(mesh, P())
     data_axis, data_size = mesh.axis_names[0], mesh.shape[mesh.axis_names[0]]
@@ -384,14 +397,10 @@ def place_state_on_mesh(state: TrainState, mesh, zero_optimizer: bool = False) -
             shape_map.setdefault((pl.shape, str(pl.dtype)), ps)
 
         def zero_spec(shape) -> NamedSharding | None:
-            # Shard the first axis divisible by the data size (moments keep
-            # the param's shape); None → no axis shards evenly, replicate.
-            for i, dim in enumerate(shape):
-                if dim % data_size == 0 and dim > 0:
-                    return NamedSharding(
-                        mesh, P(*([None] * i + [data_axis] + [None] * (len(shape) - i - 1)))
-                    )
-            return None
+            # Same shard-selection rule as FSDP param placement; None → no
+            # axis shards evenly, replicate.
+            spec = shard_first_divisible(shape, data_axis, data_size)
+            return None if spec == P() else NamedSharding(mesh, spec)
 
         def put(leaf):
             if not hasattr(leaf, "shape"):

@@ -414,7 +414,9 @@ def train(cfg: Config) -> TrainSummary:
         else:
             logger.info("from_checkpoint=True but no checkpoint found; fresh start")
 
-    state = place_state_on_mesh(state, mesh, zero_optimizer=cfg.zero_optimizer)
+    state = place_state_on_mesh(
+        state, mesh, zero_optimizer=cfg.zero_optimizer, fsdp=cfg.fsdp
+    )
     host_batch = cfg.batch_size // jax.process_count()
 
     # AOT-compile the step on the static batch shape: one compile serves the

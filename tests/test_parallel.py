@@ -271,6 +271,64 @@ def test_zero_optimizer_sharding_matches_replicated():
     assert np.isfinite(float(m3["loss"]))
 
 
+def test_fsdp_param_sharding_matches_replicated():
+    """ZeRO-3-style FSDP: (a) params themselves are sharded over the data
+    axis at rest (the big conv kernels hold 1/8 per device) and the Adam
+    moments follow, (b) one train step produces the same loss and params as
+    the replicated-weights DP step — sharding is placement only, the compiled
+    math is equivalent."""
+    mesh = create_mesh(MeshConfig())
+
+    # Placement: params sharded 1/8 per device, Adam moments following.
+    _, adam_state, _ = _setup()
+    placed_adam = place_state_on_mesh(adam_state, mesh, fsdp=True)
+    sharded_params = [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(placed_adam.params)
+        if leaf.ndim > 0 and not leaf.sharding.is_fully_replicated
+    ]
+    assert sharded_params, "no param ended up FSDP-sharded"
+    big = max(sharded_params, key=lambda a: a.size)
+    assert big.addressable_shards[0].data.size == big.size // 8
+    sharded_moments = [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(placed_adam.opt_state)
+        if hasattr(leaf, "sharding") and leaf.ndim > 0
+        and not leaf.sharding.is_fully_replicated
+    ]
+    assert sharded_moments, "Adam moments did not follow the param shardings"
+
+    # Equivalence (SGD: linear in g, so reduce-scatter float noise stays
+    # float-sized instead of flipping Adam's ±lr first-step sign).
+    _, state, batch = _setup(sgd=True)
+    step = make_train_step(compute_dtype=jnp.float32)
+    s_rep, m_rep = step(place_state_on_mesh(state, mesh), shard_batch(batch, mesh))
+
+    _, state2, _ = _setup(sgd=True)
+    placed = place_state_on_mesh(state2, mesh, fsdp=True)
+    s_fsdp, m_fsdp = step(placed, shard_batch(batch, mesh))
+    np.testing.assert_allclose(float(m_rep["loss"]), float(m_fsdp["loss"]), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_rep.params), jax.tree_util.tree_leaves(s_fsdp.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    # A SECOND step through the trainer's pinned-output-sharding executable
+    # (donated input + out_shardings pinned to the FSDP placement) — the
+    # configuration where compiler-chosen output shardings once broke the
+    # ZeRO path on step 2 (see test_zero_optimizer_sharding_matches_replicated).
+    from mpi_pytorch_tpu.train.trainer import _state_shardings
+
+    _, state3, _ = _setup(sgd=True)
+    placed2 = place_state_on_mesh(state3, mesh, fsdp=True)
+    pinned = jax.jit(
+        step, donate_argnums=(0,), out_shardings=(_state_shardings(placed2), None)
+    )
+    placed2, _ = pinned(placed2, shard_batch(batch, mesh))
+    placed2, m3 = pinned(placed2, shard_batch(batch, mesh))
+    assert np.isfinite(float(m3["loss"]))
+
+
 def test_async_checkpoint_gathers_zero_sharded_state(tmp_path):
     """AsyncCheckpointer on a ZeRO-sharded state: the snapshot's replicated
     out_shardings all-gather the data-axis-sharded Adam moments, so the save
