@@ -18,6 +18,8 @@ stage:
 
 from __future__ import annotations
 
+import signal
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -54,6 +56,68 @@ class TrainSummary:
     images_per_sec: float = 0.0
     checkpoint_path: str | None = None
     epoch_losses: list = field(default_factory=list)
+    preempted: bool = False
+
+
+class PreemptionGuard:
+    """Graceful SIGTERM/SIGINT handling (SURVEY §5 failure-detection row).
+
+    Cluster schedulers and TPU maintenance events deliver SIGTERM with a
+    grace window; the reference's fail-stop MPI world dies mid-step and
+    relies on a manual ``FROM_CHECKPOINT`` restart. Here the FIRST signal
+    only sets a flag that the train loop polls — the run stops at the next
+    safe boundary, saves any unsaved completed-epoch progress, drains the
+    in-flight async checkpoint write, and returns normally with
+    ``summary.preempted=True`` (exit code 0, auto-resume picks up the saved
+    epoch). A SECOND signal restores the previous handler and re-raises it —
+    the escape hatch if the graceful drain itself wedges.
+
+    Installed only from the main thread (Python restricts ``signal.signal``
+    to it); elsewhere the guard is inert and the signals keep their prior
+    behavior."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self) -> None:
+        self.triggered = False
+        self._previous: dict[int, Any] = {}
+
+    def __enter__(self) -> "PreemptionGuard":
+        if threading.current_thread() is threading.main_thread():
+            for sig in self.SIGNALS:
+                self._previous[sig] = signal.signal(sig, self._handle)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+
+    def _handle(self, signum, frame) -> None:
+        if self.triggered:  # second signal: defer to the original behavior
+            prev = self._previous.get(signum)
+            signal.signal(signum, prev if prev is not None else signal.SIG_DFL)
+            signal.raise_signal(signum)
+            return
+        self.triggered = True
+
+
+def _stop_agreed(guard: PreemptionGuard, mesh) -> bool:
+    """Epoch-boundary stop decision. Single-process: the local flag.
+    Multi-host: a tiny global all-reduce of every host's flag, so EITHER all
+    processes break before the next epoch or none do — a host stopping
+    unilaterally would leave the others blocked in the next collective
+    step."""
+    if jax.process_count() == 1:
+        return guard.triggered
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    local = np.full(
+        (jax.local_device_count(),), 1.0 if guard.triggered else 0.0, np.float32
+    )
+    sharding = NamedSharding(mesh, P(tuple(mesh.axis_names)))  # 1-D over all devices
+    flags = jax.make_array_from_process_local_data(sharding, local)
+    return float(jnp.max(flags)) > 0.0
 
 
 def _dtype(name: str):
@@ -515,8 +579,23 @@ def train(cfg: Config) -> TrainSummary:
     if profiling:
         jax.profiler.start_trace(cfg.profile_dir)
 
-    try:
+    # The guard stays installed through the preemption save and the final
+    # checkpoint drain below: a FIRST signal arriving mid-drain is absorbed
+    # (the run is already finishing), and only a SECOND signal falls through
+    # to the previous handler — the escape hatch if the drain itself wedges.
+    guard = PreemptionGuard()
+    last_saved_epoch = -1
+    with guard:
+      try:
         for epoch in range(start_epoch, cfg.num_epochs):
+            if _stop_agreed(guard, mesh):
+                summary.preempted = True
+                logger.info(
+                    "preemption signal: stopping before epoch %d "
+                    "(progress saved; auto-resume continues from the latest "
+                    "checkpoint)", epoch,
+                )
+                break
             t0 = time.perf_counter()  # ≙ MPI.Wtime() (main.py:145)
             losses, counts = [], []
             loss_v = count_v = None  # [steps] device arrays, set below
@@ -563,7 +642,16 @@ def train(cfg: Config) -> TrainSummary:
                         mesh, host_batch, cfg.prefetch_device_batches,
                     )
                 )
+            stopped_mid_epoch = False
             for step_i, args in enumerate(step_args):
+                # Single-process: stop promptly at a step boundary, dropping
+                # the partial epoch (its updates stay in `state` but aren't
+                # reported or saved as a completed epoch). Multi-host stops
+                # only at the agreed epoch boundary above — a unilateral
+                # mid-epoch break would strand the other hosts' collectives.
+                if guard.triggered and jax.process_count() == 1:
+                    stopped_mid_epoch = True
+                    break
                 state, m = compiled_step(state, *args)
                 losses.append(m["loss"])
                 counts.append(m["count"])
@@ -571,6 +659,14 @@ def train(cfg: Config) -> TrainSummary:
                     logger.info(
                         "epoch %d step %d loss %.4f", epoch, step_i + 1, float(m["loss"])
                     )
+            if stopped_mid_epoch:
+                summary.preempted = True
+                logger.info(
+                    "preemption signal: stopping mid-epoch %d at step boundary "
+                    "%d (last completed epoch's progress is what resume sees)",
+                    epoch, step_i,
+                )
+                break
             # Device sync so the timer measures compute, not dispatch.
             jax.block_until_ready(state.params)
             dt = time.perf_counter() - t0
@@ -624,6 +720,7 @@ def train(cfg: Config) -> TrainSummary:
                     cfg.checkpoint_dir, epoch=epoch, state=state, loss=epoch_loss,
                     keep=cfg.keep_checkpoints,
                 )
+                last_saved_epoch = epoch
                 if path:
                     summary.checkpoint_path = path
                     logger.info(
@@ -664,7 +761,7 @@ def train(cfg: Config) -> TrainSummary:
                 logger.info("Accuracy of the network: %.4f (val_on_train=%s)", acc, cfg.val_on_train)
                 metrics.write({"kind": "val", "epoch": epoch, "accuracy": acc, "loss": vloss})
 
-    except BaseException:
+      except BaseException:
         # Drain the in-flight write on the failure path too, but never let a
         # secondary writer error replace the primary exception the user
         # needs to see.
@@ -673,9 +770,29 @@ def train(cfg: Config) -> TrainSummary:
         except BaseException as werr:
             logger.warning("background checkpoint write also failed: %s", werr)
         raise
-    # Clean path: the last dispatched write must land before callers read the
-    # file (resume, evaluate), and a writer error must fail the run loudly.
-    checkpointer.wait()
+      if summary.preempted and cfg.checkpoint_every_epochs:
+        # Preserve completed-but-unsaved progress (checkpoint_every_epochs>1
+        # leaves up to k-1 epochs unsaved). The state may additionally carry a
+        # partial epoch's updates — saved under the last COMPLETED epoch, so
+        # resume redoes the interrupted epoch on top (same looseness as the
+        # reference's epoch-granular FROM_CHECKPOINT restart, main.py:127-130).
+        # `completed >= start_epoch`: only epochs completed by THIS run — a
+        # resumed run preempted before finishing any epoch must not replace
+        # the clean on-disk checkpoint it restored from with a dirty state.
+        completed = start_epoch + summary.epochs_run - 1
+        if completed >= start_epoch and completed != last_saved_epoch:
+            path = checkpointer.save(
+                cfg.checkpoint_dir, epoch=completed, state=state, loss=epoch_loss,
+                keep=cfg.keep_checkpoints,
+            )
+            if path:
+                summary.checkpoint_path = path
+                logger.info("preemption checkpoint dispatched: %s", path)
+
+      # Clean path: the last dispatched write must land before callers read
+      # the file (resume, evaluate), and a writer error must fail the run
+      # loudly. Still under the guard: see the note at `with guard:` above.
+      checkpointer.wait()
 
     if profiling:
         jax.profiler.stop_trace()
