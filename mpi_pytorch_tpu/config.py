@@ -164,6 +164,13 @@ class Config:
     # and sized by host memory instead of HBM (40k images at 128px = 7.9 GB
     # f32 / 3.9 GB bf16). The middle ground between streaming and device_cache.
     host_cache: bool = False
+    # Directory of OFFLINE-packed datasets (data/packed.py): uint8 image
+    # tensors decoded+resized once, mmap'd at run time — per-epoch decode
+    # cost removed entirely (vs hidden, the reference's approach), page
+    # cache shared across processes on a host. Build with
+    # `python -m mpi_pytorch_tpu.data.packed --packed-dir DIR [flags]`;
+    # loaders resolve their shard against the packs by filename.
+    packed_dir: str = ""
     drop_remainder: bool = True  # static shapes for XLA; see trainer for semantics
     # Keep the whole (decoded, normalized) training set resident in HBM and
     # have each jitted step gather its batch by index on device — zero

@@ -1,0 +1,213 @@
+"""Packed dataset: decode once OFFLINE into an mmap-able uint8 tensor file.
+
+The reference hides per-image ingest cost behind pipeline stages at RUN time
+(``evaluation_pipeline.py:53-129``; ``data_loader.py:29-39`` decodes every
+image every epoch). The packed format removes the cost instead of hiding it:
+one offline pass decodes+resizes the whole split into
+
+- ``<stem>.images.npy`` — uint8 ``[N, H, W, 3]``, written via ``open_memmap``
+  (never holds the dataset in RAM) and read back with ``np.load(...,
+  mmap_mode='r')`` — batches are row slices served straight from the OS page
+  cache, shared read-only across every process on the host;
+- ``<stem>.labels.npy`` — int32 ``[N]`` (contiguous labels of the packing
+  run; loaders use their own manifest's labels, these are for standalone use);
+- ``<stem>.meta.json`` — image size, source image dir, synthetic flag, and
+  the filename list, so a loader can resolve ANY manifest shard (multi-host
+  shards, DEBUG subsets) to pack rows by filename.
+
+Numerics: images are stored as the uint8 output of PIL's decode→RGB→resize —
+exactly the bytes ``pipeline.decode_image`` converts to float — so
+``normalize(packed[i]/255) == normalize(decode_image(path))`` bit-for-bit.
+(Synthetic images are float-valued and quantize to uint8 at pack time:
+max error 1/510 per channel; the meta's ``synthetic`` flag records it.)
+
+CLI (packs BOTH splits of the configured dataset, reusing every manifest
+semantic including DEBUG sampling):
+
+    python -m mpi_pytorch_tpu.data.packed --packed-dir data/packed \
+        [--image-size 128] [--synthetic-data true] [any config flag]
+
+Loaders opt in with ``--packed-dir``: each resolves the first pack in the
+directory whose image size and synthetic flag match and whose filename set
+covers the loader's shard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+META_VERSION = 1
+
+
+def _pack_paths(stem: str) -> tuple[str, str, str]:
+    return stem + ".images.npy", stem + ".labels.npy", stem + ".meta.json"
+
+
+def _decode_uint8(path: str, image_size: tuple[int, int]) -> np.ndarray:
+    """decode→RGB→resize as raw uint8 HWC — the pre-float prefix of
+    ``pipeline.decode_image``."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        im = im.convert("RGB").resize((image_size[1], image_size[0]), Image.BILINEAR)
+        return np.asarray(im, dtype=np.uint8)
+
+
+def _synthetic_uint8(label: int, image_size: tuple[int, int]) -> np.ndarray:
+    from mpi_pytorch_tpu.data.pipeline import synthetic_image
+
+    return np.clip(
+        np.rint(synthetic_image(label, image_size) * 255.0), 0, 255
+    ).astype(np.uint8)
+
+
+def write_pack(
+    manifest,
+    image_size: tuple[int, int],
+    stem: str,
+    *,
+    synthetic: bool = False,
+    num_workers: int = 8,
+) -> str:
+    """Decode ``manifest`` into ``<stem>.{images,labels}.npy + .meta.json``.
+    Returns the images path. Incremental memmap writes keep peak RAM at one
+    batch regardless of dataset size."""
+    img_path, lab_path, meta_path = _pack_paths(stem)
+    os.makedirs(os.path.dirname(stem) or ".", exist_ok=True)
+    n = len(manifest)
+    out = np.lib.format.open_memmap(
+        img_path + ".tmp.npy", mode="w+", dtype=np.uint8, shape=(n, *image_size, 3)
+    )
+
+    def load(i: int) -> np.ndarray:
+        if synthetic:
+            return _synthetic_uint8(int(manifest.labels[i]), image_size)
+        return _decode_uint8(
+            os.path.join(manifest.img_dir, manifest.filenames[i]), image_size
+        )
+
+    # Bounded submission: pool.map over all n rows at once would buffer every
+    # finished decode behind one slow item (worst case the whole uint8 set in
+    # RAM); chunking caps in-flight results at a few batches.
+    chunk = max(1, num_workers) * 4
+    with ThreadPoolExecutor(max_workers=max(1, num_workers)) as pool:
+        for s in range(0, n, chunk):
+            stop = min(s + chunk, n)
+            for i, img in zip(range(s, stop), pool.map(load, range(s, stop))):
+                out[i] = img
+    out.flush()
+    del out
+    os.replace(img_path + ".tmp.npy", img_path)  # atomic, like checkpoint.py
+
+    np.save(lab_path, manifest.labels.astype(np.int32))
+    meta = {
+        "version": META_VERSION,
+        "image_size": list(image_size),
+        "img_dir": manifest.img_dir,
+        "synthetic": bool(synthetic),
+        "filenames": list(manifest.filenames),
+    }
+    with open(meta_path + ".tmp", "w") as f:
+        json.dump(meta, f)
+    os.replace(meta_path + ".tmp", meta_path)
+    return img_path
+
+
+class PackHandle:
+    """A resolved pack: the images mmap plus this shard's row mapping."""
+
+    def __init__(self, images: np.ndarray, rows: np.ndarray, meta: dict, stem: str):
+        self.images = images  # uint8 [N,H,W,3] memmap (whole pack)
+        self.rows = rows  # int64 [n_shard]: shard position -> pack row
+        self.meta = meta
+        self.stem = stem
+
+
+def find_pack(packed_dir: str, manifest, image_size, synthetic: bool) -> PackHandle:
+    """Resolve the pack in ``packed_dir`` covering ``manifest``: image size
+    and synthetic flag must match, and every shard filename must exist in the
+    pack (multi-host shards and DEBUG subsets resolve against a full-split
+    pack). Raises with the candidates' rejection reasons when nothing fits —
+    a configured packed_dir silently falling back to per-epoch decode would
+    hide exactly the cost the format removes."""
+    reasons = []
+    metas = sorted(
+        name for name in os.listdir(packed_dir) if name.endswith(".meta.json")
+    ) if os.path.isdir(packed_dir) else []
+    for name in metas:
+        stem = os.path.join(packed_dir, name[: -len(".meta.json")])
+        img_path, _, meta_path = _pack_paths(stem)
+        with open(meta_path) as f:
+            meta = json.load(f)
+        if meta.get("version") != META_VERSION:
+            reasons.append(f"{name}: version {meta.get('version')} != {META_VERSION}")
+            continue
+        if tuple(meta["image_size"]) != tuple(image_size):
+            reasons.append(f"{name}: image_size {meta['image_size']} != {list(image_size)}")
+            continue
+        if bool(meta["synthetic"]) != bool(synthetic):
+            reasons.append(f"{name}: synthetic={meta['synthetic']}")
+            continue
+        if not synthetic and meta["img_dir"] != manifest.img_dir:
+            reasons.append(f"{name}: img_dir {meta['img_dir']!r} != {manifest.img_dir!r}")
+            continue
+        index = {fn: i for i, fn in enumerate(meta["filenames"])}
+        try:
+            rows = np.asarray([index[fn] for fn in manifest.filenames], np.int64)
+        except KeyError as missing:
+            reasons.append(f"{name}: missing file {missing}")
+            continue
+        if synthetic:
+            # Synthetic images are FUNCTIONS of their labels (class-keyed
+            # patterns), so a pack whose stored labels disagree with the
+            # manifest (same filenames, different generation seed/classes)
+            # would silently serve images for the wrong classes. Real-JPEG
+            # packs skip this: images are file contents, and label mappings
+            # may legitimately differ (raw vs contiguous ids).
+            _, lab_path, _ = _pack_paths(stem)
+            if not np.array_equal(np.load(lab_path)[rows], manifest.labels):
+                reasons.append(f"{name}: synthetic pack labels disagree with manifest")
+                continue
+        images = np.load(img_path, mmap_mode="r")
+        if images.shape != (len(meta["filenames"]), *image_size, 3):
+            reasons.append(f"{name}: images shape {images.shape} inconsistent with meta")
+            continue
+        return PackHandle(images, rows, meta, stem)
+    raise FileNotFoundError(
+        f"packed_dir={packed_dir!r} has no pack covering this manifest "
+        f"(size {tuple(image_size)}, synthetic={synthetic}, "
+        f"{len(manifest)} files from {manifest.img_dir!r}). "
+        f"Candidates rejected: {reasons or 'none found'}. "
+        "Build packs with: python -m mpi_pytorch_tpu.data.packed "
+        f"--packed-dir {packed_dir} [config flags matching the run]"
+    )
+
+
+def main(argv=None) -> None:
+    from mpi_pytorch_tpu.config import parse_config
+    from mpi_pytorch_tpu.data.manifest import load_manifests
+
+    cfg = parse_config(argv)
+    if not cfg.packed_dir:
+        raise SystemExit("--packed-dir is required (where to write the packs)")
+    train_m, test_m = load_manifests(cfg)
+    for split, m in (("train", train_m), ("test", test_m)):
+        stem = os.path.join(
+            cfg.packed_dir, f"{split}_{cfg.image_size[0]}x{cfg.image_size[1]}"
+        )
+        path = write_pack(
+            m, cfg.image_size, stem,
+            synthetic=cfg.synthetic_data, num_workers=cfg.loader_workers,
+        )
+        print(
+            f"packed {split}: {len(m)} images -> {path} "
+            f"({os.path.getsize(path) / 1e6:.1f} MB)"
+        )
+
+
+if __name__ == "__main__":
+    main()

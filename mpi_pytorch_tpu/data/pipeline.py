@@ -121,6 +121,7 @@ class DataLoader:
         native_decode: bool = True,
         decode_prescale: int = 2,
         host_cache: bool = False,
+        packed_dir: str = "",
     ):
         self.manifest = manifest
         self.batch_size = batch_size
@@ -143,11 +144,20 @@ class DataLoader:
         self._cache_complete = False
         self._fill_thread: threading.Thread | None = None  # in-flight filler
         self._cache_fill_error: BaseException | None = None  # undelivered
+        # Offline-packed uint8 dataset (data/packed.py): batches become mmap
+        # row slices + a vectorized normalize — no decode at run time at all.
+        # Resolution is strict: a set packed_dir with no covering pack raises.
+        self.packed_dir = packed_dir
+        self._pack = None
+        if packed_dir:
+            from mpi_pytorch_tpu.data.packed import find_pack
+
+            self._pack = find_pack(packed_dir, manifest, image_size, synthetic)
         # Native C++ batched ingest (mpi_pytorch_tpu/native): one GIL-released
         # call decodes the whole batch on C threads. Auto-falls back to the
         # PIL thread pool when the toolchain/libjpeg is unavailable.
         self.native_decode = False
-        if native_decode and not synthetic:
+        if native_decode and not synthetic and self._pack is None:
             from mpi_pytorch_tpu import native as _native
 
             self.native_decode = _native.available()
@@ -185,8 +195,20 @@ class DataLoader:
         return normalize_image(decode_image(path, self.image_size))
 
     def _load_batch(self, idx: np.ndarray, pool: ThreadPoolExecutor) -> np.ndarray:
-        """Load a batch of images as normalized f32 [B,H,W,3]: one GIL-released
-        native call when available, else the PIL thread pool."""
+        """Load a batch of images as normalized f32 [B,H,W,3]: packed mmap
+        rows when a pack is resolved, else one GIL-released native call when
+        available, else the PIL thread pool."""
+        if self._pack is not None:
+            # uint8 rows / 255 reproduce decode_image's floats bit-for-bit
+            # (the pack stores PIL's resize output pre-float-conversion), and
+            # the in-place chain keeps the exact op order of normalize_image
+            # (same bits) with one allocation instead of four — this IS the
+            # packed path's hot loop, there's no decode to hide behind.
+            out = self._pack.images[self._pack.rows[idx]].astype(np.float32)
+            out /= 255.0
+            out -= _MEAN
+            out /= _STD
+            return out
         if self.native_decode:
             from mpi_pytorch_tpu import native
 
@@ -232,6 +254,8 @@ class DataLoader:
             and other.synthetic == self.synthetic
             and other.native_decode == self.native_decode
             and other.decode_prescale == self.decode_prescale
+            and (other._pack.stem if other._pack else None)
+            == (self._pack.stem if self._pack else None)
         ):
             self._cache_images = other._cache_images
             self._cache_complete = True

@@ -167,6 +167,7 @@ def build_training(cfg: Config, mesh=None):
         native_decode=cfg.native_decode,
         decode_prescale=cfg.decode_prescale,
         host_cache=cfg.host_cache,
+        packed_dir=cfg.packed_dir,
     )
 
     bundle, variables = create_model_bundle(
@@ -358,6 +359,7 @@ def build_device_cache(cfg: Config, loader: DataLoader, mesh):
         image_dtype=str(np.dtype(loader.image_dtype)),
         native_decode=loader.native_decode,
         decode_prescale=loader.decode_prescale,
+        packed_dir=loader.packed_dir,
     )
     # Preallocate and fill in place: np.concatenate over a parts list would
     # transiently hold the dataset twice, at exactly the scale (GBs) this
@@ -397,6 +399,7 @@ def make_eval_loader(cfg: Config, manifest, host_cache: bool = False) -> DataLoa
         native_decode=cfg.native_decode,
         decode_prescale=cfg.decode_prescale,
         host_cache=host_cache,
+        packed_dir=cfg.packed_dir,
     )
 
 

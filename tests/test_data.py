@@ -289,3 +289,124 @@ def test_host_cache_next_epoch_waits_for_backfill():
     assert dl._cache_complete
     assert dl._fill_thread is None or not dl._fill_thread.is_alive()
     assert len(batches) == 2
+
+
+def _jpeg_dataset(tmp_path, n=96, classes=8, size=48):
+    """Synthetic-JPEG dataset on disk + its (train, test) manifests."""
+    from mpi_pytorch_tpu.data.create_dataset import main as create_main
+
+    out = str(tmp_path / "data")
+    create_main(["--synthetic", str(n), "--num-classes", str(classes),
+                 "--image-size", str(size), "--out", out])
+    c = Config()
+    c.debug = False
+    c.train_csv = f"{out}/train_sample.csv"
+    c.test_csv = f"{out}/test_sample.csv"
+    c.train_img_dir = f"{out}/img/train"
+    c.test_img_dir = f"{out}/img/test"
+    c.synthetic_data = False
+    c.num_classes = classes
+    return c, load_manifests(c)
+
+
+def test_packed_dataset_matches_streaming_exactly(tmp_path):
+    """Packed batches must be BIT-identical to the streaming PIL decode path
+    (the pack stores PIL's resize output pre-float), including when a shard
+    resolves against the full-split pack by filename."""
+    from mpi_pytorch_tpu.data.packed import write_pack
+
+    _, (train_m, _) = _jpeg_dataset(tmp_path)
+    packed_dir = str(tmp_path / "packed")
+    write_pack(train_m, (32, 32), f"{packed_dir}/train_32x32", num_workers=2)
+
+    kw = dict(batch_size=8, image_size=(32, 32), shuffle=True, seed=7,
+              native_decode=False, num_workers=2)
+    streamed = list(DataLoader(train_m, **kw).epoch(0))
+    packed = list(DataLoader(train_m, packed_dir=packed_dir, **kw).epoch(0))
+    assert len(streamed) == len(packed) > 0
+    for (si, sl), (pi, pl) in zip(streamed, packed):
+        np.testing.assert_array_equal(sl, pl)
+        np.testing.assert_array_equal(si, pi)  # bit-for-bit, not allclose
+
+    shard = train_m.shard(2, 1)
+    s_shard = list(DataLoader(shard, **kw).epoch(0))
+    p_shard = list(DataLoader(shard, packed_dir=packed_dir, **kw).epoch(0))
+    for (si, _), (pi, _) in zip(s_shard, p_shard):
+        np.testing.assert_array_equal(si, pi)
+
+
+def test_packed_resolution_is_strict(tmp_path):
+    """A configured packed_dir with no covering pack must raise (silent
+    fallback to per-epoch decode would hide the cost the format removes)."""
+    from mpi_pytorch_tpu.data.packed import write_pack
+
+    _, (train_m, _) = _jpeg_dataset(tmp_path, n=48)
+    packed_dir = str(tmp_path / "packed")
+    write_pack(train_m, (32, 32), f"{packed_dir}/train_32x32", num_workers=2)
+    with pytest.raises(FileNotFoundError, match="image_size"):
+        DataLoader(train_m, batch_size=8, image_size=(16, 16),
+                   packed_dir=packed_dir)
+
+
+def test_packed_cli_then_train(tmp_path):
+    """The pack CLI writes both splits; the trainer consumes them through
+    --packed-dir end to end."""
+    import os
+
+    from mpi_pytorch_tpu.data.packed import main as pack_main
+    from mpi_pytorch_tpu.train.trainer import train
+
+    c, _ = _jpeg_dataset(tmp_path, n=64, classes=4)
+    packed_dir = str(tmp_path / "packed")
+    pack_main([
+        "--packed-dir", packed_dir, "--debug", "false",
+        "--train-csv", c.train_csv, "--test-csv", c.test_csv,
+        "--train-img-dir", c.train_img_dir, "--test-img-dir", c.test_img_dir,
+        "--synthetic-data", "false", "--num-classes", "4",
+        "--image-size", "32", "--loader-workers", "2",
+    ])
+    assert sorted(n for n in os.listdir(packed_dir) if n.endswith(".meta.json")) == [
+        "test_32x32.meta.json", "train_32x32.meta.json"
+    ]
+
+    c.packed_dir = packed_dir
+    c.batch_size = 16
+    c.width = c.height = 32
+    c.num_epochs = 1
+    c.compute_dtype = "float32"
+    c.validate = True
+    c.val_on_train = False  # resolves the test-split pack for validation
+    c.checkpoint_dir = str(tmp_path / "ckpt")
+    c.log_file = str(tmp_path / "training.log")
+    c.loader_workers = 2
+    c.log_every_steps = 0
+    c.validate_config()
+    summary = train(c)
+    assert summary.epochs_run == 1 and np.isfinite(summary.final_loss)
+    assert summary.val_accuracy is not None
+
+
+def test_packed_synthetic_label_mismatch_rejected(tmp_path):
+    """Synthetic images are functions of their labels, so a synthetic pack
+    whose stored labels disagree with the manifest must be rejected — it
+    would silently serve images of the wrong classes."""
+    from mpi_pytorch_tpu.data.packed import write_pack
+
+    m = _tiny_manifest(n=12, classes=3)
+    packed_dir = str(tmp_path / "packed")
+    write_pack(m, (16, 16), f"{packed_dir}/train_16x16", synthetic=True,
+               num_workers=2)
+    # Same filenames, shifted labels ≙ a regenerated dataset.
+    shifted = Manifest(
+        filenames=m.filenames,
+        labels=(m.labels + 1) % 3,
+        category_ids=m.category_ids,
+        img_dir=m.img_dir,
+    )
+    with pytest.raises(FileNotFoundError, match="labels disagree"):
+        DataLoader(shifted, batch_size=4, image_size=(16, 16), synthetic=True,
+                   packed_dir=packed_dir)
+    # The matching manifest still resolves.
+    dl = DataLoader(m, batch_size=4, image_size=(16, 16), synthetic=True,
+                    packed_dir=packed_dir)
+    assert dl._pack is not None
