@@ -104,7 +104,11 @@ class Config:
     compute_dtype: str = "bfloat16"  # MXU-native; params stay float32
     param_dtype: str = "float32"
     # host batch dtype: bfloat16 halves host→device transfer (the step casts
-    # to compute_dtype anyway); float32 preserves exact reference numerics.
+    # to compute_dtype anyway); float32 preserves exact reference numerics;
+    # uint8 ships RAW pixels (4x less H2D than f32, 4x smaller host/device
+    # caches, zero host float work on the packed path) and normalizes ON
+    # DEVICE (train/step.py ingest_images), where XLA fuses it into the
+    # first conv. uint8 disables the fused native C++ decode (PIL path).
     input_dtype: str = "float32"
     sync_batchnorm: bool = False  # reference keeps per-rank local BN stats (SURVEY §7)
     # spmd_mode=True uses the shard_map step with explicit collectives and
@@ -223,8 +227,10 @@ class Config:
             raise ValueError(f"learning_rate must be > 0, got {self.learning_rate}")
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"compute_dtype must be float32|bfloat16, got {self.compute_dtype}")
-        if self.input_dtype not in ("float32", "bfloat16"):
-            raise ValueError(f"input_dtype must be float32|bfloat16, got {self.input_dtype}")
+        if self.input_dtype not in ("float32", "bfloat16", "uint8"):
+            raise ValueError(
+                f"input_dtype must be float32|bfloat16|uint8, got {self.input_dtype}"
+            )
         if self.zero_optimizer and self.spmd_mode:
             raise ValueError(
                 "zero_optimizer shards Adam moments via the auto-partitioned "

@@ -153,11 +153,16 @@ class DataLoader:
             from mpi_pytorch_tpu.data.packed import find_pack
 
             self._pack = find_pack(packed_dir, manifest, image_size, synthetic)
+        # image_dtype 'uint8' = RAW-pixel batches (train/step.py ingest_images
+        # normalizes on device): 4x less H2D than f32, 4x smaller host cache;
+        # packed batches become plain mmap slices with no host float work.
+        self.raw_uint8 = image_dtype == "uint8"
         # Native C++ batched ingest (mpi_pytorch_tpu/native): one GIL-released
         # call decodes the whole batch on C threads. Auto-falls back to the
-        # PIL thread pool when the toolchain/libjpeg is unavailable.
+        # PIL thread pool when the toolchain/libjpeg is unavailable. (Its
+        # fused output is normalized f32, so raw-uint8 mode uses PIL.)
         self.native_decode = False
-        if native_decode and not synthetic and self._pack is None:
+        if native_decode and not synthetic and self._pack is None and not self.raw_uint8:
             from mpi_pytorch_tpu import native as _native
 
             self.native_decode = _native.available()
@@ -177,13 +182,19 @@ class DataLoader:
     def _load_one(self, i: int) -> np.ndarray:
         if self.synthetic:
             # Key the pattern by label so classes are separable. The pattern
-            # is a pure function of (label, size), so a bounded cache removes
-            # the host-side generation bottleneck (1 CPU core feeding a TPU).
-            key = (int(self.manifest.labels[i]), self.image_size)
+            # is a pure function of (label, size, dtype), so a bounded cache
+            # removes the host-side generation bottleneck (1 CPU core feeding
+            # a TPU). raw-uint8 mode caches the quantized pixels instead.
+            key = (int(self.manifest.labels[i]), self.image_size, self.raw_uint8)
             img = _SYNTH_CACHE.get(key)
             if img is None:
                 global _synth_cache_bytes
-                img = normalize_image(synthetic_image(*key))
+                if self.raw_uint8:
+                    from mpi_pytorch_tpu.data.packed import _synthetic_uint8
+
+                    img = _synthetic_uint8(key[0], self.image_size)
+                else:
+                    img = normalize_image(synthetic_image(key[0], self.image_size))
                 with _SYNTH_CACHE_LOCK:
                     if key not in _SYNTH_CACHE and (
                         _synth_cache_bytes + img.nbytes <= _SYNTH_CACHE_BUDGET
@@ -192,13 +203,25 @@ class DataLoader:
                         _synth_cache_bytes += img.nbytes
             return img
         path = os.path.join(self.manifest.img_dir, self.manifest.filenames[i])
+        if self.raw_uint8:
+            # Shared with the pack writer — the single point of truth that
+            # keeps pack ≡ streaming bit-identity for raw-uint8 batches.
+            from mpi_pytorch_tpu.data.packed import _decode_uint8
+
+            return _decode_uint8(path, self.image_size)
         return normalize_image(decode_image(path, self.image_size))
 
     def _load_batch(self, idx: np.ndarray, pool: ThreadPoolExecutor) -> np.ndarray:
-        """Load a batch of images as normalized f32 [B,H,W,3]: packed mmap
-        rows when a pack is resolved, else one GIL-released native call when
+        """Load a batch of images [B,H,W,3]: normalized f32, or RAW uint8
+        pixels in ``raw_uint8`` mode (normalization then happens on device,
+        train/step.py ``ingest_images``). Sources in order: packed mmap rows
+        when a pack is resolved, else one GIL-released native call when
         available, else the PIL thread pool."""
         if self._pack is not None:
+            if self.raw_uint8:
+                # The whole host pipeline collapses to an mmap row gather;
+                # normalize happens on device (step.ingest_images).
+                return self._pack.images[self._pack.rows[idx]]
             # uint8 rows / 255 reproduce decode_image's floats bit-for-bit
             # (the pack stores PIL's resize output pre-float-conversion), and
             # the in-place chain keeps the exact op order of normalize_image

@@ -410,3 +410,23 @@ def test_packed_synthetic_label_mismatch_rejected(tmp_path):
     dl = DataLoader(m, batch_size=4, image_size=(16, 16), synthetic=True,
                     packed_dir=packed_dir)
     assert dl._pack is not None
+
+
+def test_uint8_ingest_matches_host_normalize(tmp_path):
+    """input_dtype='uint8' batches + on-device normalize (step.ingest_images)
+    must equal the host-normalized float path exactly: same uint8 source,
+    same op order, f32 both ways."""
+    import jax.numpy as jnp
+
+    from mpi_pytorch_tpu.train.step import ingest_images
+
+    _, (train_m, _) = _jpeg_dataset(tmp_path, n=48)
+    kw = dict(batch_size=8, image_size=(32, 32), shuffle=False,
+              native_decode=False, num_workers=2)
+    f32_batches = list(DataLoader(train_m, **kw).epoch(0))
+    u8_batches = list(DataLoader(train_m, image_dtype="uint8", **kw).epoch(0))
+    assert u8_batches[0][0].dtype == np.uint8
+    for (fi, fl), (ui, ul) in zip(f32_batches, u8_batches):
+        np.testing.assert_array_equal(fl, ul)
+        on_device = np.asarray(ingest_images(jnp.asarray(ui), jnp.float32))
+        np.testing.assert_allclose(on_device, fi, rtol=0, atol=1e-6)

@@ -419,3 +419,42 @@ def test_config_rejects_ignored_optimizer_combos():
         make_optimizer(
             1e-2, lr_schedule="warmup_cosine", warmup_steps=200, total_steps=100
         )
+
+
+def test_uint8_input_matches_float_input(tmp_path):
+    """--input-dtype uint8 (raw pixels to device, normalize on chip) must
+    reproduce the float-input loss trajectory on a real-JPEG dataset — the
+    pixels are uint8 at the source, so the two paths see identical data."""
+    from mpi_pytorch_tpu.data.create_dataset import main as create_main
+
+    out = str(tmp_path / "data")
+    create_main(["--synthetic", "96", "--num-classes", "8", "--image-size", "48",
+                 "--out", out])
+    common = dict(
+        debug=True, debug_sample_size=64, synthetic_data=False, num_classes=8,
+        validate=True, val_on_train=True,
+    )
+    cfg_a = _tiny_cfg(os.path.join(str(tmp_path), "a"), **common)
+    cfg_b = _tiny_cfg(
+        os.path.join(str(tmp_path), "b"), **common, input_dtype="uint8"
+    )
+    for c in (cfg_a, cfg_b):
+        c.train_csv = f"{out}/train_sample.csv"
+        c.test_csv = f"{out}/test_sample.csv"
+        c.train_img_dir = f"{out}/img/train"
+        c.test_img_dir = f"{out}/img/test"
+    sa = train(cfg_a)
+    sb = train(cfg_b)
+    np.testing.assert_allclose(sa.epoch_losses, sb.epoch_losses, rtol=1e-4)
+    assert sa.val_accuracy == sb.val_accuracy
+
+
+def test_uint8_device_cache_matches_uint8_streaming(tmp_path):
+    """input_dtype='uint8' composed with device_cache: the HBM-resident
+    dataset is stored as raw uint8 (4x smaller) and normalized on device
+    after the index gather — trajectory must match uint8 streaming."""
+    kw = dict(num_epochs=2, num_classes=200, debug_sample_size=96,
+              drop_remainder=False, input_dtype="uint8")
+    sa = train(_tiny_cfg(os.path.join(str(tmp_path), "a"), **kw))
+    sb = train(_tiny_cfg(os.path.join(str(tmp_path), "b"), **kw, device_cache=True))
+    np.testing.assert_allclose(sa.epoch_losses, sb.epoch_losses, rtol=1e-4)
