@@ -1,0 +1,24 @@
+#!/bin/bash
+# One-shot chip benchmark battery — run when the TPU relay is healthy.
+# Each stage is independently watchdogged (bench.py backend watchdog,
+# bench_zoo per-model child timeout, bench_flags per-set child timeout),
+# so a relay wedge mid-battery leaves error rows, not a hang.
+#
+# Usage: bash tools/run_chip_benches.sh [outdir]   (default docs/)
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-docs}"
+
+echo "== headline bench.py =="
+python bench.py | tee "$OUT/bench_latest.json"
+
+echo "== full-zoo sweep (watchdogged children) =="
+python tools/bench_zoo.py --out "$OUT/zoo_bench.json"
+
+echo "== XLA-flag MFU sweep =="
+python tools/bench_flags.py | tee "$OUT/flags_sweep.txt"
+
+echo "== inference bench =="
+python tools/bench_eval.py | tee "$OUT/eval_bench.json" || true
+
+echo "done — update docs/RESULTS.md §3b/§4/§4c from these artifacts"
