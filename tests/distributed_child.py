@@ -118,6 +118,48 @@ def main() -> None:
     losses = " ".join(f"{l:.6f}" for l in summary.epoch_losses)
     print(f"TRAIN_OK {losses} acc {summary.val_accuracy:.4f}", flush=True)
 
+    # Multi-host agreed preemption: ONLY process 1 receives SIGTERM (a
+    # watcher raises it in-process once its own log shows epoch 0 done);
+    # process 0 must stop too — purely through the epoch-boundary all-reduce
+    # of the signal flags (trainer._stop_agreed). Both must agree on the
+    # epoch count and report preempted.
+    import signal
+    import threading
+
+    log_path = os.path.join(scratch, f"preempt_{jax.process_index()}.log")
+    cfg2 = Config(
+        model_name="resnet18", num_classes=1000, batch_size=8, num_epochs=50,
+        debug=True, debug_sample_size=29, synthetic_data=True,
+        host_cache=True, drop_remainder=True, compute_dtype="float32",
+        width=32, height=32, validate=False,
+        checkpoint_every_epochs=0, log_every_steps=0, metrics_file="",
+        log_file=log_path,
+        checkpoint_dir=os.path.join(scratch, "ckpt_preempt"),
+    )
+    cfg2.validate_config()
+
+    if jax.process_index() == 1:
+
+        def fire_when_running() -> None:
+            import time
+
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                try:
+                    if "Epoch: 0," in open(log_path).read():
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.1)
+            signal.raise_signal(signal.SIGTERM)
+
+        threading.Thread(target=fire_when_running, daemon=True).start()
+
+    summary2 = train(cfg2)
+    assert summary2.preempted, "both processes must report the agreed stop"
+    assert 0 < summary2.epochs_run < 50, summary2.epochs_run
+    print(f"PREEMPT_OK {summary2.epochs_run}", flush=True)
+
 
 if __name__ == "__main__":
     main()

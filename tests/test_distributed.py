@@ -80,3 +80,13 @@ def test_two_process_distributed_train_step(tmp_path):
     ]
     assert len(train_lines) == 2, outs
     assert train_lines[0] == train_lines[1], train_lines
+    # Agreed preemption: only process 1 was signaled; process 0 stopped via
+    # the epoch-boundary all-reduce, and both agree on the epoch count.
+    preempt_lines = [
+        line
+        for out in outs
+        for line in out.splitlines()
+        if line.startswith("PREEMPT_OK")
+    ]
+    assert len(preempt_lines) == 2, outs
+    assert preempt_lines[0] == preempt_lines[1], preempt_lines
