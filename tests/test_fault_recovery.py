@@ -32,8 +32,8 @@ def _trainer_args(tmp_path, **overrides) -> list[str]:
     return [tok for pair in defaults.items() for tok in pair]
 
 
-def _launch_training(args: list[str]) -> subprocess.Popen:
-    """Spawn the CLI trainer on an 8-virtual-device CPU world."""
+def _launch_training(args: list[str], device_count: int = 8) -> subprocess.Popen:
+    """Spawn the CLI trainer on a ``device_count``-virtual-device CPU world."""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
@@ -42,7 +42,9 @@ def _launch_training(args: list[str]) -> subprocess.Popen:
         f for f in env.get("XLA_FLAGS", "").split()
         if "xla_force_host_platform_device_count" not in f
     ]
-    env["XLA_FLAGS"] = " ".join(flags + ["--xla_force_host_platform_device_count=8"])
+    env["XLA_FLAGS"] = " ".join(
+        flags + [f"--xla_force_host_platform_device_count={device_count}"]
+    )
     return subprocess.Popen(
         [sys.executable, "-m", "mpi_pytorch_tpu.train", *args],
         env=env, cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
@@ -182,3 +184,33 @@ def test_sigterm_graceful_preemption_then_resume(tmp_path):
     )
     summary = train(cfg)
     assert summary.epochs_run == 2 and not summary.preempted
+
+
+@pytest.mark.slow
+def test_resume_on_different_world_size(tmp_path):
+    """Checkpoints are world-size independent: a run on 8 devices (ZeRO-
+    sharded moments included) resumes cleanly on a 4-device world — the
+    shrunk-fleet restart a preemptible environment needs. The snapshot
+    gather stores replicated arrays, and restore re-shards onto whatever
+    mesh exists."""
+    args = _trainer_args(
+        tmp_path, **{"--num-epochs": "2", "--zero-optimizer": "true"}
+    )
+    log_file = str(tmp_path / "training.log")
+    proc = _launch_training(args, device_count=8)
+    assert proc.wait(timeout=300) == 0
+
+    proc = _launch_training(
+        args + ["--from-checkpoint", "true", "--num-epochs", "4"],
+        device_count=4,
+    )
+    assert proc.wait(timeout=300) == 0
+    log = open(log_file).read()
+    assert "resumed from" in log
+    assert "8 device(s)" in log and "4 device(s)" in log
+    completed = [
+        int(line.split("Epoch: ")[1].split(",")[0])
+        for line in log.splitlines()
+        if "Epoch: " in line
+    ]
+    assert completed == [0, 1, 2, 3]  # epochs 2-3 ran on the 4-device world
