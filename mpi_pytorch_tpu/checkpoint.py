@@ -99,13 +99,49 @@ def save_checkpoint(
 
 
 def _cleanup(ckpt_dir: str, keep: int) -> None:
+    """Last-k retention — except the best-marked checkpoint (``best.json``),
+    which survives however old it gets (≙ the reference's *intended*
+    ``is_best``/``best_model_dir`` machinery, accepted-and-ignored at
+    ``helpers.py:4-7``)."""
+    best = best_marker(ckpt_dir)
+    pinned = os.path.basename(best["checkpoint"]) if best else None
     ckpts = sorted(
         (m.group(1), name)
         for name in os.listdir(ckpt_dir)
         if (m := _CKPT_RE.search(name))
     )
     for _, name in ckpts[:-keep] if keep > 0 else []:
-        os.remove(os.path.join(ckpt_dir, name))
+        if name != pinned:
+            os.remove(os.path.join(ckpt_dir, name))
+
+
+def best_marker(ckpt_dir: str) -> dict | None:
+    """Read ``best.json`` ({epoch, accuracy, checkpoint}) if present."""
+    import json
+
+    path = os.path.join(ckpt_dir, "best.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_best_marker(ckpt_dir: str, *, epoch: int, accuracy: float, ckpt_path: str) -> None:
+    """Atomically point ``best.json`` at the best-validation checkpoint
+    (process 0 only)."""
+    import json
+
+    if process_index() != 0:
+        return
+    path = os.path.join(ckpt_dir, "best.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(
+            {"epoch": epoch, "accuracy": accuracy,
+             "checkpoint": os.path.basename(ckpt_path)},
+            f,
+        )
+    os.replace(tmp, path)
 
 
 def latest_checkpoint(ckpt_dir: str) -> str | None:
@@ -163,7 +199,14 @@ class AsyncCheckpointer:
         self._error: BaseException | None = None
 
     def save(
-        self, ckpt_dir: str, *, epoch: int, state: Any, loss: float, keep: int = 3
+        self,
+        ckpt_dir: str,
+        *,
+        epoch: int,
+        state: Any,
+        loss: float,
+        keep: int = 3,
+        on_durable=None,
     ) -> str | None:
         """Snapshot now, write in the background; returns the path that will
         exist once the write completes (None on processes > 0).
@@ -187,6 +230,11 @@ class AsyncCheckpointer:
         def _worker() -> None:
             try:
                 _write_atomic(ckpt_dir, path, _payload_from(snapshot, epoch, loss), keep)
+                if on_durable is not None:
+                    # Runs strictly AFTER the atomic rename: anything the
+                    # callback publishes (e.g. the best.json marker) can
+                    # never reference a file that doesn't exist yet.
+                    on_durable(path)
             except BaseException as e:  # surfaced on the next save()/wait()
                 self._error = e
 

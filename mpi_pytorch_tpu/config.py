@@ -198,6 +198,14 @@ class Config:
     # --- checkpoint ---
     keep_checkpoints: int = 3
     checkpoint_every_epochs: int = 1
+    # Track the best-validation checkpoint: on a val-accuracy improvement the
+    # epoch's checkpoint is dispatched (even when the periodic save isn't
+    # due) and best.json points at it; retention never deletes it; evaluate
+    # --use-best consumes it. This is the reference's accepted-and-ignored
+    # is_best/best_model_dir surface (helpers.py:4-7), implemented.
+    track_best: bool = False
+    # Evaluation: load the best.json checkpoint instead of the latest.
+    use_best: bool = False
 
     # --- observability ---
     log_file: str = "training.log"
@@ -236,6 +244,11 @@ class Config:
                 "zero_optimizer shards Adam moments via the auto-partitioned "
                 "jit step; the spmd_mode shard_map step replicates its state "
                 "specs, so the two do not compose"
+            )
+        if self.track_best and not self.validate:
+            raise ValueError(
+                "track_best needs validation accuracy to rank checkpoints "
+                "(set validate=True, or drop track_best)"
             )
         if self.fsdp and self.spmd_mode:
             raise ValueError(

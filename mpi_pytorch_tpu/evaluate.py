@@ -25,6 +25,7 @@ per-image ``model(image[None])`` forward becomes a full-batch MXU matmul.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 
@@ -91,6 +92,19 @@ def evaluate(cfg: Config) -> EvalSummary:
     mesh, bundle, state, test_manifest = build_inference(cfg)
 
     latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
+    if cfg.use_best:
+        # Best-validation checkpoint (train --track-best), not merely the
+        # newest — the reference's intended is_best machinery (helpers.py:4-7).
+        marker = ckpt.best_marker(cfg.checkpoint_dir)
+        if marker is None:
+            raise FileNotFoundError(
+                f"use_best=True but no best.json in {cfg.checkpoint_dir} "
+                "(train with --track-best true --validate true)"
+            )
+        latest = os.path.join(cfg.checkpoint_dir, marker["checkpoint"])
+        logger.info(
+            "best checkpoint: epoch %d, val acc %.4f", marker["epoch"], marker["accuracy"]
+        )
     if latest:
         # ≙ predictor ranks loading the trained checkpoint
         # (evaluation_pipeline.py:142-144); params/batch_stats only.

@@ -57,6 +57,7 @@ class TrainSummary:
     checkpoint_path: str | None = None
     epoch_losses: list = field(default_factory=list)
     preempted: bool = False
+    best_accuracy: float | None = None  # track_best: best val acc this run
 
 
 class PreemptionGuard:
@@ -588,6 +589,10 @@ def train(cfg: Config) -> TrainSummary:
     # to the previous handler — the escape hatch if the drain itself wedges.
     guard = PreemptionGuard()
     last_saved_epoch = -1
+    # A resumed run must not demote a better historical best (best.json
+    # survives restarts; missing marker → any first accuracy wins).
+    _marker = ckpt.best_marker(cfg.checkpoint_dir) if cfg.track_best else None
+    best_accuracy = _marker["accuracy"] if _marker else float("-inf")
     with guard:
       try:
         for epoch in range(start_epoch, cfg.num_epochs):
@@ -763,6 +768,40 @@ def train(cfg: Config) -> TrainSummary:
                 summary.val_accuracy = acc
                 logger.info("Accuracy of the network: %.4f (val_on_train=%s)", acc, cfg.val_on_train)
                 metrics.write({"kind": "val", "epoch": epoch, "accuracy": acc, "loss": vloss})
+
+                if cfg.track_best and acc > best_accuracy:
+                    # acc is globally reduced, so every process agrees on the
+                    # improvement; any save below is a global snapshot every
+                    # process must run (only process 0 writes files/markers).
+                    # The marker is published strictly AFTER the checkpoint
+                    # file is durable — a crash mid-write must never leave
+                    # best.json naming a file that doesn't exist.
+                    best_accuracy = acc
+                    summary.best_accuracy = acc
+
+                    def _mark_best(ckpt_path, *, _epoch=epoch, _acc=acc):
+                        ckpt.write_best_marker(
+                            cfg.checkpoint_dir, epoch=_epoch, accuracy=_acc,
+                            ckpt_path=ckpt_path,
+                        )
+
+                    if last_saved_epoch == epoch:
+                        # This epoch's periodic save is already in flight:
+                        # join it (bounded — validation usually outlasts the
+                        # write anyway), then mark. `path` is that save's
+                        # return (this epoch's file on process 0).
+                        checkpointer.wait()
+                        _mark_best(path)
+                    else:
+                        best_path = checkpointer.save(
+                            cfg.checkpoint_dir, epoch=epoch, state=state,
+                            loss=epoch_loss, keep=cfg.keep_checkpoints,
+                            on_durable=_mark_best,
+                        )
+                        last_saved_epoch = epoch
+                        if best_path:
+                            summary.checkpoint_path = best_path
+                    logger.info("new best: val acc %.4f at epoch %d", acc, epoch)
 
       except BaseException:
         # Drain the in-flight write on the failure path too, but never let a

@@ -458,3 +458,46 @@ def test_uint8_device_cache_matches_uint8_streaming(tmp_path):
     sa = train(_tiny_cfg(os.path.join(str(tmp_path), "a"), **kw))
     sb = train(_tiny_cfg(os.path.join(str(tmp_path), "b"), **kw, device_cache=True))
     np.testing.assert_allclose(sa.epoch_losses, sb.epoch_losses, rtol=1e-4)
+
+
+def test_track_best_pins_checkpoint_and_eval_uses_it(tmp_path):
+    """--track-best: best.json points at the best-validation epoch, retention
+    (keep=1) never deletes that file even as newer checkpoints churn past it,
+    a resumed run won't demote the stored best, and evaluate --use-best loads
+    exactly the marked checkpoint."""
+    from mpi_pytorch_tpu import checkpoint as ckpt
+
+    cfg = _tiny_cfg(
+        str(tmp_path), num_epochs=4, num_classes=200, validate=True,
+        track_best=True, keep_checkpoints=1, learning_rate=1e-3,
+    )
+    summary = train(cfg)
+    marker = ckpt.best_marker(cfg.checkpoint_dir)
+    assert marker is not None
+    assert marker["accuracy"] == summary.best_accuracy
+    best_path = os.path.join(cfg.checkpoint_dir, marker["checkpoint"])
+    assert os.path.exists(best_path), "retention must pin the best checkpoint"
+
+    # The marker is the max over epochs: at least as good as the final
+    # epoch's accuracy (equality when the last epoch is the best).
+    assert summary.best_accuracy >= summary.val_accuracy
+    assert marker["epoch"] <= 3
+
+    # A resumed run starting from the stored best must not demote it.
+    cfg2 = _tiny_cfg(
+        str(tmp_path), num_epochs=5, num_classes=200, validate=True,
+        track_best=True, keep_checkpoints=1, from_checkpoint=True,
+    )
+    train(cfg2)
+    marker2 = ckpt.best_marker(cfg.checkpoint_dir)
+    assert marker2["accuracy"] >= marker["accuracy"]
+
+    # evaluate --use-best loads the marked file (log records the epoch).
+    cfg3 = _tiny_cfg(str(tmp_path), num_classes=200, use_best=True)
+    res = evaluate(cfg3)
+    assert 0.0 <= res.accuracy <= 1.0
+
+
+def test_track_best_requires_validation():
+    with pytest.raises(ValueError, match="track_best"):
+        Config(track_best=True, validate=False).validate_config()
