@@ -501,3 +501,43 @@ def test_track_best_pins_checkpoint_and_eval_uses_it(tmp_path):
 def test_track_best_requires_validation():
     with pytest.raises(ValueError, match="track_best"):
         Config(track_best=True, validate=False).validate_config()
+
+
+def test_full_fast_path_stack_matches_streaming(tmp_path):
+    """The whole TPU-first ingest stack composed — offline pack, raw-uint8
+    feeding, HBM-resident device cache, one-scan-per-epoch — must reproduce
+    the plain f32 streaming trajectory on a real-JPEG dataset (uint8 source,
+    so every path sees identical pixels)."""
+    from mpi_pytorch_tpu.data.create_dataset import main as create_main
+    from mpi_pytorch_tpu.data.packed import main as pack_main
+
+    out = str(tmp_path / "data")
+    create_main(["--synthetic", "96", "--num-classes", "8", "--image-size", "48",
+                 "--out", out])
+    data_args = dict(
+        debug=True, debug_sample_size=64, synthetic_data=False, num_classes=8,
+    )
+
+    def with_dataset(cfg):
+        cfg.train_csv = f"{out}/train_sample.csv"
+        cfg.test_csv = f"{out}/test_sample.csv"
+        cfg.train_img_dir = f"{out}/img/train"
+        cfg.test_img_dir = f"{out}/img/test"
+        return cfg
+
+    packed_dir = str(tmp_path / "packed")
+    pack_main([
+        "--packed-dir", packed_dir, "--debug", "true", "--debug-sample-size", "64",
+        "--test-csv", f"{out}/test_sample.csv", "--train-csv", f"{out}/train_sample.csv",
+        "--train-img-dir", f"{out}/img/train", "--test-img-dir", f"{out}/img/test",
+        "--synthetic-data", "false", "--num-classes", "8",
+        "--image-size", "32", "--loader-workers", "2",
+    ])
+
+    sa = train(with_dataset(_tiny_cfg(os.path.join(str(tmp_path), "a"), **data_args)))
+    sb = train(with_dataset(_tiny_cfg(
+        os.path.join(str(tmp_path), "b"), **data_args,
+        packed_dir=packed_dir, input_dtype="uint8",
+        device_cache=True, scan_epoch=True,
+    )))
+    np.testing.assert_allclose(sa.epoch_losses, sb.epoch_losses, rtol=1e-4)
