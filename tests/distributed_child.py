@@ -132,6 +132,9 @@ def main() -> None:
         debug=True, debug_sample_size=29, synthetic_data=True,
         host_cache=True, drop_remainder=True, compute_dtype="float32",
         width=32, height=32, validate=False,
+        # FSDP across REAL process boundaries: params sharded over the
+        # 8-device data axis that spans both processes.
+        fsdp=True,
         checkpoint_every_epochs=0, log_every_steps=0, metrics_file="",
         log_file=log_path,
         checkpoint_dir=os.path.join(scratch, "ckpt_preempt"),
