@@ -206,6 +206,12 @@ class Config:
     track_best: bool = False
     # Evaluation: load the best.json checkpoint instead of the latest.
     use_best: bool = False
+    # Evaluation: also write per-image predictions as CSV
+    # (file_name, predicted_label, predicted_category_id) — the Herbarium
+    # task's actual deliverable (a submission file), which the reference's
+    # pipeline computes per-image but never persists
+    # (evaluation_pipeline.py:149-158). "" disables. Single-process.
+    predictions_file: str = ""
 
     # --- observability ---
     log_file: str = "training.log"

@@ -25,6 +25,7 @@ per-image ``model(image[None])`` forward becomes a full-batch MXU matmul.
 
 from __future__ import annotations
 
+import functools
 import os
 import time
 from dataclasses import dataclass
@@ -52,12 +53,14 @@ class EvalSummary:
     images_per_sec: float
 
 
-def build_inference(cfg: Config, mesh=None):
+def build_inference(cfg: Config, mesh=None, manifests=None):
     """Inference-only construction: model + params, no optimizer moments, no
     train-split loader — the predictor-rank setup (``evaluation_pipeline.py:
-    132-144``) without the training baggage ``build_training`` carries."""
+    132-144``) without the training baggage ``build_training`` carries.
+    ``manifests``: pre-loaded (train, test) pair, so callers that need both
+    splits (the predictions pass's label map) parse the CSVs only once."""
     mesh = mesh or create_mesh(cfg.mesh)
-    _, test_manifest = load_manifests(cfg)
+    _, test_manifest = manifests or load_manifests(cfg)
     compute_dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[cfg.compute_dtype]
     bundle, variables = create_model_bundle(
         cfg.model_name,
@@ -88,8 +91,14 @@ def evaluate(cfg: Config) -> EvalSummary:
 
     maybe_initialize_distributed()
     apply_runtime_flags(cfg)
+    if cfg.predictions_file and jax.process_count() > 1:
+        # Fail BEFORE any compute (matching validate_config's fail-early
+        # discipline): the predictions pass runs the whole manifest on one
+        # host's chips.
+        raise ValueError("predictions_file is single-process (run it on one host)")
     logger = init_logger("MPT_EVAL", cfg.eval_log_file)
-    mesh, bundle, state, test_manifest = build_inference(cfg)
+    manifests = load_manifests(cfg)
+    mesh, bundle, state, test_manifest = build_inference(cfg, manifests=manifests)
 
     latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
     if cfg.use_best:
@@ -118,7 +127,13 @@ def evaluate(cfg: Config) -> EvalSummary:
     state = place_state_on_mesh(state, mesh)
 
     t0 = time.perf_counter()
-    acc, mean_loss = evaluate_manifest(cfg, state, mesh, test_manifest)
+    if cfg.predictions_file:
+        # One pass produces both the metrics and the submission CSV.
+        acc, mean_loss = evaluate_with_predictions(
+            cfg, state, mesh, manifests[0], test_manifest, logger
+        )
+    else:
+        acc, mean_loss = evaluate_manifest(cfg, state, mesh, test_manifest)
     wall = time.perf_counter() - t0
     n = len(test_manifest)
     # ≙ rank-0 final accuracy log (evaluation_pipeline.py:198-199)
@@ -135,6 +150,67 @@ def evaluate(cfg: Config) -> EvalSummary:
         wall_s=wall,
         images_per_sec=n / wall if wall > 0 else 0.0,
     )
+
+
+@functools.lru_cache(maxsize=None)
+def _make_predict_step(compute_dtype):
+    """ONE batched forward yielding both the eval metrics and the per-image
+    argmax — predictions and accuracy come from the same pass (the
+    reference's predictor ranks compute the per-image argmax and discard it,
+    ``evaluation_pipeline.py:149-158``)."""
+    from mpi_pytorch_tpu.train.step import eval_logits, metrics_from_logits
+
+    @jax.jit
+    def predict(state, batch):
+        images, labels = batch
+        logits = eval_logits(state, images, compute_dtype)
+        return metrics_from_logits(logits, labels), jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    return predict
+
+
+def evaluate_with_predictions(
+    cfg: Config, state, mesh, train_manifest, test_manifest, logger
+) -> tuple[float, float]:
+    """One pass over the test manifest: accuracy/loss AND a predictions CSV
+    (file_name, predicted_label, predicted_category_id) in manifest order —
+    the submission file the Herbarium task actually wants. The filename key
+    mirrors ``GetData`` returning ``(tensor, fname)`` for the test split
+    (``data_loader.py:36-39``). Returns (accuracy, mean_loss)."""
+    import numpy as np
+
+    from mpi_pytorch_tpu.parallel.mesh import shard_batch
+    from mpi_pytorch_tpu.train.trainer import make_eval_loader, pad_batch
+
+    loader = make_eval_loader(cfg, test_manifest)  # shard(1, 0) = identity
+    compute_dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[cfg.compute_dtype]
+    predict = _make_predict_step(compute_dtype)
+    preds: list = []
+    loss_sum = correct = count = 0.0
+    for images, labels in loader.epoch(0):
+        batch = shard_batch(pad_batch(images, labels, loader.batch_size), mesh)
+        m, p = predict(state, batch)
+        preds.append(np.asarray(p))
+        loss_sum += float(m["loss"])
+        correct += int(m["correct"])
+        count += int(m["count"])
+    labels_pred = np.concatenate(preds)[: len(test_manifest)]  # drop tail padding
+
+    # Contiguous label -> raw Herbarium category_id, from BOTH splits (the
+    # label map was built over both, data/manifest.py build_label_map).
+    label_to_cat: dict[int, int] = {}
+    for m in (train_manifest, test_manifest):
+        label_to_cat.update(zip(m.labels.tolist(), m.category_ids.tolist()))
+
+    tmp = cfg.predictions_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("file_name,predicted_label,predicted_category_id\n")
+        for fname, p in zip(test_manifest.filenames, labels_pred.tolist()):
+            f.write(f"{fname},{p},{label_to_cat.get(p, -1)}\n")
+    os.replace(tmp, cfg.predictions_file)
+    logger.info("predictions written: %s (%d rows)", cfg.predictions_file, len(labels_pred))
+    acc = correct / count if count else 0.0
+    return acc, (loss_sum / count if count else float("nan"))
 
 
 def main(argv=None) -> EvalSummary:

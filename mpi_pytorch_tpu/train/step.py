@@ -343,23 +343,34 @@ def make_cached_eval_step(mesh, compute_dtype=jnp.bfloat16) -> Callable:
     return cached_eval_step
 
 
-def _eval_metrics(state: TrainState, images, labels, compute_dtype):
-    """Shared eval math of the streaming and cached eval steps."""
+def eval_logits(state: TrainState, images, compute_dtype):
+    """Eval forward with the pinned f32 boundary.
+
+    The barrier pins a real f32 boundary: without it XLA fuses the upcast
+    into the softmax chain and evaluates logsumexp at bf16 precision, which
+    yields per-example CE errors of ±3e-3 — enough to report (impossible)
+    negative eval losses on a converged model (measured: batch loss-sums off
+    by ±0.4 vs the eager computation)."""
+    logits = state.apply_fn(state.variables, ingest_images(images, compute_dtype), train=False)
+    return lax.optimization_barrier(logits.astype(jnp.float32))
+
+
+def metrics_from_logits(logits, labels):
+    """loss-sum / correct / count from f32 logits (labels < 0 = padding) —
+    shared by the eval steps and the evaluate-driver predictions pass."""
     valid = labels >= 0
     safe_labels = jnp.maximum(labels, 0)
-    logits = state.apply_fn(state.variables, ingest_images(images, compute_dtype), train=False)
-    # The barrier pins a real f32 boundary: without it XLA fuses the
-    # upcast into the softmax chain and evaluates logsumexp at bf16
-    # precision, which yields per-example CE errors of ±3e-3 — enough to
-    # report (impossible) negative eval losses on a converged model
-    # (measured: batch loss-sums off by ±0.4 vs the eager computation).
-    logits = lax.optimization_barrier(logits.astype(jnp.float32))
     per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, safe_labels)
     return {
         "loss": jnp.sum(per_ex * valid),
         "correct": jnp.sum((jnp.argmax(logits, axis=-1) == labels) & valid),
         "count": jnp.sum(valid.astype(jnp.int32)),
     }
+
+
+def _eval_metrics(state: TrainState, images, labels, compute_dtype):
+    """Shared eval math of the streaming and cached eval steps."""
+    return metrics_from_logits(eval_logits(state, images, compute_dtype), labels)
 
 
 @functools.lru_cache(maxsize=None)

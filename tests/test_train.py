@@ -541,3 +541,29 @@ def test_full_fast_path_stack_matches_streaming(tmp_path):
         device_cache=True, scan_epoch=True,
     )))
     np.testing.assert_allclose(sa.epoch_losses, sb.epoch_losses, rtol=1e-4)
+
+
+def test_predictions_file_matches_reported_accuracy(tmp_path):
+    """evaluate --predictions-file writes one row per test image in manifest
+    order; the fraction of rows whose predicted_category_id equals the true
+    category reproduces the reported accuracy exactly — the submission-file
+    capability the reference's predictor ranks compute per-image but never
+    persist (evaluation_pipeline.py:149-158)."""
+    cfg = _tiny_cfg(str(tmp_path), num_epochs=2, num_classes=200,
+                    debug_sample_size=160, learning_rate=1e-3)
+    train(cfg)
+    pred_path = os.path.join(str(tmp_path), "predictions.csv")
+    cfg.predictions_file = pred_path
+    res = evaluate(cfg)
+
+    from mpi_pytorch_tpu.data import load_manifests
+
+    _, test_m = load_manifests(cfg)
+    rows = open(pred_path).read().strip().splitlines()
+    assert rows[0] == "file_name,predicted_label,predicted_category_id"
+    body = [r.split(",") for r in rows[1:]]
+    assert [b[0] for b in body] == list(test_m.filenames)  # manifest order
+    correct = sum(
+        int(b[2]) == int(c) for b, c in zip(body, test_m.category_ids)
+    )
+    assert correct / len(body) == pytest.approx(res.accuracy, abs=1e-9)
