@@ -26,6 +26,7 @@ SUPPORTED_MODELS = (
     "densenet121",
     "inception_v3",
     "mobilenet_v2",
+    "efficientnet_b0",
     "vit_s16",
     "vit_b16",
     "vit_moe_s16",

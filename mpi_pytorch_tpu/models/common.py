@@ -25,15 +25,17 @@ def batch_norm(
     *,
     dtype: Dtype = jnp.float32,
     axis_name: str | None = None,
+    eps: float = BN_EPS,
 ) -> nn.BatchNorm:
     """BatchNorm matching torch defaults. ``axis_name=None`` keeps per-replica
     local batch statistics — the reference's data-parallel semantics (only
     grads are synced, ``mpi_tools.py:30-37``; SURVEY §7 'BatchNorm under DP').
-    Pass the mesh data axis name to opt into sync-BN."""
+    Pass the mesh data axis name to opt into sync-BN. ``eps`` for families
+    that deviate from torch's 1e-5 default (efficientnet uses 1e-3)."""
     return nn.BatchNorm(
         use_running_average=None,  # caller passes via __call__
         momentum=BN_MOMENTUM,
-        epsilon=BN_EPS,
+        epsilon=eps,
         dtype=dtype,
         axis_name=axis_name,
         name=name,

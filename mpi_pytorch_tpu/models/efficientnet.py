@@ -1,0 +1,165 @@
+"""EfficientNet-B0 in Flax (NHWC, TPU-native) — beyond-parity zoo member.
+
+The reference zoo stops at its seven torchvision CNNs (``models.py:16-101``).
+EfficientNet-B0 adds the compound-scaled MBConv family: squeeze-excitation
+(the zoo's only channel-attention op), SiLU activations, per-sample
+stochastic depth, and 5×5 depthwise kernels. Architecture per the public
+EfficientNet paper / torchvision's ``efficientnet_b0``: stem 3×3 s2 → 32ch,
+MBConv settings [(1,16,1,1,3), (6,24,2,2,3), (6,40,2,2,5), (6,80,3,2,3),
+(6,112,3,1,5), (6,192,4,2,5), (6,320,1,1,3)] (expand, channels, repeats,
+stride, kernel), SE squeeze = input_channels/4, head conv 1280, dropout 0.2,
+BN eps 1e-3, stochastic-depth rate 0.2 scaled linearly over block depth.
+Parameter count matches torchvision's efficientnet_b0 (5,288,548 at 1000
+classes; asserted in tests/test_efficientnet.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mpi_pytorch_tpu.models.common import batch_norm, global_avg_pool
+
+_BN_EPS = 1e-3  # efficientnet's BN epsilon (torch default is 1e-5)
+
+# (expansion t, out channels c, repeats n, first stride s, kernel k)
+_SETTINGS = (
+    (1, 16, 1, 1, 3),
+    (6, 24, 2, 2, 3),
+    (6, 40, 2, 2, 5),
+    (6, 80, 3, 2, 3),
+    (6, 112, 3, 1, 5),
+    (6, 192, 4, 2, 5),
+    (6, 320, 1, 1, 3),
+)
+_DROP_PATH_RATE = 0.2  # final stochastic-depth rate; scaled by block index
+
+
+class SqueezeExcite(nn.Module):
+    """SE channel attention: global pool → reduce 1×1 → SiLU → expand 1×1 →
+    sigmoid gate. Squeeze width comes from the BLOCK INPUT channels (÷4),
+    not the expanded width — the efficientnet convention."""
+
+    squeeze: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = nn.Conv(
+            self.squeeze, (1, 1), dtype=self.dtype, param_dtype=self.param_dtype,
+            name="reduce",
+        )(s)
+        s = nn.silu(s)
+        s = nn.Conv(
+            x.shape[-1], (1, 1), dtype=self.dtype, param_dtype=self.param_dtype,
+            name="expand",
+        )(s)
+        return x * nn.sigmoid(s)
+
+
+class MBConv(nn.Module):
+    features: int
+    stride: int
+    expand: int
+    kernel: int
+    se_squeeze: int
+    drop_rate: float
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        in_ch = x.shape[-1]
+        hidden = in_ch * self.expand
+        bn = lambda name: batch_norm(
+            name, dtype=self.dtype, axis_name=self.bn_axis_name, eps=_BN_EPS
+        )
+        y = x
+        if self.expand != 1:
+            y = nn.Conv(
+                hidden, (1, 1), use_bias=False, dtype=self.dtype,
+                param_dtype=self.param_dtype, name="expand",
+            )(y)
+            y = nn.silu(bn("expand_bn")(y, use_running_average=not train))
+        y = nn.Conv(
+            hidden, (self.kernel, self.kernel),
+            strides=(self.stride, self.stride), padding=self.kernel // 2,
+            feature_group_count=hidden, use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="depthwise",
+        )(y)
+        y = nn.silu(bn("depthwise_bn")(y, use_running_average=not train))
+        y = SqueezeExcite(
+            self.se_squeeze, dtype=self.dtype, param_dtype=self.param_dtype,
+            name="se",
+        )(y)
+        y = nn.Conv(
+            self.features, (1, 1), use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="project",
+        )(y)
+        y = bn("project_bn")(y, use_running_average=not train)
+        if self.stride == 1 and in_ch == self.features:
+            if train and self.drop_rate > 0.0:
+                # Per-sample stochastic depth ("row" mode): drop the whole
+                # residual branch for a fraction of the batch, scale the rest.
+                keep = 1.0 - self.drop_rate
+                mask = jax.random.bernoulli(
+                    self.make_rng("dropout"), keep, shape=(y.shape[0], 1, 1, 1)
+                )
+                y = jnp.where(mask, y / keep, jnp.zeros_like(y))
+            y = x + y
+        return y
+
+
+class EfficientNetB0(nn.Module):
+    num_classes: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: str | None = None
+    dropout: float = 0.2
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        bn = lambda name: batch_norm(
+            name, dtype=self.dtype, axis_name=self.bn_axis_name, eps=_BN_EPS
+        )
+        x = nn.Conv(
+            32, (3, 3), strides=(2, 2), padding=1, use_bias=False,
+            dtype=self.dtype, param_dtype=self.param_dtype, name="stem",
+        )(x)
+        x = nn.silu(bn("stem_bn")(x, use_running_average=not train))
+
+        total_blocks = sum(n for _, _, n, _, _ in _SETTINGS)
+        block = 0
+        for t, c, n, s, k in _SETTINGS:
+            for i in range(n):
+                in_ch = x.shape[-1]
+                x = MBConv(
+                    features=c, stride=s if i == 0 else 1, expand=t, kernel=k,
+                    se_squeeze=max(1, in_ch // 4),
+                    drop_rate=_DROP_PATH_RATE * block / total_blocks,
+                    dtype=self.dtype, param_dtype=self.param_dtype,
+                    bn_axis_name=self.bn_axis_name, name=f"block{block}",
+                )(x, train)
+                block += 1
+
+        x = nn.Conv(
+            1280, (1, 1), use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="head_conv",
+        )(x)
+        x = nn.silu(bn("head_bn")(x, use_running_average=not train))
+        x = global_avg_pool(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype,
+            name="head",
+        )(x)
+
+
+def efficientnet_b0(num_classes: int, **kw: Any) -> EfficientNetB0:
+    return EfficientNetB0(num_classes=num_classes, **kw)

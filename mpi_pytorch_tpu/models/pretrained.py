@@ -23,8 +23,9 @@ from mpi_pytorch_tpu.models.common import head_filter
 # Architectures with a torchvision weight mapping — the reference's seven.
 # Single source of truth: tools/convert_torchvision.py imports this list, and
 # torch_mapping._module_prefix must cover exactly these names. The
-# beyond-parity families (vit_*, mobilenet_v2) are random-init by design:
-# they have no torchvision-checkpoint counterpart in this codebase.
+# beyond-parity families (vit_*, mobilenet_v2, efficientnet_b0) are
+# random-init by design: they have no torchvision-checkpoint counterpart in
+# this codebase.
 CONVERTIBLE_MODELS = (
     "resnet18", "resnet34", "alexnet", "vgg11_bn",
     "squeezenet1_0", "densenet121", "inception_v3",

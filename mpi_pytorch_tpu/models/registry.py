@@ -26,6 +26,7 @@ from flax import linen as nn
 from mpi_pytorch_tpu.models.alexnet import alexnet
 from mpi_pytorch_tpu.models.common import head_filter
 from mpi_pytorch_tpu.models.densenet import densenet121
+from mpi_pytorch_tpu.models.efficientnet import efficientnet_b0
 from mpi_pytorch_tpu.models.inception import inception_v3
 from mpi_pytorch_tpu.models.mobilenet import mobilenet_v2
 from mpi_pytorch_tpu.models.resnet import resnet18, resnet34
@@ -47,6 +48,7 @@ _REGISTRY: dict[str, tuple[Callable[..., nn.Module], int]] = {
     "densenet121": (densenet121, 224),
     "inception_v3": (inception_v3, 299),
     "mobilenet_v2": (mobilenet_v2, 224),
+    "efficientnet_b0": (efficientnet_b0, 224),
     "vit_s16": (vit_s16, 224),
     "vit_b16": (vit_b16, 224),
     "vit_moe_s16": (vit_moe_s16, 224),

@@ -103,3 +103,13 @@ def test_env_image_size_respects_per_dim_env(monkeypatch):
 def test_inception_rejects_explicit_128_too():
     with pytest.raises(ValueError, match="299"):
         parse_config(["--model-name", "inception_v3", "--image-size", "128"])
+
+
+def test_supported_models_matches_registry():
+    """config.SUPPORTED_MODELS (CLI validation) and the model registry must
+    list exactly the same architectures — they live in separate modules to
+    avoid an import cycle, so this is the drift guard."""
+    from mpi_pytorch_tpu.config import SUPPORTED_MODELS
+    from mpi_pytorch_tpu.models.registry import available_models
+
+    assert tuple(SUPPORTED_MODELS) == tuple(available_models())

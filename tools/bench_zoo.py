@@ -46,6 +46,7 @@ ZOO = {
     "densenet121": (1024, 128),
     "inception_v3": (256, 299),
     "mobilenet_v2": (1024, 128),
+    "efficientnet_b0": (1024, 128),
     # vit at 128px/patch16 = 64 tokens; large batches keep the MXU fed.
     "vit_s16": (2048, 128),
     "vit_b16": (1024, 128),
