@@ -20,15 +20,15 @@ from flax import serialization
 from mpi_pytorch_tpu.models.common import head_filter
 
 
-# Architectures with a torchvision weight mapping — the reference's seven.
-# Single source of truth: tools/convert_torchvision.py imports this list, and
-# torch_mapping._module_prefix must cover exactly these names. The
-# beyond-parity families (vit_*, mobilenet_v2, efficientnet_b0) are
-# random-init by design: they have no torchvision-checkpoint counterpart in
-# this codebase.
+# Architectures with a torchvision weight mapping — the reference's seven
+# plus mobilenet_v2. Single source of truth: tools/convert_torchvision.py
+# imports this list, and torch_mapping._module_prefix must cover exactly
+# these names. The remaining beyond-parity families (vit_*, efficientnet_b0)
+# are random-init by design: they have no torchvision-checkpoint counterpart
+# in this codebase.
 CONVERTIBLE_MODELS = (
     "resnet18", "resnet34", "alexnet", "vgg11_bn",
-    "squeezenet1_0", "densenet121", "inception_v3",
+    "squeezenet1_0", "densenet121", "inception_v3", "mobilenet_v2",
 )
 
 
@@ -42,7 +42,7 @@ def load_pretrained(model_name: str, variables: dict, pretrained_dir: str) -> di
     if model_name not in CONVERTIBLE_MODELS:
         raise ValueError(
             f"use_pretrained=True is not available for {model_name!r}: the "
-            "torchvision converter covers the reference's seven architectures "
+            "torchvision converter covers these architectures "
             f"({', '.join(CONVERTIBLE_MODELS)}); the beyond-parity families "
             "train from random init (set use_pretrained=False)."
         )

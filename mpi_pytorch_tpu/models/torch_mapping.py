@@ -120,6 +120,25 @@ def _module_prefix(arch: str, module_path: tuple[str, ...]) -> str:
         # module names were chosen to match torchvision exactly
         # (Conv2d_1a_3x3, Mixed_5b…, AuxLogits, conv/bn, branch names).
         return ".".join(module_path)
+    if arch == "mobilenet_v2":
+        # torchvision: features.0 = stem ConvBNActivation, features.1..17 =
+        # InvertedResidual (whose .conv Sequential has one fewer stage when
+        # expand_ratio == 1 — exactly our block0), features.18 = head conv.
+        if module_path and module_path[0].startswith("block"):
+            i = int(module_path[0].removeprefix("block"))
+            sub = module_path[1]
+            stages = (
+                {"depthwise": "conv.0.0", "depthwise_bn": "conv.0.1",
+                 "project": "conv.1", "project_bn": "conv.2"}
+                if i == 0
+                else {"expand": "conv.0.0", "expand_bn": "conv.0.1",
+                      "depthwise": "conv.1.0", "depthwise_bn": "conv.1.1",
+                      "project": "conv.2", "project_bn": "conv.3"}
+            )
+            return f"features.{i + 1}.{stages[sub]}"
+        flat = {"stem": "features.0.0", "stem_bn": "features.0.1",
+                "head_conv": "features.18.0", "head_bn": "features.18.1"}
+        return ".".join(flat.get(p, p) for p in module_path)
     raise ValueError(f"no torchvision mapping for {arch!r}")
 
 

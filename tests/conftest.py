@@ -26,19 +26,20 @@ def rng():
     return np.random.default_rng(0)
 
 
-# Initializing all seven architectures is the most expensive fixture in the
-# suite (XLA compiles on a single CPU core) — session-scoped and shared by
-# test_models and test_torch_mapping.
+# Initializing every convertible architecture is the most expensive fixture
+# in the suite (XLA compiles on a single CPU core) — session-scoped and
+# shared by test_models and test_torch_mapping. The list IS
+# CONVERTIBLE_MODELS, so a new weight mapping is automatically covered.
 TEST_NUM_CLASSES = 10
 
 
 @pytest.fixture(scope="session")
 def bundles():
     from mpi_pytorch_tpu.models import create_model_bundle
+    from mpi_pytorch_tpu.models.pretrained import CONVERTIBLE_MODELS
 
     out = {}
-    for name in ("resnet18", "resnet34", "alexnet", "vgg11_bn",
-                 "squeezenet1_0", "densenet121", "inception_v3"):
+    for name in CONVERTIBLE_MODELS:
         # small sizes for test speed; inception needs its real 299 spatial
         # dims for the aux-logits pooling path
         size = 299 if name == "inception_v3" else 64

@@ -60,15 +60,22 @@ def test_mobilenet_trains_through_standard_step():
     assert state.batch_stats is not None  # BN model: running stats updated
 
 
-def test_mobilenet_pretrained_gives_clear_error(tmp_path):
-    """Beyond-parity families have no torchvision mapping: use_pretrained
-    must say so directly rather than point at a converter that rejects the
-    model name."""
+def test_mobilenet_pretrained_errors(tmp_path):
+    """mobilenet_v2 IS convertible (torch_mapping has its rules), so
+    use_pretrained with no converted file must point at the converter via
+    FileNotFoundError — while a genuinely unconvertible family
+    (efficientnet_b0) still gets the direct random-init ValueError."""
     import pytest
 
-    with pytest.raises(ValueError, match="random init"):
+    with pytest.raises(FileNotFoundError, match="convert_torchvision"):
         create_model_bundle(
             "mobilenet_v2", 10, use_pretrained=True,
+            rng=jax.random.PRNGKey(0), image_size=32,
+            pretrained_dir=str(tmp_path),
+        )
+    with pytest.raises(ValueError, match="random init"):
+        create_model_bundle(
+            "efficientnet_b0", 10, use_pretrained=True,
             rng=jax.random.PRNGKey(0), image_size=32,
             pretrained_dir=str(tmp_path),
         )

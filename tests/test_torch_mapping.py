@@ -23,8 +23,7 @@ from mpi_pytorch_tpu.models.torch_mapping import (
     tv_entries,
 )
 
-ARCHS = ("resnet18", "resnet34", "alexnet", "vgg11_bn",
-         "squeezenet1_0", "densenet121", "inception_v3")
+from mpi_pytorch_tpu.models.pretrained import CONVERTIBLE_MODELS as ARCHS
 
 
 def _flat(tree):
