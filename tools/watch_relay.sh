@@ -1,0 +1,26 @@
+#!/bin/bash
+# Watch the TPU relay; the moment a probe succeeds, fire the chip battery.
+# Each probe is timeout-bounded so a wedged relay costs one child, not a hang.
+# Usage: bash tools/watch_relay.sh [logfile]   (default /tmp/relay_watch.log)
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-/tmp/relay_watch.log}"
+
+while true; do
+  if timeout 300 python - <<'EOF' >> "$LOG" 2>&1
+import jax, jax.numpy as jnp, time
+t0 = time.time()
+d = jax.devices()
+x = jnp.ones((256, 256))
+(x @ x).block_until_ready()
+print(f"RELAY OK {time.strftime('%H:%M:%S')} init+matmul {time.time()-t0:.1f}s {d}", flush=True)
+EOF
+  then
+    echo "== relay healthy, launching battery $(date -u +%H:%M:%S) ==" >> "$LOG"
+    bash tools/run_chip_benches.sh docs >> "$LOG" 2>&1
+    echo "== battery exit=$? $(date -u +%H:%M:%S) ==" >> "$LOG"
+    break
+  fi
+  echo "probe failed $(date -u +%H:%M:%S), retrying in 120s" >> "$LOG"
+  sleep 120
+done
