@@ -21,14 +21,15 @@ from mpi_pytorch_tpu.models.common import head_filter
 
 
 # Architectures with a torchvision weight mapping — the reference's seven
-# plus mobilenet_v2. Single source of truth: tools/convert_torchvision.py
-# imports this list, and torch_mapping._module_prefix must cover exactly
-# these names. The remaining beyond-parity families (vit_*, efficientnet_b0)
-# are random-init by design: they have no torchvision-checkpoint counterpart
-# in this codebase.
+# plus mobilenet_v2 and efficientnet_b0. Single source of truth:
+# tools/convert_torchvision.py imports this list, and
+# torch_mapping._module_prefix must cover exactly these names. The remaining
+# beyond-parity family (vit_*) is random-init by design: this zoo's ViT
+# variants have no torchvision-checkpoint counterpart.
 CONVERTIBLE_MODELS = (
     "resnet18", "resnet34", "alexnet", "vgg11_bn",
     "squeezenet1_0", "densenet121", "inception_v3", "mobilenet_v2",
+    "efficientnet_b0",
 )
 
 

@@ -139,6 +139,38 @@ def _module_prefix(arch: str, module_path: tuple[str, ...]) -> str:
         flat = {"stem": "features.0.0", "stem_bn": "features.0.1",
                 "head_conv": "features.18.0", "head_bn": "features.18.1"}
         return ".".join(flat.get(p, p) for p in module_path)
+    if arch == "efficientnet_b0":
+        # torchvision: features.0 = stem Conv2dNormActivation, features.1..7
+        # = the seven MBConv stages (block-in-stage nesting vs this zoo's
+        # flat global block index), features.8 = head conv. Within an MBConv
+        # the .block Sequential has one fewer stage when expand_ratio == 1
+        # (exactly our block0), and the SE convs are fc1 (reduce) / fc2
+        # (expand).
+        if module_path and module_path[0].startswith("block"):
+            rem = int(module_path[0].removeprefix("block"))
+            stage = 1
+            for n in (1, 2, 2, 3, 3, 4, 1):  # blocks per stage (_SETTINGS)
+                if rem < n:
+                    break
+                rem -= n
+                stage += 1
+            expand_less = stage == 1  # expand_ratio == 1: no expand stage
+            if module_path[1] == "se":
+                se = "block.1" if expand_less else "block.2"
+                fc = {"reduce": "fc1", "expand": "fc2"}[module_path[2]]
+                return f"features.{stage}.{rem}.{se}.{fc}"
+            stages = (
+                {"depthwise": "block.0.0", "depthwise_bn": "block.0.1",
+                 "project": "block.2.0", "project_bn": "block.2.1"}
+                if expand_less
+                else {"expand": "block.0.0", "expand_bn": "block.0.1",
+                      "depthwise": "block.1.0", "depthwise_bn": "block.1.1",
+                      "project": "block.3.0", "project_bn": "block.3.1"}
+            )
+            return f"features.{stage}.{rem}.{stages[module_path[1]]}"
+        flat = {"stem": "features.0.0", "stem_bn": "features.0.1",
+                "head_conv": "features.8.0", "head_bn": "features.8.1"}
+        return ".".join(flat.get(p, p) for p in module_path)
     raise ValueError(f"no torchvision mapping for {arch!r}")
 
 
