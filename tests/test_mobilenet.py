@@ -63,8 +63,9 @@ def test_mobilenet_trains_through_standard_step():
 def test_mobilenet_pretrained_errors(tmp_path):
     """mobilenet_v2 IS convertible (torch_mapping has its rules), so
     use_pretrained with no converted file must point at the converter via
-    FileNotFoundError — while a genuinely unconvertible family
-    (efficientnet_b0) still gets the direct random-init ValueError."""
+    FileNotFoundError — while a genuinely unconvertible family (the ViTs,
+    which have no torchvision-checkpoint counterpart in this zoo) still
+    gets the direct random-init ValueError."""
     import pytest
 
     with pytest.raises(FileNotFoundError, match="convert_torchvision"):
@@ -75,7 +76,7 @@ def test_mobilenet_pretrained_errors(tmp_path):
         )
     with pytest.raises(ValueError, match="random init"):
         create_model_bundle(
-            "efficientnet_b0", 10, use_pretrained=True,
+            "vit_s16", 10, use_pretrained=True,
             rng=jax.random.PRNGKey(0), image_size=32,
             pretrained_dir=str(tmp_path),
         )
