@@ -29,7 +29,7 @@ import jax
 import numpy as np
 from flax import serialization
 
-from mpi_pytorch_tpu.utils.logging import process_index
+from mpi_pytorch_tpu.utils.logging import process_index, run_logger
 
 _CKPT_RE = re.compile(r"ckpt_(\d+)\.msgpack$")
 
@@ -71,11 +71,24 @@ def _payload(state: Any, epoch: int = 0, loss: float = 0.0) -> dict:
     return _payload_from(_state_arrays(state), epoch, loss)
 
 
-def _write_atomic(ckpt_dir: str, path: str, payload: dict, keep: int) -> None:
+def _write_atomic(
+    ckpt_dir: str, path: str, payload: dict, keep: int, dirty: bool = False
+) -> None:
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(serialization.to_bytes(payload))
     os.replace(tmp, path)  # atomic on POSIX
+    # Dirty = the state carries a partial epoch's updates beyond the epoch it
+    # is filed under (mid-epoch preemption). A sidecar rather than a payload
+    # field keeps the msgpack schema stable across checkpoint generations;
+    # written AFTER the rename so a marker never outlives a failed write,
+    # and a clean overwrite of the same epoch clears it.
+    marker = path + ".dirty"
+    if dirty:
+        with open(marker, "w") as f:
+            f.write("partial-epoch state: resume replays the interrupted epoch\n")
+    elif os.path.exists(marker):
+        os.remove(marker)
     _cleanup(ckpt_dir, keep)
 
 
@@ -86,6 +99,7 @@ def save_checkpoint(
     state: Any,
     loss: float,
     keep: int = 3,
+    dirty: bool = False,
 ) -> str | None:
     """Synchronous save (process 0 only); returns the path written. The
     trainer uses ``AsyncCheckpointer``; this stays as the blocking variant
@@ -94,7 +108,7 @@ def save_checkpoint(
         return None
     os.makedirs(ckpt_dir, exist_ok=True)
     path = _ckpt_path(ckpt_dir, epoch)
-    _write_atomic(ckpt_dir, path, _payload(state, epoch, loss), keep)
+    _write_atomic(ckpt_dir, path, _payload(state, epoch, loss), keep, dirty)
     return path
 
 
@@ -113,6 +127,9 @@ def _cleanup(ckpt_dir: str, keep: int) -> None:
     for _, name in ckpts[:-keep] if keep > 0 else []:
         if name != pinned:
             os.remove(os.path.join(ckpt_dir, name))
+            marker = os.path.join(ckpt_dir, name + ".dirty")
+            if os.path.exists(marker):
+                os.remove(marker)
 
 
 def best_marker(ckpt_dir: str) -> dict | None:
@@ -183,6 +200,39 @@ def _replicated_sharding(arrays: dict):
     return None
 
 
+def _any_sharded(arrays: dict) -> bool:
+    for leaf in jax.tree_util.tree_leaves(arrays):
+        s = getattr(leaf, "sharding", None)
+        if s is not None and not s.is_fully_replicated:
+            return True
+    return False
+
+
+def _gather_to_host(arrays: dict, repl) -> dict:
+    """All-gather a SHARDED state (fsdp / zero_optimizer / TP) to host numpy,
+    one leaf at a time.
+
+    A whole-tree replicated gather would transiently hold the full unsharded
+    state — params plus both Adam moments, ~3x params — on EVERY device at
+    once, which can OOM exactly the configurations that needed sharding.
+    Gathering leaf-by-leaf and freeing each device copy once it's on the
+    host keeps the peak per-device overhead at one leaf's unsharded size.
+    The cost is that the device_get runs on the caller thread (the async
+    writer then only serializes), a trade the sharded configs accept."""
+    gather = _copy_fn(repl)
+    p0 = process_index() == 0
+
+    def one(leaf):
+        g = gather(leaf)  # collective: EVERY process must run it per leaf
+        # Only process 0 writes the checkpoint; the other processes skip the
+        # D2H copy (and the full-state host allocation) they'd never use.
+        host = np.asarray(jax.device_get(g)) if p0 else None
+        g.delete()  # free the replicated copy before gathering the next leaf
+        return host
+
+    return jax.tree_util.tree_map(one, arrays)
+
+
 class AsyncCheckpointer:
     """Non-blocking checkpointing: a ~ms on-device copy snapshots the state,
     then a background thread does the expensive ``device_get`` + serialize +
@@ -207,6 +257,7 @@ class AsyncCheckpointer:
         loss: float,
         keep: int = 3,
         on_durable=None,
+        dirty: bool = False,
     ) -> str | None:
         """Snapshot now, write in the background; returns the path that will
         exist once the write completes (None on processes > 0).
@@ -220,8 +271,14 @@ class AsyncCheckpointer:
         process-addressable arrays on any number of hosts."""
         self.wait()
         arrays = _state_arrays(state)
-        snapshot = _copy_fn(_replicated_sharding(arrays))(arrays)
-        jax.block_until_ready(snapshot["params"])  # copy is cheap; be certain
+        repl = _replicated_sharding(arrays)
+        if repl is not None and _any_sharded(arrays):
+            # Sharded state: leaf-by-leaf host gather (see _gather_to_host)
+            # instead of materializing the whole unsharded state on-device.
+            snapshot = _gather_to_host(arrays, repl)
+        else:
+            snapshot = _copy_fn(repl)(arrays)
+            jax.block_until_ready(snapshot["params"])  # copy is cheap; be certain
         if process_index() != 0:
             return None
         os.makedirs(ckpt_dir, exist_ok=True)
@@ -229,7 +286,9 @@ class AsyncCheckpointer:
 
         def _worker() -> None:
             try:
-                _write_atomic(ckpt_dir, path, _payload_from(snapshot, epoch, loss), keep)
+                _write_atomic(
+                    ckpt_dir, path, _payload_from(snapshot, epoch, loss), keep, dirty
+                )
                 if on_durable is not None:
                     # Runs strictly AFTER the atomic rename: anything the
                     # callback publishes (e.g. the best.json marker) can
@@ -259,6 +318,16 @@ def load_checkpoint(path: str, state: Any) -> tuple[Any, int, float]:
     """Restore (state, epoch, loss) from a checkpoint file (≙
     ``load_checkpoint``, helpers.py:10-15 — which returns the epoch so the
     driver can continue the epoch loop, main.py:127-129)."""
+    if os.path.exists(path + ".dirty"):
+        m = _CKPT_RE.search(os.path.basename(path))
+        epoch_txt = (m.group(1).lstrip("0") or "0") if m else "the filed epoch"
+        run_logger().warning(
+            "resuming from a DIRTY checkpoint (%s): it was saved after a "
+            "mid-epoch preemption, so the state already carries part of epoch "
+            "%s+1's updates — replaying that epoch double-applies those "
+            "batches' steps (trajectory may differ from an uninterrupted run)",
+            path, epoch_txt,
+        )
     with open(path, "rb") as f:
         data = f.read()
     restored = serialization.from_bytes(_payload(state), data)

@@ -152,7 +152,12 @@ def find_pack(packed_dir: str, manifest, image_size, synthetic: bool) -> PackHan
         if bool(meta["synthetic"]) != bool(synthetic):
             reasons.append(f"{name}: synthetic={meta['synthetic']}")
             continue
-        if not synthetic and meta["img_dir"] != manifest.img_dir:
+        # realpath: a pack built with a relative spelling of the same
+        # directory must not be rejected against an absolute one (the strict
+        # no-fallback policy would turn that into a hard error).
+        if not synthetic and os.path.realpath(meta["img_dir"]) != os.path.realpath(
+            manifest.img_dir
+        ):
             reasons.append(f"{name}: img_dir {meta['img_dir']!r} != {manifest.img_dir!r}")
             continue
         index = {fn: i for i, fn in enumerate(meta["filenames"])}

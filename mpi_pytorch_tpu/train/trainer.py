@@ -103,22 +103,35 @@ class PreemptionGuard:
         self.triggered = True
 
 
-def _stop_agreed(guard: PreemptionGuard, mesh) -> bool:
-    """Epoch-boundary stop decision. Single-process: the local flag.
-    Multi-host: a tiny global all-reduce of every host's flag, so EITHER all
-    processes break before the next epoch or none do — a host stopping
-    unilaterally would leave the others blocked in the next collective
-    step."""
+def _global_max(value: float, mesh) -> float:
+    """Tiny all-reduce: the max of every process's ``value`` over the whole
+    mesh (single-process: identity). The one collective that decisions read
+    off the host side go through — anything that gates entering a collective
+    (stop flags, best-accuracy init) must agree across processes."""
     if jax.process_count() == 1:
-        return guard.triggered
+        return value
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    local = np.full(
-        (jax.local_device_count(),), 1.0 if guard.triggered else 0.0, np.float32
-    )
+    local = np.full((jax.local_device_count(),), value, np.float32)
     sharding = NamedSharding(mesh, P(tuple(mesh.axis_names)))  # 1-D over all devices
-    flags = jax.make_array_from_process_local_data(sharding, local)
-    return float(jnp.max(flags)) > 0.0
+    vals = jax.make_array_from_process_local_data(sharding, local)
+    return float(jnp.max(vals))
+
+
+def _stop_agreed(guard: PreemptionGuard, mesh) -> bool:
+    """Epoch-boundary stop decision: EITHER all processes break before the
+    next epoch or none do — a host stopping unilaterally would leave the
+    others blocked in the next collective step."""
+    return _global_max(1.0 if guard.triggered else 0.0, mesh) > 0.0
+
+
+def _p0_scalar(value: float, mesh) -> float:
+    """Process 0's ``value`` on every process: non-0 processes contribute
+    -inf to the global max. Used where a value read from process 0's
+    filesystem (e.g. the best.json marker) feeds a decision that gates a
+    collective — every process must start from the same number even when
+    the checkpoint dir is not a shared filesystem."""
+    return _global_max(value if jax.process_index() == 0 else float("-inf"), mesh)
 
 
 def _dtype(name: str):
@@ -589,10 +602,21 @@ def train(cfg: Config) -> TrainSummary:
     # to the previous handler — the escape hatch if the drain itself wedges.
     guard = PreemptionGuard()
     last_saved_epoch = -1
+    stopped_mid_epoch = False
     # A resumed run must not demote a better historical best (best.json
-    # survives restarts; missing marker → any first accuracy wins).
-    _marker = ckpt.best_marker(cfg.checkpoint_dir) if cfg.track_best else None
-    best_accuracy = _marker["accuracy"] if _marker else float("-inf")
+    # survives restarts; missing marker → any first accuracy wins). Only
+    # process 0 reads the marker: on multi-host WITHOUT a shared checkpoint
+    # dir the other processes would see no file and start from -inf, and a
+    # diverged improvement decision gates a collective (checkpointer.save)
+    # — a hang. Process 0's value is broadcast instead.
+    best_accuracy = float("-inf")
+    if cfg.track_best:
+        _marker = (
+            ckpt.best_marker(cfg.checkpoint_dir) if jax.process_index() == 0 else None
+        )
+        best_accuracy = _p0_scalar(
+            _marker["accuracy"] if _marker else float("-inf"), mesh
+        )
     with guard:
       try:
         for epoch in range(start_epoch, cfg.num_epochs):
@@ -671,7 +695,8 @@ def train(cfg: Config) -> TrainSummary:
                 summary.preempted = True
                 logger.info(
                     "preemption signal: stopping mid-epoch %d at step boundary "
-                    "%d (last completed epoch's progress is what resume sees)",
+                    "%d (partial-epoch state — saved with a .dirty marker; "
+                    "resume warns before replaying the interrupted epoch)",
                     epoch, step_i,
                 )
                 break
@@ -814,10 +839,13 @@ def train(cfg: Config) -> TrainSummary:
         raise
       if summary.preempted and cfg.checkpoint_every_epochs:
         # Preserve completed-but-unsaved progress (checkpoint_every_epochs>1
-        # leaves up to k-1 epochs unsaved). The state may additionally carry a
-        # partial epoch's updates — saved under the last COMPLETED epoch, so
-        # resume redoes the interrupted epoch on top (same looseness as the
-        # reference's epoch-granular FROM_CHECKPOINT restart, main.py:127-130).
+        # leaves up to k-1 epochs unsaved). After a mid-epoch stop the state
+        # additionally carries a partial epoch's updates — saved under the
+        # last COMPLETED epoch, so resume redoes the interrupted epoch on
+        # top, double-applying those batches' steps. Such saves are marked
+        # dirty (a ``.dirty`` sidecar) and resume warns: the progress is
+        # kept, the trajectory perturbation vs the reference's clean-boundary
+        # restart (main.py:127-130) is surfaced instead of silent.
         # `completed >= start_epoch`: only epochs completed by THIS run — a
         # resumed run preempted before finishing any epoch must not replace
         # the clean on-disk checkpoint it restored from with a dirty state.
@@ -825,7 +853,7 @@ def train(cfg: Config) -> TrainSummary:
         if completed >= start_epoch and completed != last_saved_epoch:
             path = checkpointer.save(
                 cfg.checkpoint_dir, epoch=completed, state=state, loss=epoch_loss,
-                keep=cfg.keep_checkpoints,
+                keep=cfg.keep_checkpoints, dirty=stopped_mid_epoch,
             )
             if path:
                 summary.checkpoint_path = path

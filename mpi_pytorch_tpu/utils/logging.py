@@ -59,6 +59,16 @@ def init_logger(name: str = "MPT", log_file: str | None = "training.log",
     return logger
 
 
+def run_logger() -> logging.Logger:
+    """The rank-tagged run logger — the SAME logger ``init_logger`` configures
+    (stream + file handlers, ``propagate=False``). Library modules that need
+    to surface messages outside the trainer (e.g. checkpoint restore
+    warnings) must log here, not to a module-named logger: the run logger
+    doesn't propagate, and an unconfigured module logger would fall to the
+    bare stderr last-resort handler and never reach ``training.log``."""
+    return logging.getLogger(f"MPT_R{process_index()}")
+
+
 class MetricsWriter:
     """Structured JSONL metrics (throughput, loss, MFU) — SURVEY §5 observability.
 

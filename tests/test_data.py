@@ -348,6 +348,32 @@ def test_packed_resolution_is_strict(tmp_path):
                    packed_dir=packed_dir)
 
 
+def test_packed_accepts_relative_img_dir_spelling(tmp_path, monkeypatch):
+    """A pack recorded with a relative spelling of the manifest's img_dir is
+    the SAME pack: find_pack compares realpaths, so the strict no-fallback
+    policy doesn't turn a path-spelling difference into a hard error."""
+    import json
+    import os
+
+    from mpi_pytorch_tpu.data.packed import find_pack, write_pack
+
+    _, (train_m, _) = _jpeg_dataset(tmp_path, n=48)
+    packed_dir = str(tmp_path / "packed")
+    write_pack(train_m, (32, 32), f"{packed_dir}/train_32x32", num_workers=2)
+
+    meta_path = f"{packed_dir}/train_32x32.meta.json"
+    with open(meta_path) as f:
+        meta = json.load(f)
+    monkeypatch.chdir(tmp_path)
+    meta["img_dir"] = os.path.relpath(meta["img_dir"], str(tmp_path))
+    assert meta["img_dir"] != train_m.img_dir  # genuinely different spellings
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+
+    handle = find_pack(packed_dir, train_m, (32, 32), synthetic=False)
+    assert handle.rows.shape[0] == len(train_m.filenames)
+
+
 def test_packed_cli_then_train(tmp_path):
     """The pack CLI writes both splits; the trainer consumes them through
     --packed-dir end to end."""

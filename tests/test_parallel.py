@@ -330,8 +330,9 @@ def test_fsdp_param_sharding_matches_replicated():
 
 
 def test_async_checkpoint_gathers_zero_sharded_state(tmp_path):
-    """AsyncCheckpointer on a ZeRO-sharded state: the snapshot's replicated
-    out_shardings all-gather the data-axis-sharded Adam moments, so the save
+    """AsyncCheckpointer on a ZeRO-sharded state: the snapshot gathers the
+    data-axis-sharded Adam moments leaf-by-leaf to host (peak device overhead
+    one unsharded leaf, not the whole 3x-params state), so the save
     round-trips exactly — the single-process face of the multi-host property
     exercised end-to-end by tests/test_distributed.py."""
     from mpi_pytorch_tpu.checkpoint import AsyncCheckpointer, load_checkpoint

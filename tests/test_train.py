@@ -141,6 +141,69 @@ def test_async_checkpointer_roundtrip(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_dirty_checkpoint_marker_and_resume_warning(tmp_path):
+    """A mid-epoch preemption save is marked dirty (sidecar): resume warns
+    that the replayed epoch double-applies the partial epoch's updates, a
+    clean overwrite of the same epoch clears the marker, and last-k cleanup
+    removes markers with their checkpoints."""
+    import logging
+
+    import jax.numpy as jnp
+    import optax
+    from flax import linen as nn
+
+    from mpi_pytorch_tpu import checkpoint as ckpt
+    from mpi_pytorch_tpu.train.state import TrainState
+    from mpi_pytorch_tpu.utils.logging import run_logger
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(4)(x)
+
+    model = M()
+    state = TrainState.create(
+        apply_fn=model.apply,
+        variables=model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8))),
+        tx=optax.adam(1e-3), rng=jax.random.PRNGKey(1),
+    )
+    cp = ckpt.AsyncCheckpointer()
+    path = cp.save(str(tmp_path), epoch=5, state=state, loss=1.0, dirty=True)
+    cp.wait()
+    assert os.path.exists(path + ".dirty")
+
+    # Capture from the rank-tagged run logger itself: it is the logger the
+    # trainer configures (propagate=False), so the warning must land THERE
+    # to be visible in real runs' stream/file handlers.
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    logger = run_logger()
+    logger.addHandler(handler)
+    try:
+        ckpt.load_checkpoint(path, state)
+        assert any("DIRTY" in r.getMessage() for r in records)
+
+        # A clean save of the same epoch (the resumed run re-finishing it)
+        # clears the marker, and a clean load stays silent.
+        cp.save(str(tmp_path), epoch=5, state=state, loss=0.9)
+        cp.wait()
+        assert not os.path.exists(path + ".dirty")
+        records.clear()
+        ckpt.load_checkpoint(path, state)
+        assert not records
+    finally:
+        logger.removeHandler(handler)
+
+    # Markers ride last-k retention: evicting the checkpoint evicts its
+    # sidecar too.
+    p6 = ckpt.save_checkpoint(str(tmp_path), epoch=6, state=state, loss=0.8,
+                              dirty=True)
+    assert os.path.exists(p6 + ".dirty")
+    ckpt.save_checkpoint(str(tmp_path), epoch=7, state=state, loss=0.7, keep=1)
+    assert not os.path.exists(p6) and not os.path.exists(p6 + ".dirty")
+
+
 def test_device_cache_matches_streaming(tmp_path):
     """device_cache=True (HBM-resident dataset, on-device index gather) walks
     the data in the same order as the streaming loader and must produce the
