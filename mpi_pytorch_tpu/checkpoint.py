@@ -262,13 +262,16 @@ class AsyncCheckpointer:
         """Snapshot now, write in the background; returns the path that will
         exist once the write completes (None on processes > 0).
 
-        EVERY process must call this (the trainer does): the jitted snapshot
-        copy is a global SPMD computation on multi-host meshes, so gating it
-        to process 0 would diverge the programs the processes run. Only
-        process 0 spawns the writer thread. Sharded state (ZeRO-1 moments,
-        the TP head) is all-gathered to replicated by the snapshot copy's
-        ``out_shardings``, so the writer's ``device_get`` sees only
-        process-addressable arrays on any number of hosts."""
+        EVERY process must call this (the trainer does): the snapshot is a
+        global SPMD computation on multi-host meshes, so gating it to
+        process 0 would diverge the programs the processes run. Only process
+        0 spawns the writer thread. Replicated state takes the fast path (a
+        ~ms on-device copy; the background thread does the device_get).
+        Sharded state (fsdp / ZeRO-1 moments / the TP head) goes through
+        ``_gather_to_host`` instead: a synchronous leaf-by-leaf all-gather
+        streamed to host numpy on the caller thread (peak device overhead
+        one unsharded leaf, not the whole state), after which the writer
+        only serializes."""
         self.wait()
         arrays = _state_arrays(state)
         repl = _replicated_sharding(arrays)

@@ -49,13 +49,19 @@ class MeshConfig:
 
     data_axis: str = "data"
     model_axis: str = "model"
+    pipe_axis: str = "pipe"
     # -1 means "all remaining devices" on that axis.
     data_parallel: int = -1
     model_parallel: int = 1
+    # Pipeline stages (driven by --pp-stages; the mesh gains a third axis
+    # only when > 1, so existing 2-axis layouts are untouched).
+    pipe_parallel: int = 1
 
     def validate(self) -> None:
         if self.model_parallel < 1:
             raise ValueError(f"model_parallel must be >= 1, got {self.model_parallel}")
+        if self.pipe_parallel < 1:
+            raise ValueError(f"pipe_parallel must be >= 1, got {self.pipe_parallel}")
 
 
 @dataclass
@@ -149,6 +155,17 @@ class Config:
     # over all devices on an ("expert", "_") mesh; tokens travel by
     # all_to_all (ops/moe.py). MoE models only.
     expert_parallel: bool = False
+    # Pipeline parallelism over the vit_* encoder trunk (parallel/pp_vit.py):
+    # > 1 adds a "pipe" mesh axis of that size, splits the depth-homogeneous
+    # encoder blocks into pp_stages equal stages, and streams microbatches
+    # through them GPipe-style (parallel/pipeline.py) — composed with DP over
+    # the remaining devices. Same param tree, same checkpoints: PP is purely
+    # an execution strategy (the apply_fn is swapped, nothing else). Dense
+    # ViT models only (registry.PP_MODELS); auto mode only.
+    pp_stages: int = 1
+    # Microbatches streamed through the pipeline per step; 0 → 2*pp_stages.
+    # The GPipe bubble fraction is (S-1)/(M+S-1): raise M to amortize it.
+    pp_microbatches: int = 0
 
     # --- input pipeline ---
     shuffle: bool = True
@@ -345,6 +362,69 @@ class Config:
                 "its replicated in/out specs would silently gather the TP-sharded "
                 "head. Use the default auto mode for mesh.model_parallel > 1."
             )
+        if self.pp_stages < 1:
+            raise ValueError(f"pp_stages must be >= 1, got {self.pp_stages}")
+        if self.pp_microbatches < 0:
+            raise ValueError(
+                f"pp_microbatches must be >= 0 (0 = default), got {self.pp_microbatches}"
+            )
+        if self.pp_microbatches and self.pp_stages <= 1:
+            raise ValueError("pp_microbatches only applies with pp_stages > 1")
+        if self.pp_stages > 1:
+            from mpi_pytorch_tpu.models.registry import PP_MODELS
+
+            if self.model_name not in PP_MODELS:
+                raise ValueError(
+                    f"pp_stages > 1 pipelines a depth-homogeneous encoder trunk; "
+                    f"{self.model_name!r} is not pipeline-shaped "
+                    f"(supported: {', '.join(PP_MODELS)})"
+                )
+            if self.spmd_mode:
+                raise ValueError(
+                    "pp_stages > 1 requires the auto-partitioned step "
+                    "(spmd_mode is pure reference-parity data parallelism)"
+                )
+            if self.sp_strategy != "none":
+                raise ValueError(
+                    "pp_stages > 1 cannot nest the SP attention strategies "
+                    "inside pipeline stages (both shard the same devices); "
+                    "choose one of --pp-stages / --sp-strategy"
+                )
+            if self.expert_parallel:
+                raise ValueError(
+                    "pp_stages > 1 with expert_parallel would nest all_to_all "
+                    "inside pipeline stages; choose one of --pp-stages / "
+                    "--expert-parallel"
+                )
+            if self.accum_steps > 1:
+                raise ValueError(
+                    "pp_stages > 1 already microbatches the step (GPipe); "
+                    "combine with --pp-microbatches instead of --accum-steps"
+                )
+            if self.remat == "full":
+                raise ValueError(
+                    "pp_stages > 1 supports remat='blocks' (per-stage "
+                    "rematerialization inside the pipeline) or 'none', "
+                    "not 'full'"
+                )
+            if self.fsdp or self.zero_optimizer:
+                raise ValueError(
+                    "pp_stages > 1 with fsdp/zero_optimizer would re-gather "
+                    "the data-axis-sharded trunk params into the pipeline's "
+                    "P(pipe) layout every step — the full unsharded stack per "
+                    "device, defeating exactly the memory saving the sharding "
+                    "buys. The pipeline already splits trunk memory S ways; "
+                    "choose one of --pp-stages / --fsdp / --zero-optimizer"
+                )
+            mb = self.pp_microbatches or 2 * self.pp_stages
+            if self.batch_size % mb:
+                raise ValueError(
+                    f"batch_size {self.batch_size} not divisible by "
+                    f"pp_microbatches {mb}"
+                )
+            # pp_stages drives the mesh layout: one stage per device along
+            # the pipe axis (DP fills the remaining devices).
+            self.mesh.pipe_parallel = self.pp_stages
         self.mesh.validate()
 
     @property

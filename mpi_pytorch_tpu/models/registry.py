@@ -65,6 +65,13 @@ SP_MODELS = ("vit_s16", "vit_b16", "vit_moe_s16")
 # parallelism; their train loss includes the sown load-balance aux term).
 MOE_MODELS = ("vit_moe_s16",)
 
+# Architectures whose trunk is a stack of depth-homogeneous blocks that
+# pipeline parallelism can split into stages (parallel/pp_vit.py). The MoE
+# variant is excluded: its sown aux-loss collection cannot cross the
+# pipeline's shard_map boundary, and its alternating block structure breaks
+# the stacked-stage layout.
+PP_MODELS = ("vit_s16", "vit_b16")
+
 
 @dataclasses.dataclass(frozen=True)
 class ModelBundle:

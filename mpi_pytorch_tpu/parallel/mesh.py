@@ -21,17 +21,31 @@ from mpi_pytorch_tpu.config import MeshConfig
 
 
 def create_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
-    """Build a (data, model) mesh over all devices (or the given ones)."""
+    """Build a (data, model[, pipe]) mesh over all devices (or the given
+    ones). The ``pipe`` axis exists only when ``pipe_parallel > 1``
+    (--pp-stages), so 2-axis layouts — and everything keyed on
+    ``axis_names[0] == data`` / ``axis_names[1] == model`` — are untouched.
+    Pipe is the LAST reshape axis: consecutive pipeline stages land on
+    adjacent devices, so the stage→stage ``ppermute`` rides neighbor ICI
+    links."""
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
-    mp = cfg.model_parallel
-    if n % mp != 0:
-        raise ValueError(f"{n} devices not divisible by model_parallel={mp}")
-    dp = cfg.data_parallel if cfg.data_parallel > 0 else n // mp
-    if dp * mp != n:
-        raise ValueError(f"data_parallel×model_parallel = {dp}×{mp} != {n} devices")
-    arr = np.asarray(devices).reshape(dp, mp)
-    return Mesh(arr, (cfg.data_axis, cfg.model_axis))
+    mp, pp = cfg.model_parallel, cfg.pipe_parallel
+    if n % (mp * pp) != 0:
+        raise ValueError(
+            f"{n} devices not divisible by model_parallel={mp} x pipe_parallel={pp}"
+        )
+    dp = cfg.data_parallel if cfg.data_parallel > 0 else n // (mp * pp)
+    if dp * mp * pp != n:
+        raise ValueError(
+            f"data_parallel×model_parallel×pipe_parallel = {dp}×{mp}×{pp} "
+            f"!= {n} devices"
+        )
+    if pp == 1:
+        arr = np.asarray(devices).reshape(dp, mp)
+        return Mesh(arr, (cfg.data_axis, cfg.model_axis))
+    arr = np.asarray(devices).reshape(dp, mp, pp)
+    return Mesh(arr, (cfg.data_axis, cfg.model_axis, cfg.pipe_axis))
 
 
 def flat_mesh(mesh: Mesh, axis: str) -> Mesh:

@@ -1,0 +1,151 @@
+"""Pipeline-parallel execution of the ViT family — PP as a pure execution
+strategy, wired to the trainer by ``--pp-stages N``.
+
+The reference never pipelines model layers (its "pipeline" is the 4-stage MPI
+*preprocessing* stream, ``evaluation_pipeline.py:162-199``); this module puts
+the missing strategy on the actual training path. Design rule: **the param
+tree does not change**. ``make_pp_apply`` returns a drop-in replacement for
+``model.apply`` over the SAME variables the unpipelined model initializes and
+checkpoints — the prologue (patch embed + position embeddings) and epilogue
+(final LN, GAP, head) run through the model's own submodule classes, and the
+depth-homogeneous encoder trunk is split into S stages whose params are
+stacked on the fly and streamed through :func:`parallel.pipeline.
+pipeline_forward` (GPipe fill-drain over ``ppermute``). Consequences:
+
+- checkpoints are PP-degree independent: a run trained at ``--pp-stages 4``
+  resumes unpipelined, or at any other stage count that divides the depth;
+- equivalence is testable param-for-param: PP and unpipelined training steps
+  must produce the same updated params (tests/test_pipeline.py);
+- the swap composes with everything keyed on ``state.apply_fn`` — streaming,
+  device-cache, scanned-epoch, and eval steps all pipeline for free.
+
+Restrictions (validated in config): dense ViT blocks only (no MoE sow across
+the shard_map boundary), no SP attention inside stages, dropout 0.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mpi_pytorch_tpu.models.vit import EncoderBlock, VisionTransformer
+from mpi_pytorch_tpu.parallel.pipeline import pipeline_forward, stack_stage_params
+
+
+def _stack_trunk(params: dict, depth: int, stages: int):
+    """[S, L, ...]-stacked trunk params from the model's ``block{i}``
+    subtrees: leading stage axis (sharded over ``pipe``), then the L
+    blocks-per-stage axis the stage function loops over. ``jnp.stack`` is
+    linear, so gradients flow back to each block's own leaves unchanged."""
+    per_stage = depth // stages
+    return stack_stage_params([
+        jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves),
+            *(params[f"block{s * per_stage + j}"] for j in range(per_stage)),
+        )
+        for s in range(stages)
+    ])
+
+
+def make_pp_apply(
+    model: VisionTransformer,
+    mesh,
+    *,
+    num_microbatches: int,
+    pipe_axis: str = "pipe",
+    data_axis: str | None = "data",
+    remat: bool = False,
+):
+    """Build an ``apply_fn``-compatible pipelined forward for ``model``.
+
+    The returned function has the ``flax`` apply calling convention the train
+    and eval steps use (``variables, x, train=..., rngs=..., mutable=...``),
+    so it drops into ``TrainState.create(apply_fn=...)`` with no step
+    changes. ``remat=True`` recomputes each stage's internals in the backward
+    (the PP face of ``remat='blocks'``)."""
+    if not isinstance(model, VisionTransformer):
+        raise ValueError(f"pipeline parallelism supports the ViT family, got {model}")
+    if model.moe_every > 0:
+        raise ValueError(
+            "pipeline parallelism requires dense encoder blocks (the MoE "
+            "aux-loss sow cannot cross the pipeline boundary)"
+        )
+    if model.sp_strategy != "none":
+        raise ValueError("pipeline stages cannot nest SP attention")
+    if model.dropout != 0.0:
+        raise ValueError(
+            "pipeline parallelism requires dropout=0 (per-block rng streams "
+            "are not threaded through the stage scan)"
+        )
+    stages = mesh.shape[pipe_axis]
+    if model.depth % stages:
+        raise ValueError(
+            f"depth {model.depth} not divisible by pp_stages {stages}"
+        )
+    per_stage = model.depth // stages
+
+    block = EncoderBlock(
+        num_heads=model.num_heads,
+        mlp_dim=model.mlp_dim,
+        dropout=0.0,
+        dtype=model.dtype,
+        param_dtype=model.param_dtype,
+    )
+
+    # ONE stage_fn object per make_pp_apply call: pipeline_forward keys its
+    # jit cache on this function's identity, so it must not be rebuilt per
+    # step (build_training calls this once per run).
+    def stage_fn(stage_params, x):
+        # stage_params leaves are [L, ...] (the [S, L, ...] stack after the
+        # pipe sharding squeezed the stage axis); apply the L blocks in order.
+        for j in range(per_stage):
+            p_j = jax.tree_util.tree_map(lambda leaf: leaf[j], stage_params)
+            x = block.apply({"params": p_j}, x, False)
+        return x
+
+    conv = nn.Conv(
+        model.hidden,
+        (model.patch_size, model.patch_size),
+        strides=(model.patch_size, model.patch_size),
+        padding="VALID",
+        dtype=model.dtype,
+        param_dtype=model.param_dtype,
+    )
+    ln = nn.LayerNorm(dtype=model.dtype, param_dtype=model.param_dtype)
+    head = nn.Dense(
+        model.num_classes, dtype=model.dtype, param_dtype=model.param_dtype
+    )
+
+    def pp_apply(variables, x, train=False, rngs=None, mutable=None):
+        params = variables["params"]
+        # Prologue — the model's own submodule classes over its own param
+        # subtrees, so PP can never drift numerically from models/vit.py
+        # (the equivalence test asserts it param-for-param).
+        x = conv.apply({"params": params["patch_embed"]}, x)
+        b, gh, gw, c = x.shape
+        x = x.reshape(b, gh * gw, c)
+        x = x + params["pos_embed"].astype(x.dtype)
+
+        stacked = _stack_trunk(params, model.depth, stages)
+        x = pipeline_forward(
+            stacked,
+            x,
+            mesh,
+            stage_fn=stage_fn,
+            num_microbatches=num_microbatches,
+            pipe_axis=pipe_axis,
+            data_axis=data_axis,
+            remat=remat,
+        )
+
+        x = ln.apply({"params": params["ln"]}, x)
+        x = x.mean(axis=1)
+        out = head.apply({"params": params["head"]}, x)
+        # flax mutable-call convention: ViTs carry no batch_stats and dense
+        # blocks sow no losses, so the updated-collections dict is empty.
+        if mutable is not None and mutable is not False:
+            return out, {}
+        return out
+
+    return pp_apply

@@ -224,6 +224,32 @@ def build_training(cfg: Config, mesh=None):
         tx=tx,
         rng=jax.random.PRNGKey(cfg.seed + 1),
     )
+    if cfg.pp_stages > 1:
+        # PP is an execution strategy, not a different model: swap the
+        # apply_fn for the pipelined forward over the SAME param tree
+        # (parallel/pp_vit.py), and every step flavor keyed on
+        # state.apply_fn — streaming, cached, scanned-epoch, eval —
+        # pipelines from here on.
+        from mpi_pytorch_tpu.parallel.pp_vit import make_pp_apply
+
+        mb_count = cfg.pp_microbatches or 2 * cfg.pp_stages
+        mb_rows = cfg.batch_size // mb_count
+        if mb_rows % data_size:
+            raise ValueError(
+                f"pipeline microbatch rows {mb_rows} "
+                f"(batch {cfg.batch_size} / {mb_count} microbatches) not "
+                f"divisible by data-parallel size {data_size}"
+            )
+        state = state.replace(
+            apply_fn=make_pp_apply(
+                bundle.model,
+                mesh,
+                num_microbatches=mb_count,
+                pipe_axis=cfg.mesh.pipe_axis,
+                data_axis=cfg.mesh.data_axis,
+                remat=(cfg.remat == "blocks"),
+            )
+        )
     return mesh, bundle, state, (train_manifest, test_manifest, train_loader)
 
 

@@ -113,3 +113,39 @@ def test_supported_models_matches_registry():
     from mpi_pytorch_tpu.models.registry import available_models
 
     assert tuple(SUPPORTED_MODELS) == tuple(available_models())
+
+
+def test_pp_stages_validation():
+    """--pp-stages gates: pipeline-shaped models only, auto mode only, no
+    SP/EP/accum nesting, batch divisibility — and pp_stages drives the
+    mesh's pipe axis."""
+    ok = parse_config(["--model-name", "vit_s16", "--pp-stages", "4"])
+    assert ok.pp_stages == 4 and ok.mesh.pipe_parallel == 4
+
+    with pytest.raises(ValueError, match="pipeline-shaped"):
+        parse_config(["--pp-stages", "4"])  # default resnet18
+    with pytest.raises(ValueError, match="pipeline-shaped"):
+        parse_config(["--model-name", "vit_moe_s16", "--pp-stages", "4"])
+    with pytest.raises(ValueError, match="auto-partitioned"):
+        parse_config(["--model-name", "vit_s16", "--pp-stages", "4",
+                      "--spmd-mode", "true"])
+    with pytest.raises(ValueError, match="sp-strategy|SP attention"):
+        parse_config(["--model-name", "vit_s16", "--pp-stages", "4",
+                      "--sp-strategy", "ring"])
+    with pytest.raises(ValueError, match="expert"):
+        parse_config(["--model-name", "vit_s16", "--pp-stages", "4",
+                      "--expert-parallel", "true"])
+    with pytest.raises(ValueError, match="microbatches"):
+        parse_config(["--model-name", "vit_s16", "--pp-stages", "4",
+                      "--accum-steps", "2"])
+    with pytest.raises(ValueError, match="not divisible"):
+        parse_config(["--model-name", "vit_s16", "--pp-stages", "4",
+                      "--batch-size", "130"])
+    with pytest.raises(ValueError, match="fsdp"):
+        parse_config(["--model-name", "vit_s16", "--pp-stages", "4",
+                      "--fsdp", "true"])
+    with pytest.raises(ValueError, match="zero"):
+        parse_config(["--model-name", "vit_s16", "--pp-stages", "4",
+                      "--zero-optimizer", "true"])
+    with pytest.raises(ValueError, match="pp_microbatches only applies"):
+        parse_config(["--model-name", "vit_s16", "--pp-microbatches", "8"])

@@ -188,6 +188,158 @@ def test_pipeline_runs_vit_encoder_blocks(mesh):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
 
 
+# --- PP as a trainer capability (--pp-stages): parallel/pp_vit.py ---------
+
+
+def _tiny_vit(num_classes=7, depth=4, **kw):
+    from mpi_pytorch_tpu.models.vit import VisionTransformer
+
+    return VisionTransformer(
+        num_classes=num_classes, patch_size=4, hidden=16, depth=depth,
+        num_heads=2, mlp_dim=32, dtype=jnp.float32, param_dtype=jnp.float32,
+        **kw,
+    )
+
+
+def _pp_mesh(stages=4):
+    from mpi_pytorch_tpu.config import MeshConfig
+    from mpi_pytorch_tpu.parallel.mesh import create_mesh
+
+    return create_mesh(MeshConfig(pipe_parallel=stages))
+
+
+def test_pp_apply_matches_model_apply():
+    """make_pp_apply over the UNCHANGED param tree reproduces model.apply
+    exactly: logits and per-param grads — pipelining is an execution
+    strategy, not a different model."""
+    from mpi_pytorch_tpu.parallel.pp_vit import make_pp_apply
+
+    model = _tiny_vit()
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((16, 16, 16, 3)), jnp.float32
+    )
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x[:2], train=False)
+    mesh = _pp_mesh(4)
+    pp_apply = make_pp_apply(model, mesh, num_microbatches=8)
+
+    got = pp_apply(variables, x, train=False)
+    want = model.apply(variables, x, train=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+    labels = jnp.asarray(np.random.default_rng(1).integers(0, 7, 16), jnp.int32)
+
+    def ce(apply_fn):
+        def loss(params):
+            logits = apply_fn({"params": params}, x, train=False)
+            import optax
+
+            return jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+            )
+
+        return jax.grad(loss)(variables["params"])
+
+    g_pp, g_ref = ce(pp_apply), ce(model.apply)
+    assert jax.tree_util.tree_structure(g_pp) == jax.tree_util.tree_structure(g_ref)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pp), jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+
+def test_pp_train_step_matches_unpipelined():
+    """The FULL jitted train step (loss, grads, Adam update) with the PP
+    apply_fn produces the same updated params as the unpipelined step —
+    the --pp-stages ≡ unpipelined trajectory property, two steps deep."""
+    import optax
+
+    from mpi_pytorch_tpu.parallel.mesh import shard_batch
+    from mpi_pytorch_tpu.parallel.pp_vit import make_pp_apply
+    from mpi_pytorch_tpu.train.state import TrainState
+    from mpi_pytorch_tpu.train.step import make_train_step
+
+    model = _tiny_vit()
+    mesh = _pp_mesh(4)
+    rng = np.random.default_rng(2)
+    x = np.asarray(rng.standard_normal((16, 16, 16, 3)), np.float32)
+    labels = np.asarray(rng.integers(0, 7, 16), np.int32)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(3)}, jnp.asarray(x[:2]), train=False
+    )
+
+    def run(apply_fn):
+        # Fresh buffers per run: the jitted step donates the state, so the
+        # two runs must not share the init arrays. SGD, not Adam: Adam's
+        # m/sqrt(v) normalization amplifies noise-level grad differences on
+        # zero-grad params into O(lr) update differences, which would force
+        # a vacuous tolerance — SGD keeps the comparison linear in grads.
+        fresh = jax.tree_util.tree_map(jnp.array, variables)
+        state = TrainState.create(
+            apply_fn=apply_fn, variables=fresh, tx=optax.sgd(1e-2),
+            rng=jax.random.PRNGKey(4),
+        )
+        step = make_train_step(compute_dtype=jnp.float32)
+        batch = shard_batch((jnp.asarray(x), jnp.asarray(labels)), mesh)
+        metrics = None
+        for _ in range(2):
+            state, metrics = step(state, batch)
+        return state, metrics
+
+    s_pp, m_pp = run(make_pp_apply(model, mesh, num_microbatches=8))
+    s_ref, m_ref = run(model.apply)
+    np.testing.assert_allclose(float(m_pp["loss"]), float(m_ref["loss"]), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_pp.params), jax.tree_util.tree_leaves(s_ref.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_pp_apply_guards():
+    """make_pp_apply rejects the configurations whose semantics would
+    silently differ: MoE blocks, SP attention, dropout, indivisible depth."""
+    from mpi_pytorch_tpu.parallel.pp_vit import make_pp_apply
+
+    mesh = _pp_mesh(4)
+    with pytest.raises(ValueError, match="dense encoder blocks"):
+        make_pp_apply(_tiny_vit(moe_every=2), mesh, num_microbatches=8)
+    with pytest.raises(ValueError, match="dropout"):
+        make_pp_apply(_tiny_vit(dropout=0.1), mesh, num_microbatches=8)
+    with pytest.raises(ValueError, match="not divisible"):
+        make_pp_apply(_tiny_vit(depth=6), mesh, num_microbatches=8)
+
+
+@pytest.mark.slow
+def test_pp_stages_config_trains_vit(tmp_path):
+    """--pp-stages 4 end to end through parse_config/build_training/train on
+    the 8-device mesh (pipe=4 × data=2): the trainer runs, the loss is
+    finite and decreasing, and the checkpoint it writes restores into an
+    UNPIPELINED run (PP-degree-independent checkpoints)."""
+    from mpi_pytorch_tpu.config import parse_config
+    from mpi_pytorch_tpu.train.trainer import train
+
+    args = [
+        "--model-name", "vit_s16", "--pp-stages", "4",
+        "--debug", "true", "--debug-sample-size", "64",
+        "--image-size", "32", "--batch-size", "16", "--num-classes", "1000",
+        "--num-epochs", "2", "--synthetic-data", "true", "--validate", "false",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--log-file", str(tmp_path / "training.log"),
+        "--metrics-file", str(tmp_path / "metrics.jsonl"),
+    ]
+    cfg = parse_config(args)
+    assert cfg.mesh.pipe_parallel == 4
+    summary = train(cfg)
+    assert summary.epochs_run == 2
+    assert np.isfinite(summary.final_loss)
+
+    # Resume the PP checkpoint WITHOUT pipelining: same param tree.
+    cfg2 = parse_config(
+        args[:2] + args[4:] + ["--from-checkpoint", "true", "--num-epochs", "3"]
+    )
+    assert cfg2.pp_stages == 1
+    summary2 = train(cfg2)
+    assert summary2.epochs_run == 1
+    assert np.isfinite(summary2.final_loss)
+
+
 def test_pipeline_rejects_bad_shapes(mesh, stacked):
     with pytest.raises(ValueError, match="not divisible"):
         pipeline_forward(
