@@ -91,11 +91,6 @@ def evaluate(cfg: Config) -> EvalSummary:
 
     maybe_initialize_distributed()
     apply_runtime_flags(cfg)
-    if cfg.predictions_file and jax.process_count() > 1:
-        # Fail BEFORE any compute (matching validate_config's fail-early
-        # discipline): the predictions pass runs the whole manifest on one
-        # host's chips.
-        raise ValueError("predictions_file is single-process (run it on one host)")
     logger = init_logger("MPT_EVAL", cfg.eval_log_file)
     manifests = load_manifests(cfg)
     mesh, bundle, state, test_manifest = build_inference(cfg, manifests=manifests)
@@ -153,20 +148,48 @@ def evaluate(cfg: Config) -> EvalSummary:
 
 
 @functools.lru_cache(maxsize=None)
-def _make_predict_step(compute_dtype):
+def _make_predict_step(mesh, compute_dtype):
     """ONE batched forward yielding both the eval metrics and the per-image
     argmax — predictions and accuracy come from the same pass (the
     reference's predictor ranks compute the per-image argmax and discard it,
-    ``evaluation_pipeline.py:149-158``)."""
+    ``evaluation_pipeline.py:149-158``).
+
+    The argmax is PINNED to ``P(data)``: on multi-host the global array
+    spans non-addressable devices, and the caller reads back exactly its own
+    host's rows from the addressable shards — a compiler-chosen layout
+    (e.g. replicated) would silently hand every host all rows."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     from mpi_pytorch_tpu.train.step import eval_logits, metrics_from_logits
+
+    row_sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
 
     @jax.jit
     def predict(state, batch):
         images, labels = batch
         logits = eval_logits(state, images, compute_dtype)
-        return metrics_from_logits(logits, labels), jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        preds = jax.lax.with_sharding_constraint(
+            jnp.argmax(logits, axis=-1).astype(jnp.int32), row_sharding
+        )
+        return metrics_from_logits(logits, labels), preds
 
     return predict
+
+
+def _host_rows(p, host_batch: int):
+    """This host's rows of a ``P(data)``-sharded [B] array, in global row
+    order, read from the addressable shards only (``np.asarray`` on the
+    global array raises on multi-host). Shards replicated across a model/
+    pipe axis carry duplicate row blocks — deduped by start index."""
+    import numpy as np
+
+    by_start = {}
+    for s in p.addressable_shards:
+        start = s.index[0].start or 0
+        by_start.setdefault(start, np.asarray(s.data))
+    rows = np.concatenate([by_start[k] for k in sorted(by_start)])
+    assert rows.shape[0] == host_batch, (rows.shape, host_batch)
+    return rows
 
 
 def evaluate_with_predictions(
@@ -176,39 +199,83 @@ def evaluate_with_predictions(
     (file_name, predicted_label, predicted_category_id) in manifest order —
     the submission file the Herbarium task actually wants. The filename key
     mirrors ``GetData`` returning ``(tensor, fname)`` for the test split
-    (``data_loader.py:36-39``). Returns (accuracy, mean_loss)."""
+    (``data_loader.py:36-39``). Returns (accuracy, mean_loss).
+
+    Multi-host: every host walks its manifest shard through the same
+    synchronized global steps as ``evaluate_manifest`` (so the sharded
+    forward uses every chip of the pod), slices its own rows out of each
+    step's global argmax, and the per-host predictions — tiny int32 rows,
+    not images — are all-gathered so process 0 writes the single CSV in
+    global manifest order. No shared filesystem is required."""
     import numpy as np
 
     from mpi_pytorch_tpu.parallel.mesh import shard_batch
-    from mpi_pytorch_tpu.train.trainer import make_eval_loader, pad_batch
+    from mpi_pytorch_tpu.train.trainer import (
+        global_step_count,
+        make_eval_loader,
+        pad_batch,
+        synchronized_batches,
+    )
 
-    loader = make_eval_loader(cfg, test_manifest)  # shard(1, 0) = identity
+    n_proc, pid = jax.process_count(), jax.process_index()
+    host_batch = cfg.batch_size // n_proc
+    loader = make_eval_loader(cfg, test_manifest)  # this host's shard
+    local_n = len(loader.manifest)
     compute_dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[cfg.compute_dtype]
-    predict = _make_predict_step(compute_dtype)
+    predict = _make_predict_step(mesh, compute_dtype)
     preds: list = []
     loss_sum = correct = count = 0.0
-    for images, labels in loader.epoch(0):
-        batch = shard_batch(pad_batch(images, labels, loader.batch_size), mesh)
+    n_steps = global_step_count(len(test_manifest), host_batch, drop_remainder=False)
+    for images, labels in synchronized_batches(loader, 0, n_steps):
+        batch = shard_batch(pad_batch(images, labels, host_batch), mesh)
         m, p = predict(state, batch)
-        preds.append(np.asarray(p))
+        # Global batch rows [pid*hb, (pid+1)*hb) are THIS host's images
+        # (shard_batch assembles the global array host-major), and the
+        # P(data)-pinned argmax keeps them on this host's devices.
+        preds.append(_host_rows(p, host_batch))
         loss_sum += float(m["loss"])
         correct += int(m["correct"])
         count += int(m["count"])
-    labels_pred = np.concatenate(preds)[: len(test_manifest)]  # drop tail padding
+    local_preds = np.concatenate(preds)[:local_n]  # drop tail/filler padding
 
-    # Contiguous label -> raw Herbarium category_id, from BOTH splits (the
-    # label map was built over both, data/manifest.py build_label_map).
-    label_to_cat: dict[int, int] = {}
-    for m in (train_manifest, test_manifest):
-        label_to_cat.update(zip(m.labels.tolist(), m.category_ids.tolist()))
+    if n_proc > 1:
+        from jax.experimental import multihost_utils
 
-    tmp = cfg.predictions_file + ".tmp"
-    with open(tmp, "w") as f:
-        f.write("file_name,predicted_label,predicted_category_id\n")
-        for fname, p in zip(test_manifest.filenames, labels_pred.tolist()):
-            f.write(f"{fname},{p},{label_to_cat.get(p, -1)}\n")
-    os.replace(tmp, cfg.predictions_file)
-    logger.info("predictions written: %s (%d rows)", cfg.predictions_file, len(labels_pred))
+        # array_split shard sizes are deterministic — every host computes the
+        # same layout, pads its rows to the max, and the gather is one tiny
+        # [P, max] int32 exchange.
+        sizes = [
+            len(part)
+            for part in np.array_split(np.arange(len(test_manifest)), n_proc)
+        ]
+        buf = np.full((max(sizes),), -1, np.int32)
+        buf[:local_n] = local_preds
+        gathered = np.asarray(multihost_utils.process_allgather(buf))
+        labels_pred = np.concatenate(
+            [gathered[p, : sizes[p]] for p in range(n_proc)]
+        )
+    else:
+        labels_pred = local_preds
+    assert len(labels_pred) == len(test_manifest), (
+        len(labels_pred), len(test_manifest),
+    )
+
+    if pid == 0:
+        # Contiguous label -> raw Herbarium category_id, from BOTH splits (the
+        # label map was built over both, data/manifest.py build_label_map).
+        label_to_cat: dict[int, int] = {}
+        for m in (train_manifest, test_manifest):
+            label_to_cat.update(zip(m.labels.tolist(), m.category_ids.tolist()))
+
+        tmp = cfg.predictions_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("file_name,predicted_label,predicted_category_id\n")
+            for fname, p in zip(test_manifest.filenames, labels_pred.tolist()):
+                f.write(f"{fname},{p},{label_to_cat.get(p, -1)}\n")
+        os.replace(tmp, cfg.predictions_file)
+        logger.info(
+            "predictions written: %s (%d rows)", cfg.predictions_file, len(labels_pred)
+        )
     acc = correct / count if count else 0.0
     return acc, (loss_sum / count if count else float("nan"))
 

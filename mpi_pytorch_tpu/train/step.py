@@ -265,11 +265,46 @@ def make_cached_train_step(mesh, compute_dtype=jnp.bfloat16, remat: bool = False
     return cached_step
 
 
+def _sharded_cache_take(mesh, dataset, idx):
+    """Batch-row gather from a dataset whose rows are SHARDED over the data
+    axis (``trainer.build_device_cache``): each shard gathers the indices
+    that fall in its row range (masked to zero otherwise) and a ``psum``
+    combines them — exact, because every global row lives on exactly one
+    shard (masked uint8 sums cannot overflow: all other contributions are
+    literal zeros). The replicated output is immediately shard-constrained
+    back onto ``data`` by the caller, which XLA folds into a
+    reduce-scatter — per-step cross-shard traffic of about one batch, the
+    price of holding 1/n of the dataset per device instead of a full
+    replica."""
+    data_axis = mesh.axis_names[0]
+    per = dataset.shape[0] // mesh.shape[data_axis]
+
+    def local(ds_local, idx_g):
+        li = idx_g - lax.axis_index(data_axis) * per
+        inb = (li >= 0) & (li < per)
+        rows = jnp.take(ds_local, jnp.clip(li, 0, per - 1), axis=0)
+        mask = inb.reshape((-1,) + (1,) * (rows.ndim - 1))
+        rows = jnp.where(mask, rows, jnp.zeros((), rows.dtype))
+        return lax.psum(rows, data_axis)
+
+    return shard_map(
+        local, mesh=mesh, in_specs=(P(data_axis), P()), out_specs=P(),
+        check_vma=False,
+    )(dataset, idx)
+
+
 def _gather_batch(mesh, compute_dtype, dataset, labels_all, idx, valid):
     """Index-gather a batch from the HBM-resident dataset, shard-constrained
     onto the data axis — THE shared ingest of the cached train, scanned-epoch,
-    and cached eval steps, so none can drift from the others."""
-    images = ingest_images(jnp.take(dataset, idx, axis=0), compute_dtype)
+    and cached eval steps, so none can drift from the others. The dataset's
+    rows are sharded over ``data`` whenever that axis has >1 device
+    (``build_device_cache``), so the gather goes through the cross-shard
+    path; a 1-device data axis holds the whole dataset locally."""
+    if mesh.shape[mesh.axis_names[0]] > 1:
+        raw = _sharded_cache_take(mesh, dataset, idx)
+    else:
+        raw = jnp.take(dataset, idx, axis=0)
+    images = ingest_images(raw, compute_dtype)
     images = lax.with_sharding_constraint(
         images, NamedSharding(mesh, P(mesh.axis_names[0]))
     )

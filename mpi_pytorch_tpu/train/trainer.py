@@ -380,41 +380,69 @@ def device_prefetch(batches, mesh, host_batch: int, depth: int = 2):
         yield buf.popleft()
 
 
-def build_device_cache(cfg: Config, loader: DataLoader, mesh):
-    """Materialize the loader's whole shard as device-resident arrays
-    (images replicated over the mesh, in ``cfg.input_dtype``), for the
-    ``device_cache`` fast path. One pass through the loader in manifest
-    order; the per-epoch shuffle happens on indices instead."""
+def build_device_cache(cfg: Config, manifest, loader: DataLoader, mesh):
+    """Materialize the train split as a device-resident dataset with rows
+    SHARDED over the data axis — per-device HBM is ``dataset/n_data``, not a
+    full replica per chip — plus replicated (tiny) labels. One decode pass
+    in manifest order; the per-epoch shuffle happens on indices instead, and
+    the step gathers batch rows across shards (``step._sharded_cache_take``).
+
+    ``manifest`` is the GLOBAL train manifest: global row i is dataset row i
+    on every host, so the identical seeded index permutation each host draws
+    refers to the same images. Each host decodes exactly the contiguous row
+    range its local devices hold (data is the mesh's major axis), which is
+    what makes the cache build itself scale with the host count. Rows are
+    padded up to a multiple of the data-axis size; padding rows sit past the
+    real row count and are never indexed."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    ordered = DataLoader(
-        loader.manifest,
-        batch_size=loader.batch_size,
-        image_size=loader.image_size,
-        shuffle=False,
-        drop_remainder=False,
-        synthetic=loader.synthetic,
-        num_workers=loader.num_workers,
-        prefetch=loader.prefetch,
-        image_dtype=str(np.dtype(loader.image_dtype)),
-        native_decode=loader.native_decode,
-        decode_prescale=loader.decode_prescale,
-        packed_dir=loader.packed_dir,
-    )
+    data_axis = mesh.axis_names[0]
+    n_data = mesh.shape[data_axis]
+    n = len(manifest)
+    padded = -(-n // n_data) * n_data
+    shape = (padded, *loader.image_size, 3)
+    sharding = NamedSharding(mesh, P(data_axis))
+
+    # This host's addressable slice of the sharded rows: contiguous because
+    # ``data`` is the leading (process-major) mesh axis.
+    imap = sharding.addressable_devices_indices_map(shape)
+    lo = min((s[0].start or 0) for s in imap.values())
+    hi = max((s[0].stop if s[0].stop is not None else padded) for s in imap.values())
+    real_hi = min(hi, n)
+
     # Preallocate and fill in place: np.concatenate over a parts list would
-    # transiently hold the dataset twice, at exactly the scale (GBs) this
-    # feature targets.
-    images = np.empty(
-        (len(loader.manifest), *loader.image_size, 3), loader.image_dtype
-    )
-    row = 0
-    for batch_images, _ in ordered.epoch(0):
-        images[row : row + batch_images.shape[0]] = batch_images
-        row += batch_images.shape[0]
-    assert row == images.shape[0], (row, images.shape)
+    # transiently hold the slice twice, at exactly the scale (GBs) this
+    # feature targets. Zeros beyond real_hi are the never-indexed padding.
+    local = np.zeros((hi - lo, *loader.image_size, 3), loader.image_dtype)
+    if real_hi > lo:
+        ordered = DataLoader(
+            manifest.select(np.arange(lo, real_hi)),
+            batch_size=loader.batch_size,
+            image_size=loader.image_size,
+            shuffle=False,
+            drop_remainder=False,
+            synthetic=loader.synthetic,
+            num_workers=loader.num_workers,
+            prefetch=loader.prefetch,
+            image_dtype=str(np.dtype(loader.image_dtype)),
+            native_decode=loader.native_decode,
+            decode_prescale=loader.decode_prescale,
+            packed_dir=loader.packed_dir,
+        )
+        row = 0
+        for batch_images, _ in ordered.epoch(0):
+            local[row : row + batch_images.shape[0]] = batch_images
+            row += batch_images.shape[0]
+        assert row == real_hi - lo, (row, lo, real_hi)
+
     rep = NamedSharding(mesh, P())
-    dataset = jax.device_put(images, rep)
-    labels = jax.device_put(loader.manifest.labels.astype(np.int32), rep)
+    labels_np = manifest.labels.astype(np.int32)
+    if jax.process_count() == 1:
+        dataset = jax.device_put(local, sharding)
+        labels = jax.device_put(labels_np, rep)
+    else:
+        dataset = jax.make_array_from_process_local_data(sharding, local)
+        labels = jax.make_array_from_process_local_data(rep, labels_np)
     jax.block_until_ready(dataset)
     return dataset, labels
 
@@ -481,13 +509,15 @@ def evaluate_cached(cfg: Config, state: TrainState, mesh, dataset, labels) -> tu
     ``val_on_train=True``, the reference's default, the val set IS the
     already-cached train set)."""
     eval_step = make_cached_eval_step(mesh, _dtype(cfg.compute_dtype))
-    host_batch = cfg.batch_size // jax.process_count()
-    n = int(dataset.shape[0])
-    n_steps = -(-n // host_batch)
+    # Real row count from the labels: the sharded dataset's row dim carries
+    # divisibility padding past it (build_device_cache) that must not be
+    # evaluated. Index batches are global and identical on every host.
+    n = int(labels.shape[0])
+    n_steps = -(-n // cfg.batch_size)
     return _accumulate_eval(
         eval_step(state, dataset, labels, idx, valid)
         for idx, valid in cached_index_batches(
-            cfg, n, host_batch, epoch=0, n_steps=n_steps, shuffle=False
+            cfg, n, cfg.batch_size, epoch=0, n_steps=n_steps, shuffle=False
         )
     )
 
@@ -532,16 +562,23 @@ def train(cfg: Config) -> TrainSummary:
     n_steps = global_step_count(len(train_manifest), host_batch, cfg.drop_remainder)
     dataset = labels_all = None
     val_loader = None  # built lazily, then reused so its host cache persists
+    # Cached-mode index batches are GLOBAL (every host draws the identical
+    # seeded permutation over the global manifest): one [B] index array per
+    # step on all hosts, stepping over global rows.
+    cache_batch = cfg.batch_size
+    n_cache = len(train_manifest)
     if cfg.device_cache:
-        if jax.process_count() > 1:
-            raise ValueError(
-                "device_cache is single-process only; multi-host runs stream "
-                "per-host shards (set device_cache=False)"
-            )
-        dataset, labels_all = build_device_cache(cfg, loader, mesh)
+        # Step count over the GLOBAL walk (the streaming count derives from
+        # per-host array_split shards and can differ by rounding off it).
+        n_steps = (
+            n_cache // cache_batch if cfg.drop_remainder else -(-n_cache // cache_batch)
+        )
+        dataset, labels_all = build_device_cache(cfg, train_manifest, loader, mesh)
+        n_data = mesh.shape[cfg.mesh.data_axis]
         logger.info(
-            "device cache: %d images (%.1f MB %s) resident in HBM",
-            dataset.shape[0], dataset.nbytes / 1e6, dataset.dtype,
+            "device cache: %d images, rows sharded over %d device(s) "
+            "(%.1f MB/device %s)",
+            n_cache, n_data, dataset.nbytes / n_data / 1e6, dataset.dtype,
         )
         # The per-step program is the FLOPs reference either way; the scan
         # mode reuses the Lowered (cost analysis needs no backend compile)
@@ -551,7 +588,7 @@ def train(cfg: Config) -> TrainSummary:
             donate_argnums=(0,), out_shardings=(_state_shardings(state), None),
         ).lower(
             state, dataset, labels_all,
-            np.zeros((host_batch,), np.int32), np.ones((host_batch,), bool),
+            np.zeros((cache_batch,), np.int32), np.ones((cache_batch,), bool),
         )
         if cfg.scan_epoch:
             epoch_fn = make_scanned_epoch(mesh, _dtype(cfg.compute_dtype), remat=(cfg.remat == "full"))
@@ -560,8 +597,8 @@ def train(cfg: Config) -> TrainSummary:
                 out_shardings=(_state_shardings(state), None),
             ).lower(
                 state, dataset, labels_all,
-                np.zeros((n_steps, host_batch), np.int32),
-                np.ones((n_steps, host_batch), bool),
+                np.zeros((n_steps, cache_batch), np.int32),
+                np.ones((n_steps, cache_batch), bool),
             ).compile()
         else:
             compiled_step = lowered_step.compile()
@@ -663,7 +700,7 @@ def train(cfg: Config) -> TrainSummary:
                 # back-to-back on device. metrics come back as [n_steps]
                 # arrays — used as-is, never split into per-step scalars.
                 idx_steps = list(
-                    cached_index_batches(cfg, len(loader.manifest), host_batch, epoch, n_steps)
+                    cached_index_batches(cfg, n_cache, cache_batch, epoch, n_steps)
                 )
                 if idx_steps:  # zero-step epochs (tiny shard + drop_remainder) no-op
                     idx_all = np.stack([i for i, _ in idx_steps])
@@ -685,7 +722,7 @@ def train(cfg: Config) -> TrainSummary:
                 step_args = (
                     (dataset, labels_all, idx, valid)
                     for idx, valid in cached_index_batches(
-                        cfg, len(loader.manifest), host_batch, epoch, n_steps
+                        cfg, n_cache, cache_batch, epoch, n_steps
                     )
                 )
             else:

@@ -11,14 +11,32 @@ _PEAK_BF16_TFLOPS = {
     "v6 lite": 918.0, "v6e": 918.0,
 }
 
+# Peak HBM bandwidth per chip, GB/s (published specs) — the denominator of
+# the roofline's bandwidth leg (tools/roofline.py).
+_PEAK_HBM_GBPS = {
+    "v4": 1228.0,
+    "v5 lite": 819.0, "v5e": 819.0,
+    "v5p": 2765.0,
+    "v6 lite": 1640.0, "v6e": 1640.0,
+}
 
-def peak_bf16_tflops(device) -> float | None:
-    """Peak bf16 TFLOP/s for a jax device, or None if unknown (CPU, new TPUs)."""
+
+def _lookup(table: dict, device) -> float | None:
     kind = getattr(device, "device_kind", "").lower()
-    for name, peak in _PEAK_BF16_TFLOPS.items():
+    for name, peak in table.items():
         if name in kind:
             return peak
     return None
+
+
+def peak_bf16_tflops(device) -> float | None:
+    """Peak bf16 TFLOP/s for a jax device, or None if unknown (CPU, new TPUs)."""
+    return _lookup(_PEAK_BF16_TFLOPS, device)
+
+
+def peak_hbm_gbps(device) -> float | None:
+    """Peak HBM GB/s for a jax device, or None if unknown."""
+    return _lookup(_PEAK_HBM_GBPS, device)
 
 
 def step_flops(compiled) -> float:

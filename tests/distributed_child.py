@@ -118,6 +118,59 @@ def main() -> None:
     losses = " ".join(f"{l:.6f}" for l in summary.epoch_losses)
     print(f"TRAIN_OK {losses} acc {summary.val_accuracy:.4f}", flush=True)
 
+    # Device cache SHARDED over the 8-device data axis spanning both
+    # processes: each device holds ceil(N/8) rows (not a full replica), each
+    # host decodes only its own contiguous row range, every host draws the
+    # identical global index permutation, and the step's cross-shard gather
+    # (step._sharded_cache_take) reassembles global batches — composed with
+    # scan_epoch (the whole epoch as one compiled program) and cached
+    # val-on-train evaluation.
+    cfg3 = Config(
+        model_name="resnet18", num_classes=1000, batch_size=8, num_epochs=2,
+        debug=True, debug_sample_size=29, synthetic_data=True,
+        device_cache=True, scan_epoch=True, drop_remainder=True,
+        compute_dtype="float32", width=32, height=32,
+        validate=True, val_on_train=True,
+        checkpoint_every_epochs=0, log_every_steps=0, metrics_file="",
+        log_file=os.path.join(scratch, f"devcache_{jax.process_index()}.log"),
+        checkpoint_dir=os.path.join(scratch, "ckpt_devcache"),
+    )
+    cfg3.validate_config()
+    from mpi_pytorch_tpu.train.trainer import build_device_cache, build_training
+
+    mesh3, _, _, (train_m3, _, loader3) = build_training(cfg3)
+    ds3, _lb3 = build_device_cache(cfg3, train_m3, loader3, mesh3)
+    # 23 train rows (the 29-sample 80/20 split) pad to 24 over 8 devices:
+    # exactly 3 rows per device, 12 per host — sharded, not replicated.
+    for sh in ds3.addressable_shards:
+        assert sh.data.shape[0] == 3, sh.data.shape
+    summary3 = train(cfg3)
+    assert summary3.epochs_run == 2, summary3.epochs_run
+    losses3 = " ".join(f"{l:.6f}" for l in summary3.epoch_losses)
+    print(f"DEVCACHE_OK {losses3} acc {summary3.val_accuracy:.4f}", flush=True)
+
+    # Multi-host predictions: the predictions pass runs the synchronized
+    # sharded forward on every chip of BOTH processes, all-gathers the
+    # per-host argmax rows (tiny int32, no shared FS needed), and process 0
+    # writes the single CSV in global manifest order.
+    from mpi_pytorch_tpu.evaluate import evaluate
+
+    pred_file = os.path.join(scratch, "preds.csv")
+    cfg4 = Config(
+        model_name="resnet18", num_classes=1000, batch_size=8,
+        debug=True, debug_sample_size=29, synthetic_data=True,
+        compute_dtype="float32", width=32, height=32,
+        predictions_file=pred_file, metrics_file="",
+        eval_log_file=os.path.join(scratch, f"eval_{jax.process_index()}.log"),
+        checkpoint_dir=os.path.join(scratch, "ckpt_shared"),
+    )
+    cfg4.validate_config()
+    res = evaluate(cfg4)
+    if jax.process_index() == 0:
+        rows = open(pred_file).read().strip().splitlines()
+        assert len(rows) == 1 + res.num_images, (len(rows), res.num_images)
+    print(f"PRED_OK {res.accuracy:.4f} {res.num_images}", flush=True)
+
     # Multi-host agreed preemption: ONLY process 1 receives SIGTERM (a
     # watcher raises it in-process once its own log shows epoch 0 done);
     # process 0 must stop too — purely through the epoch-boundary all-reduce

@@ -129,6 +129,7 @@ def test_create_dataset_metadata_join(tmp_path):
     assert list(t2["file_name"]) == list(train_df["file_name"])
 
 
+@pytest.mark.slow
 def test_synthetic_jpeg_dataset_trains_via_decode_path(tmp_path):
     """--synthetic generates real JPEGs; training with synthetic_data=False
     exercises the actual PIL decode→resize→normalize path end to end."""
@@ -374,6 +375,7 @@ def test_packed_accepts_relative_img_dir_spelling(tmp_path, monkeypatch):
     assert handle.rows.shape[0] == len(train_m.filenames)
 
 
+@pytest.mark.slow
 def test_packed_cli_then_train(tmp_path):
     """The pack CLI writes both splits; the trainer consumes them through
     --packed-dir end to end."""

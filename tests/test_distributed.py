@@ -51,7 +51,9 @@ def test_two_process_distributed_train_step(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=600)
+            # Generous: two children × (DP step + two full trainer runs +
+            # predictions pass + preemption leg) on one starved CPU core.
+            out, _ = p.communicate(timeout=1800)
             outs.append(out)
     finally:  # a hung rendezvous must not leak children holding the port
         for p in procs:
@@ -80,6 +82,27 @@ def test_two_process_distributed_train_step(tmp_path):
     ]
     assert len(train_lines) == 2, outs
     assert train_lines[0] == train_lines[1], train_lines
+    # Sharded device cache across processes: both must complete the
+    # scan-epoch cached run and agree on per-epoch losses and accuracy.
+    devcache_lines = [
+        line
+        for out in outs
+        for line in out.splitlines()
+        if line.startswith("DEVCACHE_OK")
+    ]
+    assert len(devcache_lines) == 2, outs
+    assert devcache_lines[0] == devcache_lines[1], devcache_lines
+    # Multi-host predictions: both processes ran the sharded predictions
+    # pass and agree on its accuracy; process 0 wrote the single CSV.
+    pred_lines = [
+        line
+        for out in outs
+        for line in out.splitlines()
+        if line.startswith("PRED_OK")
+    ]
+    assert len(pred_lines) == 2, outs
+    assert pred_lines[0] == pred_lines[1], pred_lines
+    assert os.path.exists(os.path.join(str(tmp_path), "preds.csv"))
     # Agreed preemption: only process 1 was signaled; process 0 stopped via
     # the epoch-boundary all-reduce, and both agree on the epoch count.
     preempt_lines = [

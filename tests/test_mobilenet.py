@@ -4,8 +4,14 @@ depthwise/inverted-residual structure, and a loss-decreasing train step."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from mpi_pytorch_tpu.models import create_model_bundle
+
+# The whole module rides the expensive session-scoped model-zoo
+# compile (or end-to-end trainer runs): core-suite runs skip it
+# (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
 
 
 def test_mobilenet_param_count_matches_torchvision():

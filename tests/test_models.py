@@ -12,6 +12,11 @@ from mpi_pytorch_tpu.models.registry import init_variables
 
 from conftest import TEST_NUM_CLASSES as NUM_CLASSES
 
+# The whole module rides the expensive session-scoped model-zoo
+# compile (or end-to-end trainer runs): core-suite runs skip it
+# (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 BATCH = 2
 
 # torchvision parameter totals at num_classes=10 (fc/conv head resized):

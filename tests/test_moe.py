@@ -53,6 +53,7 @@ def dense_per_shard(params, x, *, k, capacity):
     return jnp.concatenate(blocks), jnp.mean(jnp.asarray(auxes))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("k", [1, 2])
 def test_moe_matches_dense(mesh, params, k):
     x = _x()
@@ -63,6 +64,7 @@ def test_moe_matches_dense(mesh, params, k):
     np.testing.assert_allclose(float(aux), float(aux_want), rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_moe_grads_match_dense(mesh, params):
     x = _x(seed=2)
     cap = T // N_SHARDS

@@ -52,6 +52,7 @@ def test_mesh_shapes():
         create_mesh(MeshConfig(model_parallel=3))
 
 
+@pytest.mark.slow
 def test_head_param_specs_tp():
     mesh = create_mesh(MeshConfig(model_parallel=2))
     bundle, variables = create_model_bundle(
@@ -63,6 +64,7 @@ def test_head_param_specs_tp():
     assert specs["conv1"]["kernel"] == P()
 
 
+@pytest.mark.slow
 def test_dp_step_equals_single_device():
     """8-way auto-mode DP step == single-device step on the full batch
     (resnet18: auto mode normalizes BN over the logical global batch, so the
@@ -139,6 +141,7 @@ def test_spmd_grads_match_manual_average():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("zoo_model", ["alexnet", "vit_s16"])
 def test_spmd_zoo_model_matches_manual_mpi_step(zoo_model):
     """One spmd-mode step on a real zoo model (alexnet: BN-free CNN with
@@ -196,6 +199,7 @@ def test_spmd_zoo_model_matches_manual_mpi_step(zoo_model):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("model", ["resnet18", "vit_s16"])
 def test_tp_head_step_runs_and_matches_dp(model):
     """dp=4 × tp=2: same loss/params as pure DP (TP must be numerically
@@ -223,6 +227,7 @@ def test_tp_head_step_runs_and_matches_dp(model):
     )
 
 
+@pytest.mark.slow
 def test_zero_optimizer_sharding_matches_replicated():
     """ZeRO-1-style moment sharding: (a) Adam moments are actually sharded
     over the data axis (per-device shard is 1/8 of the array), (b) one train
@@ -271,6 +276,7 @@ def test_zero_optimizer_sharding_matches_replicated():
     assert np.isfinite(float(m3["loss"]))
 
 
+@pytest.mark.slow
 def test_fsdp_param_sharding_matches_replicated():
     """ZeRO-3-style FSDP: (a) params themselves are sharded over the data
     axis at rest (the big conv kernels hold 1/8 per device) and the Adam
@@ -329,6 +335,7 @@ def test_fsdp_param_sharding_matches_replicated():
     assert np.isfinite(float(m3["loss"]))
 
 
+@pytest.mark.slow
 def test_async_checkpoint_gathers_zero_sharded_state(tmp_path):
     """AsyncCheckpointer on a ZeRO-sharded state: the snapshot gathers the
     data-axis-sharded Adam moments leaf-by-leaf to host (peak device overhead

@@ -73,6 +73,7 @@ def test_pipeline_matches_stacked_forward(mesh, stacked, num_micro):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_grads_match_stacked(mesh, stacked):
     x = _x(seed=2)
     y = jnp.asarray(np.random.default_rng(3).standard_normal(x.shape), jnp.float32)
@@ -165,6 +166,7 @@ def vit_block_stage(params, x):
     return EncoderBlock(**VIT_BLOCK).apply({"params": params}, x, train=False)
 
 
+@pytest.mark.slow
 def test_pipeline_runs_vit_encoder_blocks(mesh):
     """An 8-deep ViT encoder split one-block-per-stage over the pipe axis
     equals running the blocks sequentially on one device."""
@@ -208,6 +210,7 @@ def _pp_mesh(stages=4):
     return create_mesh(MeshConfig(pipe_parallel=stages))
 
 
+@pytest.mark.slow
 def test_pp_apply_matches_model_apply():
     """make_pp_apply over the UNCHANGED param tree reproduces model.apply
     exactly: logits and per-param grads — pipelining is an execution
@@ -245,6 +248,7 @@ def test_pp_apply_matches_model_apply():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_pp_train_step_matches_unpipelined():
     """The FULL jitted train step (loss, grads, Adam update) with the PP
     apply_fn produces the same updated params as the unpipelined step —

@@ -15,6 +15,11 @@ from mpi_pytorch_tpu.models import create_model_bundle
 from mpi_pytorch_tpu.models.common import head_filter
 from mpi_pytorch_tpu.models.torch_mapping import tv_entries
 
+# The whole module rides the expensive session-scoped model-zoo
+# compile (or end-to-end trainer runs): core-suite runs skip it
+# (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 ARCH = "resnet18"
 NUM_CLASSES = 50
 

@@ -36,6 +36,7 @@ def _tiny_cfg(tmpdir, **kw) -> Config:
     return cfg
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("spmd", [False, True])
 def test_loss_decreases(tmp_path, spmd):
     cfg = _tiny_cfg(str(tmp_path), num_epochs=3, spmd_mode=spmd,
@@ -46,6 +47,7 @@ def test_loss_decreases(tmp_path, spmd):
     assert os.path.exists(cfg.log_file)
 
 
+@pytest.mark.slow
 def test_checkpoint_resume(tmp_path):
     # num_classes=200 (not the full 64500) keeps the XLA CPU compile cheap;
     # raw-category-id label handling is covered by test_data.test_labels_fit_head.
@@ -60,6 +62,7 @@ def test_checkpoint_resume(tmp_path):
     assert "00001" in s2.checkpoint_path
 
 
+@pytest.mark.slow
 def test_validation_runs_on_train_split(tmp_path):
     cfg = _tiny_cfg(str(tmp_path), num_epochs=1, validate=True, num_classes=150,
                     debug_sample_size=96)
@@ -68,6 +71,7 @@ def test_validation_runs_on_train_split(tmp_path):
     assert 0.0 <= summary.val_accuracy <= 1.0
 
 
+@pytest.mark.slow
 def test_eval_pipeline_matches_direct_forward(tmp_path):
     """The collapsed 4-stage pipeline reports the same accuracy a direct
     batched forward gives (SURVEY §4 item 3 'eval pipeline produces the same
@@ -204,6 +208,7 @@ def test_dirty_checkpoint_marker_and_resume_warning(tmp_path):
     assert not os.path.exists(p6) and not os.path.exists(p6 + ".dirty")
 
 
+@pytest.mark.slow
 def test_device_cache_matches_streaming(tmp_path):
     """device_cache=True (HBM-resident dataset, on-device index gather) walks
     the data in the same order as the streaming loader and must produce the
@@ -222,6 +227,28 @@ def test_device_cache_matches_streaming(tmp_path):
     np.testing.assert_allclose(sa.epoch_losses, sb.epoch_losses, rtol=1e-4)
 
 
+@pytest.mark.slow
+def test_device_cache_rows_sharded_not_replicated(tmp_path):
+    """The device cache shards rows over the data axis: each of the 8
+    devices holds ceil(N/8) rows — per-device HBM ≈ dataset/n, not a full
+    replica per chip — and the padded tail rows sit past the real count."""
+    from mpi_pytorch_tpu.train.trainer import build_device_cache, build_training
+
+    cfg = _tiny_cfg(str(tmp_path), num_classes=200, debug_sample_size=102,
+                    device_cache=True)
+    mesh, _, _, (train_manifest, _, loader) = build_training(cfg)
+    dataset, labels = build_device_cache(cfg, train_manifest, loader, mesh)
+    n = len(train_manifest)
+    per_dev = -(-n // 8)
+    assert dataset.shape[0] == per_dev * 8  # padded to divisibility
+    assert int(labels.shape[0]) == n  # labels stay real-length (and replicated)
+    for shard in dataset.addressable_shards:
+        assert shard.data.shape[0] == per_dev, shard.data.shape
+    # Distinct rows per device (sharded), not 8 copies of everything.
+    assert len({shard.index[0].start for shard in dataset.addressable_shards}) == 8
+
+
+@pytest.mark.slow
 def test_host_cache_matches_streaming(tmp_path):
     """host_cache=True (decode the shard once into host RAM, slice epochs)
     must reproduce the streaming loss trajectory and validation accuracy —
@@ -239,6 +266,7 @@ def test_host_and_device_cache_exclusive():
         Config(host_cache=True, device_cache=True).validate_config()
 
 
+@pytest.mark.slow
 def test_scan_epoch_matches_per_step_cache(tmp_path):
     """scan_epoch=True (the whole epoch as ONE compiled lax.scan over the
     device cache) must reproduce the per-step cached trajectory — same
@@ -321,6 +349,7 @@ def test_grad_accumulation_matches_full_batch():
     )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("strategy", ["full", "blocks"])
 def test_remat_matches_plain_step(tmp_path, strategy):
     """Rematerialization (whole-forward jax.checkpoint, or per-residual-block
@@ -335,6 +364,7 @@ def test_remat_matches_plain_step(tmp_path, strategy):
     np.testing.assert_allclose(sa.epoch_losses, sb.epoch_losses, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_remat_blocks_param_tree_unchanged():
     """nn.remat must not change parameter paths — checkpoints and the
     torchvision converter depend on them."""
@@ -345,6 +375,7 @@ def test_remat_blocks_param_tree_unchanged():
     assert jax.tree_util.tree_structure(plain) == jax.tree_util.tree_structure(blocks)
 
 
+@pytest.mark.slow
 def test_cached_eval_matches_streaming_eval(tmp_path):
     """evaluate_cached (HBM-resident val set) must agree with
     evaluate_manifest (streaming decode) — same masking, same accounting."""
@@ -359,7 +390,7 @@ def test_cached_eval_matches_streaming_eval(tmp_path):
     cfg = _tiny_cfg(str(tmp_path), num_classes=200, debug_sample_size=96, batch_size=32)
     mesh, bundle, state, (train_manifest, _, loader) = build_training(cfg)
     state = place_state_on_mesh(state, mesh)
-    dataset, labels = build_device_cache(cfg, loader, mesh)
+    dataset, labels = build_device_cache(cfg, train_manifest, loader, mesh)
     acc_c, loss_c = evaluate_cached(cfg, state, mesh, dataset, labels)
     acc_s, loss_s = evaluate_manifest(cfg, state, mesh, train_manifest)
     # The two paths compile different HLO; allow one argmax tie-flip of slack
@@ -373,6 +404,7 @@ def test_remat_blocks_rejects_non_resnet():
         Config(remat="blocks", model_name="alexnet").validate_config()
 
 
+@pytest.mark.slow
 def test_remat_blocks_densenet_tree_and_forward():
     """densenet block remat: unchanged param tree, same forward output."""
     import jax.numpy as jnp
@@ -396,6 +428,7 @@ def test_accum_config_validation():
         Config(accum_steps=0).validate_config()
 
 
+@pytest.mark.slow
 def test_feature_extract_freezes_backbone(tmp_path):
     from mpi_pytorch_tpu.train.trainer import build_training
     from mpi_pytorch_tpu.parallel.mesh import shard_batch
@@ -484,6 +517,7 @@ def test_config_rejects_ignored_optimizer_combos():
         )
 
 
+@pytest.mark.slow
 def test_uint8_input_matches_float_input(tmp_path):
     """--input-dtype uint8 (raw pixels to device, normalize on chip) must
     reproduce the float-input loss trajectory on a real-JPEG dataset — the
@@ -512,6 +546,7 @@ def test_uint8_input_matches_float_input(tmp_path):
     assert sa.val_accuracy == sb.val_accuracy
 
 
+@pytest.mark.slow
 def test_uint8_device_cache_matches_uint8_streaming(tmp_path):
     """input_dtype='uint8' composed with device_cache: the HBM-resident
     dataset is stored as raw uint8 (4x smaller) and normalized on device
@@ -523,6 +558,7 @@ def test_uint8_device_cache_matches_uint8_streaming(tmp_path):
     np.testing.assert_allclose(sa.epoch_losses, sb.epoch_losses, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_track_best_pins_checkpoint_and_eval_uses_it(tmp_path):
     """--track-best: best.json points at the best-validation epoch, retention
     (keep=1) never deletes that file even as newer checkpoints churn past it,
@@ -566,6 +602,7 @@ def test_track_best_requires_validation():
         Config(track_best=True, validate=False).validate_config()
 
 
+@pytest.mark.slow
 def test_full_fast_path_stack_matches_streaming(tmp_path):
     """The whole TPU-first ingest stack composed — offline pack, raw-uint8
     feeding, HBM-resident device cache, one-scan-per-epoch — must reproduce
@@ -606,6 +643,7 @@ def test_full_fast_path_stack_matches_streaming(tmp_path):
     np.testing.assert_allclose(sa.epoch_losses, sb.epoch_losses, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_predictions_file_matches_reported_accuracy(tmp_path):
     """evaluate --predictions-file writes one row per test image in manifest
     order; the fraction of rows whose predicted_category_id equals the true

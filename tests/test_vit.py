@@ -67,6 +67,7 @@ def test_vit_sp_matches_plain(tiny_vit, sp_mesh, strategy):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("strategy", ["ring", "ulysses"])
 def test_vit_sp_grads_match_plain(tiny_vit, sp_mesh, strategy):
     model, variables, x = tiny_vit
@@ -100,6 +101,7 @@ def test_vit_remat_blocks_matches_plain(tiny_vit):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_vit_trains_through_standard_step():
     """The family plugs into the same train step as the CNN zoo."""
     from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
@@ -125,6 +127,7 @@ def test_vit_trains_through_standard_step():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_trainer_config_wires_sp_and_ep(tmp_path):
     """--sp-strategy / --expert-parallel reach the model through
     build_training: the bundle's model carries the strategy and a
@@ -152,6 +155,7 @@ def test_trainer_config_wires_sp_and_ep(tmp_path):
     assert bundle2.model.moe_every == 2
 
 
+@pytest.mark.slow
 def test_inference_config_wires_sp_and_ep(tmp_path):
     """The eval driver mirrors the trainer's SP/EP model wiring."""
     from mpi_pytorch_tpu.config import Config

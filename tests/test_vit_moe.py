@@ -66,6 +66,7 @@ def test_moe_vit_ep_matches_dense(tiny_moe_vit, ep_mesh):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_moe_vit_ep_grads_match_dense(tiny_moe_vit, ep_mesh):
     model, variables, x = tiny_moe_vit
     ep_model = VisionTransformer(**TINY, ep_mesh=ep_mesh)
@@ -87,6 +88,7 @@ def test_moe_vit_ep_grads_match_dense(tiny_moe_vit, ep_mesh):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_moe_vit_trains_through_standard_step():
     """The aux loss reaches the optimizer via the train step's "losses"
     collection — total loss stays finite and decreases."""

@@ -37,14 +37,25 @@ SWEEP: list[tuple[str, str]] = [
 ]
 
 
-def run_one(label: str, extra_flags: str) -> dict:
+def run_one(label: str, extra_flags: str, model: str = "") -> dict:
     env = dict(os.environ)
     base = env.get("XLA_FLAGS", "")
     env["XLA_FLAGS"] = f"{base} {extra_flags}".strip()
+    # Default: the headline bench.py (resnet18). --model X instead sweeps the
+    # flags over any zoo member via a single-model bench_zoo child — the
+    # instrument for attacking the bandwidth-bound members (densenet121
+    # 16.3%, squeezenet 30.7% MFU, docs/RESULTS.md §3b).
+    cmd = (
+        [sys.executable, os.path.join(REPO, "bench.py")]
+        if not model
+        else [
+            sys.executable, os.path.join(REPO, "tools", "bench_zoo.py"),
+            "--in-process", "--models", model,
+        ]
+    )
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py")],
-            env=env, cwd=REPO, capture_output=True, text=True, timeout=1800,
+            cmd, env=env, cwd=REPO, capture_output=True, text=True, timeout=1800,
         )
     except subprocess.TimeoutExpired:
         # One wedged flag set must not discard the completed results.
@@ -65,6 +76,9 @@ def run_one(label: str, extra_flags: str) -> dict:
             "value": 0.0,
             "error": f"no JSON (rc={proc.returncode}): " + " | ".join(stderr_tail),
         }
+    if model and "value" not in rec:
+        # bench_zoo rows key throughput differently from bench.py's one-liner.
+        rec["value"] = rec.get("images_per_sec_per_chip", 0.0)
     rec["label"] = label
     rec["flags"] = extra_flags
     return rec
@@ -79,6 +93,10 @@ def main() -> None:
     ap.add_argument(
         "--sets", default=None,
         help="comma-separated subset of builtin set labels to run",
+    )
+    ap.add_argument(
+        "--model", default="",
+        help="sweep this zoo model (bench_zoo child) instead of bench.py",
     )
     args = ap.parse_args()
     # --sets filters only the BUILTIN sets; explicit --flags always run.
@@ -98,7 +116,7 @@ def main() -> None:
     results = []
     for label, flags in sweep:
         print(f"== {label}: {flags or '(none)'}", file=sys.stderr, flush=True)
-        results.append(run_one(label, flags))
+        results.append(run_one(label, flags, model=args.model))
         r = results[-1]
         print(
             f"   -> {r.get('value', 0.0):.0f} img/s  mfu={r.get('mfu_pct', '?')}%"

@@ -1,0 +1,191 @@
+"""Input/execution-mode benchmark: the round-2 feeding features on a chip.
+
+VERDICT r2 flagged that uint8 feeding, the device cache, and scan-epoch had
+only virtual-CPU-mesh verification. This sweeps the HEADLINE workload
+(resnet18, 64 500 classes, 128px) through each mode with the same timing
+discipline as bench.py/bench_zoo.py and prints one JSON line per mode:
+
+    stream-f32    — host batches as float32 (reference-parity numerics)
+    stream-bf16   — host batches as bfloat16 (half the H2D bytes)
+    stream-uint8  — raw pixels + on-device normalize (1/4 the H2D bytes)
+    cached        — HBM-resident dataset, per-step index gather
+    cached-scan   — HBM-resident dataset, whole epoch as one lax.scan
+
+Streaming modes re-shard a fresh host batch EVERY step (device_put inside
+the timed loop), so they carry the real H2D cost the dtype modes differ by;
+the cached modes send only [B] int32 indices (and the scan, one dispatch per
+epoch). Run: ``python tools/bench_modes.py [--steps 20] [--out path]``.
+The packed-mmap path is host-side decode (no chip leg) — its numbers live in
+docs/RESULTS.md §4 host-ingest table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REFERENCE_IMG_PER_SEC_PER_WORKER = 4.4  # BASELINE.md
+MODEL, NUM_CLASSES, IMAGE = "resnet18", 64500, 128
+CACHE_ROWS = 8192  # HBM-resident rows for the cached modes (~400 MB f32)
+
+
+def _setup():
+    """Identical model/state for every mode — the dtype distinction lives
+    entirely in the host batch (`_host_batch`) and the ingest cast."""
+    import optax  # noqa: F401  (state factory pulls it in)
+
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.models import create_model_bundle
+    from mpi_pytorch_tpu.parallel.mesh import create_mesh
+    from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
+    from mpi_pytorch_tpu.train.step import place_state_on_mesh
+
+    mesh = create_mesh(Config().mesh)
+    bundle, variables = create_model_bundle(
+        MODEL, NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=IMAGE,
+        dtype=jnp.bfloat16, param_dtype=jnp.float32,
+    )
+    state = TrainState.create(
+        apply_fn=bundle.model.apply, variables=variables,
+        tx=make_optimizer(4e-4), rng=jax.random.PRNGKey(1),
+    )
+    return mesh, place_state_on_mesh(state, mesh)
+
+
+def _host_batch(batch: int, input_dtype: str):
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, NUM_CLASSES, size=(batch,)).astype(np.int32)
+    if input_dtype == "uint8":
+        images = rng.integers(0, 256, size=(batch, IMAGE, IMAGE, 3)).astype(np.uint8)
+    else:
+        images = rng.standard_normal((batch, IMAGE, IMAGE, 3)).astype(np.float32)
+        if input_dtype == "bfloat16":
+            images = images.astype(jnp.bfloat16)
+    return images, labels
+
+
+def bench_streaming(input_dtype: str, batch_per_chip: int, steps: int, warmup: int):
+    from mpi_pytorch_tpu.parallel.mesh import shard_batch
+    from mpi_pytorch_tpu.train.step import make_train_step
+
+    mesh, state = _setup()
+    n_chips = jax.device_count()
+    batch = batch_per_chip * n_chips
+    images, labels = _host_batch(batch, input_dtype)
+    step = make_train_step(jnp.bfloat16)
+    compiled = step.lower(state, shard_batch((images, labels), mesh)).compile()
+
+    for _ in range(warmup):
+        state, _ = compiled(state, shard_batch((images, labels), mesh))
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        # The device_put is INSIDE the timed loop on purpose: the H2D
+        # transfer is the thing the input dtypes differ by.
+        state, _ = compiled(state, shard_batch((images, labels), mesh))
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+    return dt, steps * batch, n_chips
+
+
+def bench_cached(scan: bool, batch_per_chip: int, steps: int, warmup: int):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mpi_pytorch_tpu.train.step import (
+        make_cached_train_step,
+        make_scanned_epoch,
+    )
+
+    mesh, state = _setup()
+    n_chips = jax.device_count()
+    batch = batch_per_chip * n_chips
+    n_data = mesh.shape[mesh.axis_names[0]]
+    rows = -(-CACHE_ROWS // n_data) * n_data
+    rng = np.random.default_rng(0)
+    dataset = jax.device_put(
+        rng.standard_normal((rows, IMAGE, IMAGE, 3)).astype(np.float32),
+        NamedSharding(mesh, P(mesh.axis_names[0])),
+    )
+    labels_all = jax.device_put(
+        rng.integers(0, NUM_CLASSES, size=(rows,)).astype(np.int32),
+        NamedSharding(mesh, P()),
+    )
+    idx = rng.integers(0, rows, size=(steps + warmup, batch)).astype(np.int32)
+    valid = np.ones((steps + warmup, batch), bool)
+
+    if scan:
+        epoch_fn = make_scanned_epoch(mesh, jnp.bfloat16)
+        compiled = epoch_fn.lower(
+            state, dataset, labels_all, idx[:steps], valid[:steps]
+        ).compile()
+        state, _ = compiled(state, dataset, labels_all, idx[:steps], valid[:steps])
+        jax.block_until_ready(state.params)
+        t0 = time.perf_counter()
+        state, _ = compiled(state, dataset, labels_all, idx[:steps], valid[:steps])
+        jax.block_until_ready(state.params)
+        dt = time.perf_counter() - t0
+        return dt, steps * batch, n_chips
+
+    step = make_cached_train_step(mesh, jnp.bfloat16)
+    compiled = step.lower(state, dataset, labels_all, idx[0], valid[0]).compile()
+    for i in range(warmup):
+        state, _ = compiled(state, dataset, labels_all, idx[i], valid[i])
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, _ = compiled(state, dataset, labels_all, idx[warmup + i], valid[warmup + i])
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+    return dt, steps * batch, n_chips
+
+
+MODES = {
+    "stream-f32": lambda b, s, w: bench_streaming("float32", b, s, w),
+    "stream-bf16": lambda b, s, w: bench_streaming("bfloat16", b, s, w),
+    "stream-uint8": lambda b, s, w: bench_streaming("uint8", b, s, w),
+    "cached": lambda b, s, w: bench_cached(False, b, s, w),
+    "cached-scan": lambda b, s, w: bench_cached(True, b, s, w),
+}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=2048, help="per chip")
+    ap.add_argument("--modes", default=",".join(MODES))
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    records = []
+    for mode in (m.strip() for m in args.modes.split(",") if m.strip()):
+        try:
+            dt, images, n_chips = MODES[mode](args.batch, args.steps, args.warmup)
+            rec = {
+                "mode": mode,
+                "batch_per_chip": args.batch,
+                "images_per_sec_per_chip": round(images / dt / n_chips, 1),
+                "vs_baseline": round(
+                    images / dt / n_chips / REFERENCE_IMG_PER_SEC_PER_WORKER, 1
+                ),
+            }
+        except Exception as e:
+            rec = {"mode": mode, "error": f"{type(e).__name__}: {e}"[:300]}
+        records.append(rec)
+        print(json.dumps(rec), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(records, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

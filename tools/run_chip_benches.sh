@@ -15,8 +15,21 @@ python bench.py | tee "$OUT/bench_latest.json"
 echo "== full-zoo sweep (watchdogged children) =="
 python tools/bench_zoo.py --out "$OUT/zoo_bench.json"
 
-echo "== XLA-flag MFU sweep =="
+echo "== input/execution mode sweep (uint8 / cached / scan) =="
+timeout 3600 python tools/bench_modes.py --out "$OUT/modes_bench.json" || true
+
+echo "== XLA-flag MFU sweep (headline) =="
 python tools/bench_flags.py | tee "$OUT/flags_sweep.txt"
+
+echo "== XLA-flag sweep: bandwidth-bound zoo members =="
+python tools/bench_flags.py --model densenet121 | tee "$OUT/flags_densenet.txt" || true
+python tools/bench_flags.py --model squeezenet1_0 | tee "$OUT/flags_squeezenet.txt" || true
+
+echo "== per-op roofline (MFU-ceiling instrument) =="
+timeout 1800 python tools/roofline.py --model resnet18 --batch 2048 \
+    --json "$OUT/roofline_resnet18.json" | tee "$OUT/roofline_resnet18.txt" || true
+timeout 1800 python tools/roofline.py --model densenet121 --batch 1024 \
+    --json "$OUT/roofline_densenet121.json" | tee "$OUT/roofline_densenet121.txt" || true
 
 echo "== inference bench =="
 python tools/bench_eval.py | tee "$OUT/eval_bench.json" || true
