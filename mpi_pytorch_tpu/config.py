@@ -151,6 +151,11 @@ class Config:
     # devices and shards every attention call's sequence axis over it
     # (ops/ring_attention.py, ops/ulysses.py). vit models only.
     sp_strategy: str = "none"
+    # Dense-attention implementation for the vit_* family when sp_strategy
+    # is "none": "full" (vanilla, materializes [B,H,S,S] scores) or "flash"
+    # (Pallas block-tiled online-softmax kernel on TPU, identical-math
+    # fallback on other backends — ops/flash_attention.py).
+    attn_impl: str = "full"
     # Expert parallelism for MoE models (vit_moe_s16): shard the experts
     # over all devices on an ("expert", "_") mesh; tokens travel by
     # all_to_all (ops/moe.py). MoE models only.
@@ -301,6 +306,25 @@ class Config:
             raise ValueError(
                 f"sp_strategy must be none|ring|ulysses, got {self.sp_strategy!r}"
             )
+        if self.attn_impl not in ("full", "flash"):
+            raise ValueError(
+                f"attn_impl must be full|flash, got {self.attn_impl!r}"
+            )
+        if self.attn_impl == "flash":
+            from mpi_pytorch_tpu.models.registry import SP_MODELS
+
+            if self.model_name not in SP_MODELS:
+                raise ValueError(
+                    f"attn_impl='flash' applies only to the attention family "
+                    f"({', '.join(SP_MODELS)}); {self.model_name!r} has no "
+                    "attention"
+                )
+            if self.sp_strategy != "none":
+                raise ValueError(
+                    "attn_impl='flash' is the single-device dense-attention "
+                    "path; the SP strategies (--sp-strategy) already compute "
+                    "attention blockwise across chips — choose one"
+                )
         if self.optimizer not in ("adam", "sgd", "adamw"):
             raise ValueError(f"optimizer must be adam|sgd|adamw, got {self.optimizer!r}")
         if self.lr_schedule not in ("constant", "cosine", "warmup_cosine"):

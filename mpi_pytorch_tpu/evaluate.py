@@ -74,6 +74,7 @@ def build_inference(cfg: Config, mesh=None, manifests=None):
         sp_strategy=cfg.sp_strategy,
         sp_mesh=flat_mesh(mesh, "seq") if cfg.sp_strategy != "none" else None,
         ep_mesh=flat_mesh(mesh, "expert") if cfg.expert_parallel else None,
+        attn_impl=cfg.attn_impl,
     )
     state = TrainState.create(
         apply_fn=bundle.model.apply,

@@ -111,6 +111,7 @@ def initialize_model(
     sp_strategy: str = "none",
     sp_mesh: Any = None,
     ep_mesh: Any = None,
+    attn_impl: str = "full",
 ) -> tuple[nn.Module, int]:
     """Reference-parity signature (``models.py:16``): returns (model, input_size)."""
     if model_name not in _REGISTRY:
@@ -121,6 +122,14 @@ def initialize_model(
     kw: dict[str, Any] = dict(dtype=dtype, param_dtype=param_dtype)
     if model_name not in BN_FREE_MODELS:
         kw["bn_axis_name"] = bn_axis_name
+    if attn_impl != "full":
+        if model_name not in SP_MODELS:
+            raise ValueError(
+                f"attn_impl={attn_impl!r} applies only to the attention "
+                f"family ({', '.join(SP_MODELS)}); {model_name!r} has no "
+                "attention"
+            )
+        kw["attn_impl"] = attn_impl
     if sp_strategy != "none":
         if model_name not in SP_MODELS:
             raise ValueError(
@@ -188,13 +197,14 @@ def create_model_bundle(
     sp_strategy: str = "none",
     sp_mesh: Any = None,
     ep_mesh: Any = None,
+    attn_impl: str = "full",
 ) -> tuple[ModelBundle, dict]:
     """Full-fat factory: returns the bundle plus initialized variables."""
     model, canonical = initialize_model(
         model_name, num_classes, feature_extract, use_pretrained,
         dtype=dtype, param_dtype=param_dtype, bn_axis_name=bn_axis_name,
         remat_blocks=remat_blocks, sp_strategy=sp_strategy, sp_mesh=sp_mesh,
-        ep_mesh=ep_mesh,
+        ep_mesh=ep_mesh, attn_impl=attn_impl,
     )
     size = image_size or (299 if model_name == "inception_v3" else 128)
     rng = rng if rng is not None else jax.random.PRNGKey(0)

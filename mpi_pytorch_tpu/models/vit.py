@@ -32,17 +32,23 @@ from mpi_pytorch_tpu.models.common import Dtype
 
 class MultiHeadAttention(nn.Module):
     """MHA whose core attention is pluggable: ``sp_strategy`` of ``none``
-    (single-device full attention), ``ring``, or ``ulysses`` (both SP
-    strategies shard the sequence over ``sp_mesh``'s first axis)."""
+    (single-device attention — vanilla ``full`` or the Pallas ``flash``
+    kernel, ``attn_impl``), ``ring``, or ``ulysses`` (both SP strategies
+    shard the sequence over ``sp_mesh``'s first axis)."""
 
     num_heads: int
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
     sp_strategy: str = "none"
     sp_mesh: Any = None
+    # "full" materializes [B,H,S,S] scores; "flash" streams k/v blocks
+    # through VMEM with an online softmax (ops/flash_attention.py — Pallas
+    # on TPU, identical-math fallback elsewhere). Same function either way.
+    attn_impl: str = "full"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        from mpi_pytorch_tpu.ops.flash_attention import flash_attention
         from mpi_pytorch_tpu.ops.ring_attention import (
             full_attention,
             ring_self_attention,
@@ -59,7 +65,12 @@ class MultiHeadAttention(nn.Module):
         )
         q, k, v = proj("q")(x), proj("k")(x), proj("v")(x)
         if self.sp_strategy == "none":
-            out = full_attention(q, k, v)
+            if self.attn_impl == "flash":
+                out = flash_attention(q, k, v)
+            elif self.attn_impl == "full":
+                out = full_attention(q, k, v)
+            else:
+                raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
         elif self.sp_strategy == "ring":
             out = ring_self_attention(q, k, v, self.sp_mesh)
         elif self.sp_strategy == "ulysses":
@@ -158,6 +169,7 @@ class EncoderBlock(nn.Module):
     param_dtype: Dtype = jnp.float32
     sp_strategy: str = "none"
     sp_mesh: Any = None
+    attn_impl: str = "full"
     num_experts: int = 0
     moe_k: int = 2
     moe_capacity: int | None = None
@@ -172,7 +184,7 @@ class EncoderBlock(nn.Module):
         y = MultiHeadAttention(
             num_heads=self.num_heads, dtype=self.dtype,
             param_dtype=self.param_dtype, sp_strategy=self.sp_strategy,
-            sp_mesh=self.sp_mesh, name="attn",
+            sp_mesh=self.sp_mesh, attn_impl=self.attn_impl, name="attn",
         )(ln("ln1")(x))
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -215,6 +227,7 @@ class VisionTransformer(nn.Module):
     remat_blocks: bool = False
     sp_strategy: str = "none"
     sp_mesh: Any = None
+    attn_impl: str = "full"
     # MoE: every `moe_every`-th block (0-indexed blocks moe_every-1,
     # 2·moe_every-1, ...; =2 → the odd blocks) swaps its dense MLP for a
     # `num_experts`-expert MoE. 0 disables.
@@ -256,7 +269,7 @@ class VisionTransformer(nn.Module):
                 num_heads=self.num_heads, mlp_dim=self.mlp_dim,
                 dropout=self.dropout, dtype=self.dtype,
                 param_dtype=self.param_dtype, sp_strategy=self.sp_strategy,
-                sp_mesh=self.sp_mesh,
+                sp_mesh=self.sp_mesh, attn_impl=self.attn_impl,
                 num_experts=self.num_experts if is_moe else 0,
                 moe_k=self.moe_k, moe_capacity=self.moe_capacity,
                 moe_group_size=self.moe_group_size,

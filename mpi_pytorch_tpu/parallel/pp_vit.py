@@ -91,6 +91,7 @@ def make_pp_apply(
         dropout=0.0,
         dtype=model.dtype,
         param_dtype=model.param_dtype,
+        attn_impl=model.attn_impl,
     )
 
     # ONE stage_fn object per make_pp_apply call: pipeline_forward keys its

@@ -202,6 +202,7 @@ def build_training(cfg: Config, mesh=None):
         sp_strategy=cfg.sp_strategy,
         sp_mesh=flat_mesh(mesh, "seq") if cfg.sp_strategy != "none" else None,
         ep_mesh=flat_mesh(mesh, "expert") if cfg.expert_parallel else None,
+        attn_impl=cfg.attn_impl,
     )
     # Total optimizer steps for cosine-style schedules: the globally-computed
     # per-epoch step count (identical on every host) x epochs.

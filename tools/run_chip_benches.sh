@@ -15,6 +15,10 @@ python bench.py | tee "$OUT/bench_latest.json"
 echo "== full-zoo sweep (watchdogged children) =="
 python tools/bench_zoo.py --out "$OUT/zoo_bench.json"
 
+echo "== flash vs full attention on the vit family =="
+python tools/bench_zoo.py --models vit_s16,vit_b16 --attn-impl flash \
+    --out "$OUT/zoo_flash.json" || true
+
 echo "== input/execution mode sweep (uint8 / cached / scan) =="
 timeout 3600 python tools/bench_modes.py --out "$OUT/modes_bench.json" || true
 
