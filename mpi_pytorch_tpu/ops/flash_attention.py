@@ -223,12 +223,9 @@ _flash3.defvjp(_flash3_fwd, _flash3_bwd)
 
 
 def on_tpu() -> bool:
-    # Same gate as ops/fused_head_ce.py: 'axon' is a TPU behind a remote-PJRT
-    # relay (this environment's chip) — the compiled Pallas kernel runs there.
-    try:
-        return jax.default_backend() in ("tpu", "axon")
-    except Exception:
-        return False
+    from mpi_pytorch_tpu.utils.hardware import tpu_backend
+
+    return tpu_backend()
 
 
 def flash_attention(

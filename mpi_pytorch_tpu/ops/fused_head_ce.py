@@ -272,7 +272,9 @@ def fused_head_ce(
     backends and the XLA fallback otherwise.
     """
     if interpret is None:
-        if jax.default_backend() not in ("tpu", "axon"):
+        from mpi_pytorch_tpu.utils.hardware import tpu_backend
+
+        if not tpu_backend():
             return head_ce_reference(feats, w, b, labels)
         interpret = False
     # f32 w/b at the custom_vjp boundary keeps the cotangent dtypes f32 (the

@@ -39,6 +39,19 @@ def peak_hbm_gbps(device) -> float | None:
     return _lookup(_PEAK_HBM_GBPS, device)
 
 
+def tpu_backend() -> bool:
+    """True when the default backend is a TPU — including 'axon', a TPU
+    behind a remote-PJRT relay (this environment's chip). THE gate every
+    Pallas kernel uses to choose compiled-kernel vs XLA-fallback, kept in
+    one place so a new backend alias can't split the kernels' behavior."""
+    import jax
+
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
 def step_flops(compiled) -> float:
     """Total FLOPs of an XLA executable (0.0 if unavailable). Accepts either
     a Compiled or a Lowered stage — cost analysis does not require the

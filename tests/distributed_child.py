@@ -149,6 +149,45 @@ def main() -> None:
     losses3 = " ".join(f"{l:.6f}" for l in summary3.epoch_losses)
     print(f"DEVCACHE_OK {losses3} acc {summary3.val_accuracy:.4f}", flush=True)
 
+    # Pipeline parallelism across REAL process boundaries: a (data=2,
+    # pipe=4) mesh where the data axis spans both processes (the gradient
+    # all-reduce crosses hosts) while each host holds a full 4-stage
+    # pipeline (the stage ppermute stays on-host ICI — create_mesh's
+    # pipe-minor layout). One full PP x DP train step on a real ViT trunk
+    # through the --pp-stages machinery.
+    from mpi_pytorch_tpu.models.vit import VisionTransformer
+    from mpi_pytorch_tpu.parallel.pp_vit import make_pp_apply
+
+    pp_mesh = create_mesh(MeshConfig(data_parallel=2, pipe_parallel=4))
+    pp_vit = VisionTransformer(
+        num_classes=16, patch_size=8, hidden=32, depth=8, num_heads=4,
+        mlp_dim=64,
+    )
+    pp_imgs = rng.normal(size=(4, 16, 16, 3)).astype(np.float32)  # per host
+    pp_labels = (np.arange(4, dtype=np.int32) + 4 * jax.process_index()) % 16
+    pp_vars = pp_vit.init(
+        {"params": jax.random.PRNGKey(11)}, jax.numpy.asarray(pp_imgs[:2]),
+        train=False,
+    )
+    pp_state = place_state_on_mesh(
+        TrainState.create(
+            apply_fn=make_pp_apply(
+                pp_vit, pp_mesh, num_microbatches=4, data_axis="data",
+            ),
+            variables=pp_vars, tx=make_optimizer(1e-3),
+            rng=jax.random.PRNGKey(12),
+        ),
+        pp_mesh,
+    )
+    pp_step = make_train_step(jax.numpy.float32)
+    pp_state, pp_metrics = pp_step(
+        pp_state, shard_batch((pp_imgs, pp_labels), pp_mesh)
+    )
+    jax.block_until_ready(pp_state.params)
+    pp_loss = float(pp_metrics["loss"])
+    assert np.isfinite(pp_loss), pp_loss
+    print(f"PP_OK {pp_loss:.6f}", flush=True)
+
     # Multi-host predictions: the predictions pass runs the synchronized
     # sharded forward on every chip of BOTH processes, all-gathers the
     # per-host argmax rows (tiny int32, no shared FS needed), and process 0

@@ -92,6 +92,16 @@ def test_two_process_distributed_train_step(tmp_path):
     ]
     assert len(devcache_lines) == 2, outs
     assert devcache_lines[0] == devcache_lines[1], devcache_lines
+    # Pipeline parallelism across processes: both ran one PP x DP step on
+    # different local data and agree on the all-reduced loss.
+    pp_lines = [
+        line
+        for out in outs
+        for line in out.splitlines()
+        if line.startswith("PP_OK")
+    ]
+    assert len(pp_lines) == 2, outs
+    assert pp_lines[0] == pp_lines[1], pp_lines
     # Multi-host predictions: both processes ran the sharded predictions
     # pass and agree on its accuracy; process 0 wrote the single CSV.
     pred_lines = [
