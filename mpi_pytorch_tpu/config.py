@@ -440,11 +440,13 @@ class Config:
                     "buys. The pipeline already splits trunk memory S ways; "
                     "choose one of --pp-stages / --fsdp / --zero-optimizer"
                 )
-            mb = self.pp_microbatches or 2 * self.pp_stages
-            if self.batch_size % mb:
+            # Normalize the default HERE, once: the trainer, the eval driver,
+            # and this validation all read the resolved value afterwards.
+            self.pp_microbatches = self.pp_microbatches or 2 * self.pp_stages
+            if self.batch_size % self.pp_microbatches:
                 raise ValueError(
                     f"batch_size {self.batch_size} not divisible by "
-                    f"pp_microbatches {mb}"
+                    f"pp_microbatches {self.pp_microbatches}"
                 )
             # pp_stages drives the mesh layout: one stage per device along
             # the pipe axis (DP fills the remaining devices).

@@ -82,6 +82,15 @@ def build_inference(cfg: Config, mesh=None, manifests=None):
         tx=optax.identity(),
         rng=jax.random.PRNGKey(cfg.seed),
     )
+    if cfg.pp_stages > 1:
+        # Same seam as build_training: PP is an execution strategy keyed on
+        # state.apply_fn, so --pp-stages pipelines inference too (identical
+        # params and numerics; the eval batch streams through the stages).
+        from mpi_pytorch_tpu.parallel.pp_vit import pp_apply_from_config
+
+        state = state.replace(
+            apply_fn=pp_apply_from_config(cfg, bundle.model, mesh)
+        )
     return mesh, bundle, state, test_manifest
 
 

@@ -222,12 +222,6 @@ def _flash3_bwd(causal, block_q, block_k, interpret, residuals, do):
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
 
 
-def on_tpu() -> bool:
-    from mpi_pytorch_tpu.utils.hardware import tpu_backend
-
-    return tpu_backend()
-
-
 def flash_attention(
     q, k, v, *, causal: bool = False,
     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
@@ -242,11 +236,12 @@ def flash_attention(
     import os
 
     from mpi_pytorch_tpu.ops.ring_attention import full_attention
+    from mpi_pytorch_tpu.utils.hardware import tpu_backend
 
     if interpret is None:
         if os.environ.get("MPT_FLASH_INTERPRET"):
             interpret = True
-        elif not on_tpu():
+        elif not tpu_backend():
             return full_attention(q, k, v, causal=causal)
         else:
             interpret = False

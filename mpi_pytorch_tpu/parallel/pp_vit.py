@@ -33,6 +33,30 @@ from mpi_pytorch_tpu.models.vit import EncoderBlock, VisionTransformer
 from mpi_pytorch_tpu.parallel.pipeline import pipeline_forward, stack_stage_params
 
 
+def pp_apply_from_config(cfg, model, mesh, *, remat: bool = False):
+    """The ONE construction path for ``--pp-stages`` (trainer AND eval):
+    validates the microbatch layout against the mesh — so a bad config fails
+    with the same clear error in both drivers, at build time — then builds
+    the pipelined apply_fn. ``cfg.pp_microbatches`` arrives normalized
+    (config.validate_config resolves the 0-means-default)."""
+    data_size = mesh.shape[cfg.mesh.data_axis]
+    mb_rows = cfg.batch_size // cfg.pp_microbatches
+    if mb_rows % data_size:
+        raise ValueError(
+            f"pipeline microbatch rows {mb_rows} "
+            f"(batch {cfg.batch_size} / {cfg.pp_microbatches} microbatches) "
+            f"not divisible by data-parallel size {data_size}"
+        )
+    return make_pp_apply(
+        model,
+        mesh,
+        num_microbatches=cfg.pp_microbatches,
+        pipe_axis=cfg.mesh.pipe_axis,
+        data_axis=cfg.mesh.data_axis,
+        remat=remat,
+    )
+
+
 def _stack_trunk(params: dict, depth: int, stages: int):
     """[S, L, ...]-stacked trunk params from the model's ``block{i}``
     subtrees: leading stage axis (sharded over ``pipe``), then the L

@@ -231,24 +231,11 @@ def build_training(cfg: Config, mesh=None):
         # (parallel/pp_vit.py), and every step flavor keyed on
         # state.apply_fn — streaming, cached, scanned-epoch, eval —
         # pipelines from here on.
-        from mpi_pytorch_tpu.parallel.pp_vit import make_pp_apply
+        from mpi_pytorch_tpu.parallel.pp_vit import pp_apply_from_config
 
-        mb_count = cfg.pp_microbatches or 2 * cfg.pp_stages
-        mb_rows = cfg.batch_size // mb_count
-        if mb_rows % data_size:
-            raise ValueError(
-                f"pipeline microbatch rows {mb_rows} "
-                f"(batch {cfg.batch_size} / {mb_count} microbatches) not "
-                f"divisible by data-parallel size {data_size}"
-            )
         state = state.replace(
-            apply_fn=make_pp_apply(
-                bundle.model,
-                mesh,
-                num_microbatches=mb_count,
-                pipe_axis=cfg.mesh.pipe_axis,
-                data_axis=cfg.mesh.data_axis,
-                remat=(cfg.remat == "blocks"),
+            apply_fn=pp_apply_from_config(
+                cfg, bundle.model, mesh, remat=(cfg.remat == "blocks")
             )
         )
     return mesh, bundle, state, (train_manifest, test_manifest, train_loader)

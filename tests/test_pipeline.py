@@ -310,6 +310,21 @@ def test_pp_apply_guards():
         make_pp_apply(_tiny_vit(depth=6), mesh, num_microbatches=8)
 
 
+def test_build_inference_wires_pp(tmp_path):
+    """--pp-stages reaches the EVAL driver through the same apply_fn seam as
+    the trainer (no silently-ignored flag)."""
+    from mpi_pytorch_tpu.config import parse_config
+    from mpi_pytorch_tpu.evaluate import build_inference
+
+    cfg = parse_config([
+        "--model-name", "vit_s16", "--pp-stages", "4", "--image-size", "32",
+        "--num-classes", "1000", "--synthetic-data", "true",
+    ])
+    mesh, bundle, state, _ = build_inference(cfg)
+    assert mesh.shape.get("pipe") == 4
+    assert state.apply_fn is not bundle.model.apply  # the PP swap happened
+
+
 @pytest.mark.slow
 def test_pp_stages_config_trains_vit(tmp_path):
     """--pp-stages 4 end to end through parse_config/build_training/train on
