@@ -1,0 +1,104 @@
+"""Attention microbench: full (materialized S×S) vs flash (Pallas) on chip.
+
+The flash kernel's win grows with sequence length — this sweeps S and
+prints one JSON line per (impl, S) for fwd+bwd through a jitted
+grad step, plus the peak-memory story XLA reports:
+
+    python tools/bench_attention.py [--seqs 512,1024,2048,4096] [--out f]
+
+On non-TPU backends the flash path falls back to full attention
+(ops/flash_attention.py gating), so chip runs are the meaningful ones;
+the battery stages this after the zoo sweep.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, H, D = 4, 6, 64  # vit_s16-shaped heads
+
+
+def bench_one(impl: str, seq: int, steps: int, warmup: int) -> dict:
+    from mpi_pytorch_tpu.ops.flash_attention import flash_attention
+    from mpi_pytorch_tpu.ops.ring_attention import full_attention
+
+    fn = {
+        "full": lambda q, k, v: full_attention(q, k, v),
+        "flash": lambda q, k, v: flash_attention(q, k, v),
+    }[impl]
+
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(
+        rng.standard_normal((B, seq, H, D)), jnp.bfloat16
+    )
+    q, k, v = mk(), mk(), mk()
+
+    @jax.jit
+    def step(q, k, v):
+        def loss(q_, k_, v_):
+            return jnp.sum(fn(q_, k_, v_).astype(jnp.float32) ** 2)
+
+        l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return l, grads
+
+    compiled = step.lower(q, k, v).compile()
+    mem = None
+    try:
+        ma = compiled.memory_analysis()
+        mem = int(getattr(ma, "temp_size_in_bytes", 0))
+    except Exception:
+        pass
+
+    for _ in range(warmup):
+        l, grads = compiled(q, k, v)
+    jax.block_until_ready(grads[0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        l, grads = compiled(q, k, v)
+    jax.block_until_ready(grads[0])
+    dt = (time.perf_counter() - t0) / steps
+
+    rec = {
+        "impl": impl, "seq": seq, "batch": B, "heads": H, "head_dim": D,
+        "fwd_bwd_ms": round(dt * 1e3, 3),
+    }
+    if mem is not None:
+        rec["temp_hbm_mb"] = round(mem / 1e6, 1)
+    return rec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="512,1024,2048,4096")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    records = []
+    for seq in (int(s) for s in args.seqs.split(",") if s):
+        for impl in ("full", "flash"):
+            try:
+                rec = bench_one(impl, seq, args.steps, args.warmup)
+            except Exception as e:
+                rec = {"impl": impl, "seq": seq,
+                       "error": f"{type(e).__name__}: {e}"[:300]}
+            records.append(rec)
+            print(json.dumps(rec), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(records, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

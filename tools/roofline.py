@@ -80,21 +80,50 @@ _INSTR_RE = re.compile(
 )
 
 
-def parse_entry_instructions(hlo_text: str):
-    """Yield (name, shape_text, op, rest_of_line) for the ENTRY computation's
-    instructions (the executed schedule after fusion)."""
-    lines = hlo_text.splitlines()
-    in_entry = False
-    for line in lines:
+# Computation header: `%name (params...) -> result {` — greedy `.*` spans
+# tuple-typed parameter lists (inner parens), which a lazy `[^)]*` would not.
+_COMP_HEAD_RE = re.compile(r"^%?([\w.\-]+)\s+\(.*\)\s*->.*\{")
+
+
+def parse_computations(hlo_text: str):
+    """{computation_name: [(name, shape_text, op, rest), ...]} for every
+    computation block (tuple-typed parameters included); the ENTRY
+    computation is keyed "ENTRY"."""
+    comps: dict[str, list] = {}
+    current = None
+    for line in hlo_text.splitlines():
         if line.startswith("ENTRY "):
-            in_entry = True
+            current = "ENTRY"
+            comps[current] = []
             continue
-        if in_entry:
-            if line.startswith("}"):
-                break
-            m = _INSTR_RE.match(line)
-            if m:
-                yield m.group(1), m.group(2), m.group(3), m.group(4)
+        if current is None:
+            if not line.startswith((" ", "}")):  # headers only at col 0
+                m_head = _COMP_HEAD_RE.match(line)
+                if m_head:
+                    current = m_head.group(1)
+                    comps[current] = []
+            continue
+        if line.startswith("}"):
+            current = None
+            continue
+        m = _INSTR_RE.match(line)
+        if m:
+            comps[current].append(
+                (m.group(1), m.group(2), m.group(3), m.group(4))
+            )
+    return comps
+
+
+def _comp_flops(instrs) -> float:
+    """Σ dot/conv FLOPs inside one (fused) computation."""
+    shapes = {name: shape for name, shape, _, _ in instrs}
+    total = 0.0
+    for _, shape_text, op, rest in instrs:
+        if op == "convolution":
+            total += conv_flops(shape_text, rest, shapes)
+        elif op == "dot":
+            total += dot_flops(shape_text, rest, shapes)
+    return total
 
 
 def conv_flops(shape_text: str, rest: str, shapes: dict) -> float:
@@ -144,10 +173,16 @@ def dot_flops(shape_text: str, rest: str, shapes: dict) -> float:
 
 def roofline(hlo_text: str, peak_tflops: float | None, peak_gbps: float | None):
     """Per-instruction roofline rows for the entry computation."""
-    shapes: dict[str, str] = {}
-    instrs = list(parse_entry_instructions(hlo_text))
-    for name, shape_text, _, _ in instrs:
-        shapes[name] = shape_text
+    comps = parse_computations(hlo_text)
+    instrs = comps.get("ENTRY", [])
+    shapes = {name: shape for name, shape, _, _ in instrs}
+    # FLOPs of dots/convs INSIDE each fused computation, attributed to the
+    # calling fusion instruction (XLA sometimes fuses the conv/dot itself).
+    fused_flops = {
+        cname: _comp_flops(cinstrs)
+        for cname, cinstrs in comps.items()
+        if cname != "ENTRY"
+    }
 
     rows = []
     for name, shape_text, op, rest in instrs:
@@ -162,10 +197,9 @@ def roofline(hlo_text: str, peak_tflops: float | None, peak_gbps: float | None):
         elif op == "dot":
             fl = dot_flops(shape_text, rest, shapes)
         elif op == "fusion":
-            # Fusions hide dots/convs; count the inner ones via the called
-            # computation names present in the text later — approximated as
-            # bytes-only here (conv/dot usually stay unfused on TPU).
-            pass
+            mcall = re.search(r"calls=%?([\w.\-]+)", rest)
+            if mcall:
+                fl = fused_flops.get(mcall.group(1), 0.0)
         total_b = out_b + in_b
         row = {"op": op, "name": name, "bytes": total_b, "flops": fl}
         if peak_tflops and peak_gbps:

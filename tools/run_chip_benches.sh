@@ -19,6 +19,9 @@ echo "== flash vs full attention on the vit family =="
 python tools/bench_zoo.py --models vit_s16,vit_b16 --attn-impl flash \
     --out "$OUT/zoo_flash.json" || true
 
+echo "== attention microbench: flash vs full across sequence lengths =="
+timeout 3600 python tools/bench_attention.py --out "$OUT/attention_bench.json" || true
+
 echo "== input/execution mode sweep (uint8 / cached / scan) =="
 timeout 3600 python tools/bench_modes.py --out "$OUT/modes_bench.json" || true
 
