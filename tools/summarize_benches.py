@@ -1,0 +1,151 @@
+"""Render the chip battery's JSON artifacts as RESULTS-ready markdown.
+
+``tools/run_chip_benches.sh`` leaves docs/{bench_latest,zoo_bench,
+zoo_flash,modes_bench,attention_bench,eval_bench}.json plus the flag-sweep
+and roofline text files. This prints the markdown tables those artifacts
+support, so folding a battery into docs/RESULTS.md is one command whenever
+the relay comes back (possibly in a later session):
+
+    python tools/summarize_benches.py [docs]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _load(path):
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        try:
+            return json.load(f)
+        except json.JSONDecodeError:
+            # corrupt != absent: a relay wedge can truncate an artifact
+            # mid-write, and that stage must not silently vanish.
+            print(f"WARNING: {path} exists but is not valid JSON "
+                  "(truncated battery stage?)", file=sys.stderr)
+            return None
+
+
+def _load_jsonl(path):
+    """One JSON object per line (tools/bench_eval.py output)."""
+    if not os.path.exists(path):
+        return None
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    print(f"WARNING: bad JSONL line in {path}",
+                          file=sys.stderr)
+    return rows or None
+
+
+def _cell(text) -> str:
+    """Escape markdown-table separators in interpolated text (bench_zoo
+    error strings contain literal | separators)."""
+    return str(text).replace("|", "\\|")
+
+
+def main() -> None:
+    out = sys.argv[1] if len(sys.argv) > 1 else "docs"
+
+    headline = _load(os.path.join(out, "bench_latest.json"))
+    if headline:
+        print("## headline\n")
+        print("```json")
+        print(json.dumps(headline))
+        print("```\n")
+
+    zoo = _load(os.path.join(out, "zoo_bench.json"))
+    if zoo:
+        print("## zoo (§3b)\n")
+        print("| model | batch/chip | img/s/chip | step ms | TFLOP/s | MFU |")
+        print("|---|---|---|---|---|---|")
+        for r in zoo:
+            if "error" in r:
+                print(f"| {r['model']} | — | ERROR: {_cell(r['error'][:60])} | | | |")
+                continue
+            print(
+                f"| {r['model']} | {r['batch_per_chip']} | "
+                f"{r['images_per_sec_per_chip']:,.0f} | {r['step_ms']} | "
+                f"{r['tflops_per_chip']} | {r.get('mfu_pct', '?')}% |"
+            )
+        print()
+
+    flash = _load(os.path.join(out, "zoo_flash.json"))
+    if flash:
+        print("## vit flash vs full (zoo rows above are full)\n")
+        for r in flash:
+            print(json.dumps(r))
+        print()
+
+    modes = _load(os.path.join(out, "modes_bench.json"))
+    if modes:
+        print("## input/execution modes (§4c)\n")
+        print("| mode | img/s/chip | vs baseline |")
+        print("|---|---|---|")
+        for r in modes:
+            if "error" in r:
+                print(f"| {r['mode']} | ERROR: {_cell(r['error'][:60])} | |")
+                continue
+            print(
+                f"| {r['mode']} | {r['images_per_sec_per_chip']:,.0f} | "
+                f"{r['vs_baseline']:,.0f}× |"
+            )
+        print()
+
+    attn = _load(os.path.join(out, "attention_bench.json"))
+    if attn:
+        print("## attention microbench (flash vs full)\n")
+        print("| S | full ms | flash ms | speedup | full temp MB | flash temp MB |")
+        print("|---|---|---|---|---|---|")
+        by_seq: dict[int, dict] = {}
+        for r in attn:
+            by_seq.setdefault(r["seq"], {})[r["impl"]] = r
+        for seq in sorted(by_seq):
+            f_, fl = by_seq[seq].get("full", {}), by_seq[seq].get("flash", {})
+            if "error" in f_ or "error" in fl or not f_ or not fl:
+                # keep whichever side succeeded, name the one that failed
+                def fmt(r, impl):
+                    if not r:
+                        return f"{impl}: missing"
+                    if "error" in r:
+                        return f"{impl}: {_cell(r['error'][:50])}"
+                    return f"{r['fwd_bwd_ms']} ms"
+                print(f"| {seq} | {fmt(f_, 'full')} | {fmt(fl, 'flash')} | | | |")
+                continue
+            sp = f_["fwd_bwd_ms"] / fl["fwd_bwd_ms"] if fl["fwd_bwd_ms"] else 0
+            print(
+                f"| {seq} | {f_['fwd_bwd_ms']} | {fl['fwd_bwd_ms']} | "
+                f"{sp:.2f}× | {f_.get('temp_hbm_mb', '?')} | "
+                f"{fl.get('temp_hbm_mb', '?')} |"
+            )
+        print()
+
+    ev = _load_jsonl(os.path.join(out, "eval_bench.json"))
+    if ev:
+        print("## inference bench\n")
+        for r in ev:
+            print(json.dumps(r))
+        print()
+
+    for name in ("roofline_resnet18.txt", "roofline_densenet121.txt",
+                 "flags_sweep.txt", "flags_densenet.txt",
+                 "flags_squeezenet.txt"):
+        p = os.path.join(out, name)
+        if os.path.exists(p):
+            print(f"## {name}\n")
+            with open(p) as f:
+                print(f.read().strip()[:4000])
+            print()
+
+
+if __name__ == "__main__":
+    main()
