@@ -328,30 +328,45 @@ def test_build_inference_wires_pp(tmp_path):
 @pytest.mark.slow
 def test_pp_stages_config_trains_vit(tmp_path):
     """--pp-stages 4 end to end through parse_config/build_training/train on
-    the 8-device mesh (pipe=4 × data=2): the trainer runs, the loss is
-    finite and decreasing, and the checkpoint it writes restores into an
-    UNPIPELINED run (PP-degree-independent checkpoints)."""
+    the 8-device mesh (pipe=4 × data=2): the PIPELINED multi-epoch loss
+    trajectory matches the unpipelined trainer's on the identical config
+    (SURVEY §2c's PP "Done =" criterion), and the checkpoint it writes
+    restores into an UNPIPELINED run (PP-degree-independent checkpoints)."""
     from mpi_pytorch_tpu.config import parse_config
     from mpi_pytorch_tpu.train.trainer import train
 
-    args = [
-        "--model-name", "vit_s16", "--pp-stages", "4",
+    common = [
         "--debug", "true", "--debug-sample-size", "64",
         "--image-size", "32", "--batch-size", "16", "--num-classes", "1000",
         "--num-epochs", "2", "--synthetic-data", "true", "--validate", "false",
-        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--compute-dtype", "float32",  # tight trajectory comparison
         "--log-file", str(tmp_path / "training.log"),
         "--metrics-file", str(tmp_path / "metrics.jsonl"),
     ]
+    args = ["--model-name", "vit_s16", "--pp-stages", "4",
+            "--checkpoint-dir", str(tmp_path / "ckpt")] + common
     cfg = parse_config(args)
     assert cfg.mesh.pipe_parallel == 4
     summary = train(cfg)
     assert summary.epochs_run == 2
     assert np.isfinite(summary.final_loss)
 
+    # Same config WITHOUT pipelining: the per-epoch losses must match —
+    # PP is an execution strategy, not a different trajectory.
+    cfg_ref = parse_config(
+        ["--model-name", "vit_s16",
+         "--checkpoint-dir", str(tmp_path / "ckpt_ref")] + common
+    )
+    summary_ref = train(cfg_ref)
+    np.testing.assert_allclose(
+        summary.epoch_losses, summary_ref.epoch_losses, rtol=1e-4
+    )
+
     # Resume the PP checkpoint WITHOUT pipelining: same param tree.
     cfg2 = parse_config(
-        args[:2] + args[4:] + ["--from-checkpoint", "true", "--num-epochs", "3"]
+        ["--model-name", "vit_s16",
+         "--checkpoint-dir", str(tmp_path / "ckpt"),
+         "--from-checkpoint", "true"] + common + ["--num-epochs", "3"]
     )
     assert cfg2.pp_stages == 1
     summary2 = train(cfg2)
