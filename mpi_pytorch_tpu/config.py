@@ -171,6 +171,13 @@ class Config:
     # Microbatches streamed through the pipeline per step; 0 → 2*pp_stages.
     # The GPipe bubble fraction is (S-1)/(M+S-1): raise M to amortize it.
     pp_microbatches: int = 0
+    # Space-to-depth stem for the resnet family (registry.S2D_MODELS): the
+    # 7×7/stride-2 conv on 3 input channels becomes an exactly-equivalent
+    # 4×4/stride-1 conv on 12 channels (MLPerf conv0 trick) — keeps the
+    # MXU's contracting dimension filled at the stem. Checkpoints carry the
+    # (4,4,12,64) kernel; pretrained 7×7 weights load through the exact
+    # transform (models/resnet.py s2d_stem_kernel). Requires even image size.
+    stem_s2d: bool = False
 
     # --- input pipeline ---
     shuffle: bool = True
@@ -357,6 +364,20 @@ class Config:
                     f"remat='blocks' is not implemented for {self.model_name!r} "
                     f"(supported: {', '.join(REMAT_BLOCKS_MODELS)}); "
                     "use remat='full' or 'none'"
+                )
+        if self.stem_s2d:
+            from mpi_pytorch_tpu.models.registry import S2D_MODELS
+
+            if self.model_name not in S2D_MODELS:
+                raise ValueError(
+                    f"stem_s2d is only implemented for the 7×7-stem family "
+                    f"({', '.join(S2D_MODELS)}); {self.model_name!r} has no "
+                    "such stem"
+                )
+            if self.width % 2 or self.height % 2:
+                raise ValueError(
+                    "stem_s2d folds 2×2 spatial patches into channels and "
+                    f"requires even image dims, got {self.width}x{self.height}"
                 )
         if self.accum_steps < 1:
             raise ValueError(f"accum_steps must be >= 1, got {self.accum_steps}")

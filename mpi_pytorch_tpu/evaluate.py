@@ -75,6 +75,7 @@ def build_inference(cfg: Config, mesh=None, manifests=None):
         sp_mesh=flat_mesh(mesh, "seq") if cfg.sp_strategy != "none" else None,
         ep_mesh=flat_mesh(mesh, "expert") if cfg.expert_parallel else None,
         attn_impl=cfg.attn_impl,
+        stem_s2d=cfg.stem_s2d,
     )
     state = TrainState.create(
         apply_fn=bundle.model.apply,

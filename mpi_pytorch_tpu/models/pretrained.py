@@ -37,9 +37,17 @@ def pretrained_path(model_name: str, pretrained_dir: str) -> str:
     return os.path.join(pretrained_dir, f"{model_name}.msgpack")
 
 
-def load_pretrained(model_name: str, variables: dict, pretrained_dir: str) -> dict:
+def load_pretrained(
+    model_name: str, variables: dict, pretrained_dir: str,
+    stem_s2d: bool = False,
+) -> dict:
     """Overlay converted backbone weights onto freshly-initialized variables,
-    keeping the head's fresh init (head shape depends on num_classes)."""
+    keeping the head's fresh init (head shape depends on num_classes).
+
+    ``stem_s2d``: the converted file always stores the canonical 7×7 stem
+    kernel; space-to-depth models load it through the exact
+    ``s2d_stem_kernel`` transform (models/resnet.py), so one converted
+    artifact serves both stem layouts."""
     if model_name not in CONVERTIBLE_MODELS:
         raise ValueError(
             f"use_pretrained=True is not available for {model_name!r}: the "
@@ -55,10 +63,24 @@ def load_pretrained(model_name: str, variables: dict, pretrained_dir: str) -> di
             "use_pretrained=False (random init)."
         )
     with open(path, "rb") as f:
-        loaded = serialization.from_bytes(variables, f.read())
+        data = f.read()
+    if stem_s2d:
+        from mpi_pytorch_tpu.models.resnet import s2d_stem_kernel
+
+        loaded = serialization.msgpack_restore(data)
+        loaded["params"]["conv1"]["kernel"] = s2d_stem_kernel(
+            loaded["params"]["conv1"]["kernel"]
+        )
+    else:
+        loaded = serialization.from_bytes(variables, data)
 
     def overlay(path_keys, fresh, pre) -> Any:
         keys = [getattr(k, "key", str(k)) for k in path_keys]
+        if not head_filter(keys) and fresh.shape != pre.shape:
+            raise ValueError(
+                f"pretrained leaf {'/'.join(keys)} has shape {pre.shape}, "
+                f"model expects {fresh.shape}"
+            )
         return fresh if head_filter(keys) else pre
 
     return jax.tree_util.tree_map_with_path(overlay, variables, loaded)

@@ -97,6 +97,12 @@ def supports_remat_blocks(model_name: str) -> bool:
     return model_name in REMAT_BLOCKS_MODELS
 
 
+# Architectures whose factories accept stem_s2d (space-to-depth stem — the
+# exact re-expression of the 7×7/s2 3-channel stem conv as a 4×4/s1
+# 12-channel conv; models/resnet.py s2d_stem_input/s2d_stem_kernel).
+S2D_MODELS = ("resnet18", "resnet34")
+
+
 def initialize_model(
     model_name: str,
     num_classes: int,
@@ -112,6 +118,7 @@ def initialize_model(
     sp_mesh: Any = None,
     ep_mesh: Any = None,
     attn_impl: str = "full",
+    stem_s2d: bool = False,
 ) -> tuple[nn.Module, int]:
     """Reference-parity signature (``models.py:16``): returns (model, input_size)."""
     if model_name not in _REGISTRY:
@@ -158,6 +165,13 @@ def initialize_model(
                 "use remat='full' or 'none'"
             )
         kw["remat_blocks"] = True
+    if stem_s2d:
+        if model_name not in S2D_MODELS:
+            raise ValueError(
+                f"stem_s2d is only implemented for the 7×7-stem family "
+                f"({', '.join(S2D_MODELS)}); {model_name!r} has no such stem"
+            )
+        kw["stem_s2d"] = True
     model = factory(num_classes, **kw)
     return model, input_size
 
@@ -198,13 +212,14 @@ def create_model_bundle(
     sp_mesh: Any = None,
     ep_mesh: Any = None,
     attn_impl: str = "full",
+    stem_s2d: bool = False,
 ) -> tuple[ModelBundle, dict]:
     """Full-fat factory: returns the bundle plus initialized variables."""
     model, canonical = initialize_model(
         model_name, num_classes, feature_extract, use_pretrained,
         dtype=dtype, param_dtype=param_dtype, bn_axis_name=bn_axis_name,
         remat_blocks=remat_blocks, sp_strategy=sp_strategy, sp_mesh=sp_mesh,
-        ep_mesh=ep_mesh, attn_impl=attn_impl,
+        ep_mesh=ep_mesh, attn_impl=attn_impl, stem_s2d=stem_s2d,
     )
     size = image_size or (299 if model_name == "inception_v3" else 128)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -213,7 +228,9 @@ def create_model_bundle(
     if use_pretrained:
         from mpi_pytorch_tpu.models.pretrained import load_pretrained
 
-        variables = load_pretrained(model_name, variables, pretrained_dir)
+        variables = load_pretrained(
+            model_name, variables, pretrained_dir, stem_s2d=stem_s2d
+        )
 
     mask = None
     if feature_extract:

@@ -18,6 +18,43 @@ from flax import linen as nn
 from mpi_pytorch_tpu.models.common import batch_norm, global_avg_pool, max_pool
 
 
+def s2d_stem_input(x: jnp.ndarray) -> jnp.ndarray:
+    """Space-to-depth transform of the stem input (NHWC, H and W even):
+    pad spatially by (4, 2) then fold each 2×2 patch into channels —
+    (B, H, W, C) → (B, (H+6)/2, (W+6)/2, 4C), channel order (p, q, c).
+
+    Together with :func:`s2d_stem_kernel` this re-expresses the 7×7/stride-2
+    stem convolution exactly as a 4×4/stride-1 VALID convolution whose
+    contracting dimension is 4·4·12 = 192 instead of 7·7·3 = 147 on a
+    3-channel input — the MLPerf ResNet conv0 trick, which keeps the MXU's
+    contract dimension filled instead of padding 3 channels up to a tile.
+    """
+    b, h, w, c = x.shape
+    if h % 2 or w % 2:
+        raise ValueError(f"s2d stem needs even spatial dims, got {h}x{w}")
+    x = jnp.pad(x, ((0, 0), (4, 2), (4, 2), (0, 0)))
+    hp, wp = h + 6, w + 6
+    x = x.reshape(b, hp // 2, 2, wp // 2, 2, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, hp // 2, wp // 2, 4 * c)
+
+
+def s2d_stem_kernel(k7: jnp.ndarray) -> jnp.ndarray:
+    """Exact transform of a (7, 7, C, Co) HWIO stem kernel into the
+    (4, 4, 4C, Co) kernel that makes `conv(s2d_stem_input(x), k4, stride 1,
+    VALID)` equal the original 7×7/stride-2/pad-3 convolution: zero-pad the
+    kernel to 8×8 at the leading row/column, then fold 2×2 phases into the
+    input-channel dim with the same (p, q, c) order as the input transform.
+    Used by the pretrained-weight path to load torchvision 7×7 stems into
+    s2d models."""
+    if k7.shape[:2] != (7, 7):
+        raise ValueError(f"expected a 7x7 stem kernel, got {k7.shape}")
+    c, co = k7.shape[2], k7.shape[3]
+    k8 = jnp.pad(k7, ((1, 0), (1, 0), (0, 0), (0, 0)))
+    k4 = k8.reshape(4, 2, 4, 2, c, co).transpose(0, 2, 1, 3, 4, 5)
+    return k4.reshape(4, 4, 4 * c, co)
+
+
 class BasicBlock(nn.Module):
     features: int
     stride: int = 1
@@ -63,13 +100,24 @@ class ResNet(nn.Module):
     # (docs/RESULTS.md §4b). Param tree paths are unchanged (lifted
     # transforms preserve scopes), so checkpoints/converters are unaffected.
     remat_blocks: bool = False
+    # Space-to-depth stem (MLPerf conv0 trick): the 7×7/s2 conv on 3 input
+    # channels becomes an exactly-equivalent 4×4/s1 conv on 12 channels —
+    # same param name ("conv1"), kernel shape (4,4,12,64). Pretrained 7×7
+    # weights load through s2d_stem_kernel.
+    stem_s2d: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
-        x = nn.Conv(
-            64, (7, 7), strides=(2, 2), padding=3, use_bias=False,
-            dtype=self.dtype, param_dtype=self.param_dtype, name="conv1",
-        )(x)
+        if self.stem_s2d:
+            x = nn.Conv(
+                64, (4, 4), strides=(1, 1), padding="VALID", use_bias=False,
+                dtype=self.dtype, param_dtype=self.param_dtype, name="conv1",
+            )(s2d_stem_input(x))
+        else:
+            x = nn.Conv(
+                64, (7, 7), strides=(2, 2), padding=3, use_bias=False,
+                dtype=self.dtype, param_dtype=self.param_dtype, name="conv1",
+            )(x)
         x = batch_norm("bn1", dtype=self.dtype, axis_name=self.bn_axis_name)(
             x, use_running_average=not train
         )

@@ -1,0 +1,126 @@
+"""Space-to-depth stem (``--stem-s2d``): exactness and loading.
+
+The claim under test is strong: the s2d stem is not an approximation but an
+exact re-expression of the reference family's 7×7/stride-2/pad-3 stem conv
+(``models.py:30-45`` via torchvision resnet) as a 4×4/stride-1 conv over
+2×2-folded input — so logits, gradients, and pretrained weights must carry
+over exactly (up to float reassociation).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import serialization
+
+from mpi_pytorch_tpu.models.registry import create_model_bundle
+from mpi_pytorch_tpu.models.resnet import s2d_stem_input, s2d_stem_kernel
+
+
+def _conv7(x, k7):
+    return jax.lax.conv_general_dilated(
+        x, k7, window_strides=(2, 2), padding=((3, 3), (3, 3)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _conv4_s2d(x, k4):
+    return jax.lax.conv_general_dilated(
+        s2d_stem_input(x), k4, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+@pytest.mark.parametrize("size", [16, 32, 128])
+def test_s2d_conv_equals_7x7_stride2(size):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, size, size, 3)), jnp.float32)
+    k7 = jnp.asarray(rng.standard_normal((7, 7, 3, 8)), jnp.float32)
+    ref = _conv7(x, k7)
+    got = _conv4_s2d(x, s2d_stem_kernel(k7))
+    assert got.shape == ref.shape == (2, size // 2, size // 2, 8)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_s2d_input_requires_even_dims():
+    with pytest.raises(ValueError, match="even"):
+        s2d_stem_input(jnp.zeros((1, 15, 16, 3)))
+
+
+def test_resnet18_s2d_model_matches_standard():
+    """Same weights (through the kernel transform), same input → same logits;
+    and the gradients of the shared (non-stem) params agree too."""
+    kw = dict(rng=jax.random.PRNGKey(0), image_size=32)
+    bundle_ref, var_ref = create_model_bundle("resnet18", 10, **kw)
+    bundle_s2d, var_s2d = create_model_bundle("resnet18", 10, stem_s2d=True, **kw)
+    assert var_s2d["params"]["conv1"]["kernel"].shape == (4, 4, 12, 64)
+
+    # Carry the reference init into the s2d model exactly.
+    var_s2d = jax.tree.map(lambda a: a, var_ref)  # deep copy of the ref tree
+    var_s2d["params"]["conv1"]["kernel"] = s2d_stem_kernel(
+        var_ref["params"]["conv1"]["kernel"]
+    )
+
+    x = jnp.asarray(
+        np.random.default_rng(1).standard_normal((2, 32, 32, 3)), jnp.float32
+    )
+    logits_ref = bundle_ref.model.apply(var_ref, x, train=False)
+    logits_s2d = bundle_s2d.model.apply(var_s2d, x, train=False)
+    np.testing.assert_allclose(logits_s2d, logits_ref, rtol=1e-4, atol=1e-4)
+
+    def loss(v, model):
+        out = model.apply(v, x, train=False)
+        return jnp.sum(out**2)
+
+    g_ref = jax.grad(loss)(var_ref, bundle_ref.model)["params"]
+    g_s2d = jax.grad(loss)(var_s2d, bundle_s2d.model)["params"]
+    np.testing.assert_allclose(
+        g_s2d["layer1_0"]["conv1"]["kernel"],
+        g_ref["layer1_0"]["conv1"]["kernel"],
+        rtol=1e-3, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        g_s2d["head"]["kernel"], g_ref["head"]["kernel"], rtol=1e-3, atol=1e-4
+    )
+
+
+def test_pretrained_loads_7x7_into_s2d_model(tmp_path):
+    """The converted artifact stores the canonical 7×7 stem; an s2d model
+    loads it through the exact transform — one artifact, both layouts."""
+    kw = dict(rng=jax.random.PRNGKey(0), image_size=32)
+    _, var_canon = create_model_bundle("resnet18", 10, **kw)
+    (tmp_path / "resnet18.msgpack").write_bytes(
+        serialization.to_bytes(var_canon)
+    )
+
+    _, var_loaded = create_model_bundle(
+        "resnet18", 10, use_pretrained=True, stem_s2d=True,
+        pretrained_dir=str(tmp_path), **kw,
+    )
+    np.testing.assert_allclose(
+        var_loaded["params"]["conv1"]["kernel"],
+        s2d_stem_kernel(var_canon["params"]["conv1"]["kernel"]),
+        rtol=0, atol=0,
+    )
+    # A backbone (non-stem, non-head) leaf overlays byte-for-byte.
+    np.testing.assert_allclose(
+        var_loaded["params"]["layer2_0"]["conv1"]["kernel"],
+        var_canon["params"]["layer2_0"]["conv1"]["kernel"],
+        rtol=0, atol=0,
+    )
+
+
+def test_config_rejects_s2d_on_stemless_model():
+    from mpi_pytorch_tpu.config import parse_config
+
+    with pytest.raises(ValueError, match="stem_s2d"):
+        parse_config(["--model-name", "alexnet", "--stem-s2d", "true"])
+    with pytest.raises(ValueError, match="even"):
+        parse_config(["--stem-s2d", "true", "--width", "127", "--height", "127"])
+    ok = parse_config(["--stem-s2d", "true"])  # default resnet18, 128px
+    assert ok.stem_s2d
+
+
+def test_registry_rejects_s2d_on_stemless_model():
+    with pytest.raises(ValueError, match="stem_s2d"):
+        create_model_bundle("vgg11_bn", 10, stem_s2d=True)

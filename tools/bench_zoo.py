@@ -56,7 +56,7 @@ ZOO = {
 
 def build_state_and_batch(
     model_name: str, batch_per_chip: int, image: int, optimizer: bool = True,
-    remat_blocks: bool = False, attn_impl: str = "full",
+    remat_blocks: bool = False, attn_impl: str = "full", stem_s2d: bool = False,
 ):
     """Shared harness setup (also used by tools/bench_eval.py and
     tools/profile_step.py): mesh, placed train state, and a random sharded
@@ -76,7 +76,7 @@ def build_state_and_batch(
     bundle, variables = create_model_bundle(
         model_name, NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=image,
         dtype=jnp.bfloat16, param_dtype=jnp.float32, remat_blocks=remat_blocks,
-        attn_impl=attn_impl,
+        attn_impl=attn_impl, stem_s2d=stem_s2d,
     )
     state = TrainState.create(
         apply_fn=bundle.model.apply, variables=variables,
@@ -115,12 +115,13 @@ def timed_train_steps(compiled, state, device_batch, steps, warmup, trace_dir=""
 
 
 def bench_one(model_name: str, batch_per_chip: int, image: int, steps: int,
-              warmup: int, attn_impl: str = "full"):
+              warmup: int, attn_impl: str = "full", stem_s2d: bool = False):
     from mpi_pytorch_tpu.train.step import make_train_step
     from mpi_pytorch_tpu.utils.hardware import peak_bf16_tflops, step_flops
 
     mesh, state, device_batch, n_chips, batch = build_state_and_batch(
-        model_name, batch_per_chip, image, attn_impl=attn_impl
+        model_name, batch_per_chip, image, attn_impl=attn_impl,
+        stem_s2d=stem_s2d,
     )
     step = make_train_step(jnp.bfloat16)
 
@@ -143,13 +144,15 @@ def bench_one(model_name: str, batch_per_chip: int, image: int, steps: int,
     }
     if attn_impl != "full":
         rec["attn_impl"] = attn_impl
+    if stem_s2d:
+        rec["stem_s2d"] = True
     if peak and flops_per_step > 0:
         rec["mfu_pct"] = round(100.0 * tflops_per_chip / peak, 1)
     return rec
 
 
 def bench_one_in_child(name: str, steps: int, warmup: int, timeout_s: int,
-                       attn_impl: str = "full") -> dict:
+                       attn_impl: str = "full", stem_s2d: bool = False) -> dict:
     """Run one model's bench in a fresh child interpreter with a hard
     timeout. A wedged TPU relay blocks inside a compile/execute RPC that no
     in-process watchdog can interrupt (observed: a full-sweep hang with zero
@@ -163,7 +166,7 @@ def bench_one_in_child(name: str, steps: int, warmup: int, timeout_s: int,
         sys.executable, os.path.abspath(__file__), "--in-process",
         "--models", name, "--steps", str(steps), "--warmup", str(warmup),
         "--attn-impl", attn_impl,
-    ]
+    ] + (["--stem-s2d"] if stem_s2d else [])
     try:
         proc = subprocess.run(
             cmd, cwd=repo, capture_output=True, text=True, timeout=timeout_s
@@ -184,6 +187,8 @@ def main() -> None:
     ap.add_argument("--attn-impl", default="full", choices=["full", "flash"],
                     help="vit family only: dense-attention implementation")
     ap.add_argument("--models", default=",".join(ZOO), help="comma-separated subset")
+    ap.add_argument("--stem-s2d", action="store_true",
+                    help="resnet family only: space-to-depth stem conv")
     ap.add_argument("--out", default="", help="also write a JSON array to this path")
     ap.add_argument(
         "--in-process", action="store_true",
@@ -199,11 +204,11 @@ def main() -> None:
             batch, image = ZOO[name]  # inside try: a typo'd name must not
             if args.in_process:  # kill the sweep or discard --out
                 rec = bench_one(name, batch, image, args.steps, args.warmup,
-                                attn_impl=args.attn_impl)
+                                attn_impl=args.attn_impl, stem_s2d=args.stem_s2d)
             else:
                 rec = bench_one_in_child(
                     name, args.steps, args.warmup, args.model_timeout,
-                    attn_impl=args.attn_impl,
+                    attn_impl=args.attn_impl, stem_s2d=args.stem_s2d,
                 )
         except Exception as e:
             rec = {"model": name, "error": f"{type(e).__name__}: {e}"[:300]}
