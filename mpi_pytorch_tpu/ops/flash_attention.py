@@ -95,7 +95,12 @@ def _attn_fwd_kernel(
         l = l_scr[:, :1]
         safe_l = jnp.where(l > 0, l, 1.0)  # fully-padded q rows (sliced later)
         o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:, :1] + jnp.log(safe_l))[:, 0]
+        # lse rides a 128-wide lane dim (TPU block shapes need the minor-most
+        # two dims (8, 128)-tileable or full; a [BQ] vector is neither) —
+        # broadcast across lanes here, lane 0 is read back after the call.
+        lse_ref[0] = jnp.broadcast_to(
+            m_scr[:, :1] + jnp.log(safe_l), lse_ref[0].shape
+        )
 
 
 def _pad_to(x, axis, mult):
@@ -134,11 +139,11 @@ def _fwd_impl(q3, k3, v3, *, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, iq, ik: (b, iq, 0)),
-            pl.BlockSpec((1, block_q), lambda b, iq, ik: (b, iq)),
+            pl.BlockSpec((1, block_q, 128), lambda b, iq, ik: (b, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
@@ -147,7 +152,7 @@ def _fwd_impl(q3, k3, v3, *, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :s], lse
+    return out[:, :s], lse[:, :, 0]
 
 
 def _bwd_blocked(q3, k3, v3, out, lse, do, *, causal, block_k):

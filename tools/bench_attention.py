@@ -43,13 +43,24 @@ def bench_one(impl: str, seq: int, steps: int, warmup: int) -> dict:
     )
     q, k, v = mk(), mk(), mk()
 
-    @jax.jit
+    # The inputs are DONATED and each step consumes the previous step's
+    # outputs (a true dependency chain), and the timing barrier is a VALUE
+    # FETCH of a scalar computed from the final state — measured live on
+    # this relay: ``block_until_ready`` returns in ~0.03 ms/step while the
+    # actual chained work takes ~170 ms/step (the relay acks readiness
+    # without execution). A fetched value cannot be fabricated, so the
+    # fetch is the only trustworthy barrier for short programs; its one
+    # round-trip is amortized over ``steps``.
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def step(q, k, v):
         def loss(q_, k_, v_):
             return jnp.sum(fn(q_, k_, v_).astype(jnp.float32) ** 2)
 
-        l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
-        return l, grads
+        _, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        eps = jnp.asarray(1e-3, q.dtype)  # tiny axpy: negligible vs attention
+        return q - eps * grads[0], k - eps * grads[1], v - eps * grads[2]
 
     compiled = step.lower(q, k, v).compile()
     mem = None
@@ -59,13 +70,16 @@ def bench_one(impl: str, seq: int, steps: int, warmup: int) -> dict:
     except Exception:
         pass
 
+    def sync(x):  # true execution barrier (see note above)
+        return float(jnp.sum(x.astype(jnp.float32)))
+
     for _ in range(warmup):
-        l, grads = compiled(q, k, v)
-    jax.block_until_ready(grads[0])
+        q, k, v = compiled(q, k, v)
+    sync(q)
     t0 = time.perf_counter()
     for _ in range(steps):
-        l, grads = compiled(q, k, v)
-    jax.block_until_ready(grads[0])
+        q, k, v = compiled(q, k, v)
+    sync(q)
     dt = (time.perf_counter() - t0) / steps
 
     rec = {
