@@ -113,7 +113,25 @@ def main() -> None:
     labels = rng.integers(0, NUM_CLASSES, size=(batch,)).astype(np.int32)
     device_batch = shard_batch((images, labels), mesh)
 
-    compiled = step.lower(state, device_batch).compile()
+    # TPU compiler options. Default: 64 MiB scoped VMEM, the measured
+    # winner of the tools/bench_flags.py sweep on this workload
+    # (docs/flags_vmem_sweep.json: 25.3k img/s / 41.9% MFU vs 24.1k / 40.0%
+    # baseline; 48/80/96/128 MiB all inferior). A set MPT_COMPILER_OPTIONS
+    # (JSON dict) REPLACES the default entirely — so bench_flags.py's
+    # baseline="{}" row really is the no-options baseline — and must hold
+    # PER-COMPILE options, not XLA_FLAGS: the relay's client-side XLA
+    # fatally rejects TPU-only flags it doesn't know (the TPU compiler
+    # lives server-side).
+    env_options = os.environ.get("MPT_COMPILER_OPTIONS")
+    if env_options is not None:
+        options = json.loads(env_options)
+    elif jax.devices()[0].platform == "tpu":
+        options = {"xla_tpu_scoped_vmem_limit_kib": 65536}
+    else:
+        options = {}
+    compiled = step.lower(state, device_batch).compile(
+        compiler_options=options or None
+    )
     flops_per_step = step_flops(compiled)
 
     for _ in range(WARMUP_STEPS):

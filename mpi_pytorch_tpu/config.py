@@ -254,6 +254,15 @@ class Config:
     # numerics — this flag turns every NaN-producing op into an immediate
     # error with a traceback (jax_debug_nans).
     debug_nans: bool = False
+    # Extra TPU compiler options for the AOT-compiled step executables, as
+    # "key=value key2=value2" (bool/int values coerced; leading "--"
+    # tolerated). These are PER-COMPILE PJRT options, not XLA_FLAGS — under
+    # the device relay the client-side XLA fatally rejects TPU-only flags
+    # in XLA_FLAGS, while compile options reach the server-side TPU
+    # compiler. Example measured win (tools/bench_flags.py,
+    # docs/flags_vmem_sweep.json): "xla_tpu_scoped_vmem_limit_kib=65536"
+    # buys +4.8% resnet18 train throughput on v5e.
+    compiler_options: str = ""
 
     mesh: MeshConfig = field(default_factory=MeshConfig)
 
@@ -485,6 +494,35 @@ class Config:
         if self.model_name == "inception_v3":
             return (299, 299)
         return (self.height, self.width)
+
+    def parsed_compiler_options(self) -> dict[str, Any] | None:
+        """``compiler_options`` as the dict jax's ``Lowered.compile`` takes,
+        or None when unset."""
+        return parse_compiler_options(self.compiler_options)
+
+
+def parse_compiler_options(text: str) -> dict[str, Any] | None:
+    """"k=v k2=v2" (comma- or space-separated; leading "--" tolerated) →
+    the dict jax's ``Lowered.compile(compiler_options=...)`` takes, or None
+    for an empty string. XLA's option setter wants REAL types — a "true"
+    string raises "'true' is not a valid bool value", observed live — so
+    values are coerced: true/false/bare → bool, digits → int, rest → str.
+    Single source of truth for the trainer's --compiler-options and
+    tools/bench_flags.py --flags."""
+    if not text.strip():
+        return None
+    opts: dict[str, Any] = {}
+    for item in text.replace(",", " ").split():
+        k, _, v = item.partition("=")
+        if v.lower() in ("", "true", "false"):
+            val: Any = v.lower() != "false"
+        else:
+            try:
+                val = int(v)
+            except ValueError:
+                val = v
+        opts[k.lstrip("-")] = val
+    return opts
 
 
 def apply_runtime_flags(cfg: Config) -> None:

@@ -588,9 +588,11 @@ def train(cfg: Config) -> TrainSummary:
                 state, dataset, labels_all,
                 np.zeros((n_steps, cache_batch), np.int32),
                 np.ones((n_steps, cache_batch), bool),
-            ).compile()
+            ).compile(compiler_options=cfg.parsed_compiler_options())
         else:
-            compiled_step = lowered_step.compile()
+            compiled_step = lowered_step.compile(
+                compiler_options=cfg.parsed_compiler_options()
+            )
     else:
         step_fn = (
             make_spmd_train_step(mesh, _dtype(cfg.compute_dtype), remat=(cfg.remat == "full"))
@@ -608,12 +610,16 @@ def train(cfg: Config) -> TrainSummary:
             mesh,
         )
         if cfg.spmd_mode:
-            compiled_step = step_fn.lower(state, sample).compile()
+            compiled_step = step_fn.lower(state, sample).compile(
+                compiler_options=cfg.parsed_compiler_options()
+            )
         else:
             compiled_step = jax.jit(
                 step_fn, donate_argnums=(0,),
                 out_shardings=(_state_shardings(state), None),
-            ).lower(state, sample).compile()
+            ).lower(state, sample).compile(
+                compiler_options=cfg.parsed_compiler_options()
+            )
     if cfg.device_cache and cfg.scan_epoch:
         # Per-step FLOPs for the scan mode, without compiling a throwaway
         # per-step executable. Two wrinkles: (a) Lowered.cost_analysis() runs

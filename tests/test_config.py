@@ -149,3 +149,24 @@ def test_pp_stages_validation():
                       "--zero-optimizer", "true"])
     with pytest.raises(ValueError, match="pp_microbatches only applies"):
         parse_config(["--model-name", "vit_s16", "--pp-microbatches", "8"])
+
+
+def test_parsed_compiler_options_coercion():
+    """XLA's option setter needs real types (a "true" string is rejected at
+    compile time — observed live), so the parser must coerce."""
+    from mpi_pytorch_tpu.config import parse_config
+
+    cfg = parse_config([
+        "--compiler-options",
+        "xla_tpu_scoped_vmem_limit_kib=65536 "
+        "--xla_tpu_enable_latency_hiding_scheduler=true flag_c=false "
+        "bare_flag name=text",
+    ])
+    assert cfg.parsed_compiler_options() == {
+        "xla_tpu_scoped_vmem_limit_kib": 65536,
+        "xla_tpu_enable_latency_hiding_scheduler": True,
+        "flag_c": False,
+        "bare_flag": True,
+        "name": "text",
+    }
+    assert parse_config([]).parsed_compiler_options() is None

@@ -1,13 +1,18 @@
-"""XLA-flag sweep for the headline benchmark (MFU lever hunting).
+"""TPU-compiler-option sweep for the headline benchmark (MFU lever hunting).
 
-Runs ``bench.py`` in a fresh child interpreter per flag set (XLA latches
-``XLA_FLAGS`` at backend init, so flags can't be changed in-process), parses
-each run's one-line JSON, and prints a ranked table. The flag sets below are
+Runs ``bench.py`` in a fresh child interpreter per option set, parses each
+run's one-line JSON, and prints a ranked table. Options travel as
+PER-COMPILE ``compiler_options`` (via the ``MPT_COMPILER_OPTIONS`` env JSON
+that bench.py/bench_zoo.py read at ``.compile()`` time) — NOT ``XLA_FLAGS``:
+under the device relay the client-side XLA build parses ``XLA_FLAGS`` and
+fatally rejects TPU-only flags (``Unknown flag in XLA_FLAGS``, observed
+live); the TPU compiler that actually honors them lives server-side, and
+PJRT compile options are the channel that reaches it. The sets below are
 the standard TPU levers worth checking for a conv workload; add more on the
 command line:
 
     python tools/bench_flags.py                       # sweep the builtin sets
-    python tools/bench_flags.py --flags "--xla_tpu_scoped_vmem_limit_kib=65536"
+    python tools/bench_flags.py --flags "xla_tpu_scoped_vmem_limit_kib=65536"
 
 Each child inherits ``MPT_BENCH_BACKEND_TIMEOUT_S`` (default 600), so a
 wedged device relay produces an error row rather than a hang.
@@ -23,24 +28,34 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# (label, extra XLA flags). Baseline first; each candidate is one lever.
-SWEEP: list[tuple[str, str]] = [
-    ("baseline", ""),
+# (label, compiler_options dict). Baseline first; each candidate is one lever.
+SWEEP: list[tuple[str, dict]] = [
+    ("baseline", {}),
     # Latency-hiding scheduler: overlaps async copies/collectives with
     # compute; mostly a multi-chip lever but can reorder HBM prefetches.
-    ("latency-hiding", "--xla_tpu_enable_latency_hiding_scheduler=true"),
+    ("latency-hiding", {"xla_tpu_enable_latency_hiding_scheduler": True}),
     # More VMEM for fusion scratch: lets XLA form larger fusions before
     # spilling to HBM (default is model-dependent).
-    ("vmem-64M", "--xla_tpu_scoped_vmem_limit_kib=65536"),
+    ("vmem-64M", {"xla_tpu_scoped_vmem_limit_kib": 65536}),
+    ("vmem-128M", {"xla_tpu_scoped_vmem_limit_kib": 131072}),
     # Aggressive while-loop/all-reduce fusion knobs.
-    ("fusion-aggr", "--xla_tpu_enable_aggressive_loop_fusion=true"),
+    ("fusion-aggr", {"xla_tpu_enable_aggressive_loop_fusion": True}),
 ]
 
 
-def run_one(label: str, extra_flags: str, model: str = "") -> dict:
+def _parse_flag_set(text: str) -> dict:
+    """CLI "k=v k2=v2" → compiler_options dict — the shared parser behind
+    the trainer's --compiler-options (single source of truth for the
+    bool/int coercion XLA's option setter requires)."""
+    sys.path.insert(0, REPO)
+    from mpi_pytorch_tpu.config import parse_compiler_options
+
+    return parse_compiler_options(text) or {}
+
+
+def run_one(label: str, options: dict, model: str = "") -> dict:
     env = dict(os.environ)
-    base = env.get("XLA_FLAGS", "")
-    env["XLA_FLAGS"] = f"{base} {extra_flags}".strip()
+    env["MPT_COMPILER_OPTIONS"] = json.dumps(options)
     # Default: the headline bench.py (resnet18). --model X instead sweeps the
     # flags over any zoo member via a single-model bench_zoo child — the
     # instrument for attacking the bandwidth-bound members (densenet121
@@ -61,7 +76,7 @@ def run_one(label: str, extra_flags: str, model: str = "") -> dict:
         # One wedged flag set must not discard the completed results.
         return {
             "value": 0.0, "error": "child exceeded 1800s (hung past backend init)",
-            "label": label, "flags": extra_flags,
+            "label": label, "flags": options,
         }
     line = ""
     for out_line in (proc.stdout or "").splitlines()[::-1]:
@@ -80,7 +95,7 @@ def run_one(label: str, extra_flags: str, model: str = "") -> dict:
         # bench_zoo rows key throughput differently from bench.py's one-liner.
         rec["value"] = rec.get("images_per_sec_per_chip", 0.0)
     rec["label"] = label
-    rec["flags"] = extra_flags
+    rec["flags"] = options
     return rec
 
 
@@ -111,7 +126,7 @@ def main() -> None:
                 f"builtin sets: {sorted(known)}"
             )
         sweep = [s for s in sweep if s[0] in wanted]
-    sweep = sweep + [(f, f) for f in args.flags]
+    sweep = sweep + [(f, _parse_flag_set(f)) for f in args.flags]
 
     results = []
     for label, flags in sweep:

@@ -125,7 +125,15 @@ def bench_one(model_name: str, batch_per_chip: int, image: int, steps: int,
     )
     step = make_train_step(jnp.bfloat16)
 
-    compiled = step.lower(state, device_batch).compile()
+    # Same channel and contract as bench.py: a set MPT_COMPILER_OPTIONS
+    # (JSON dict) is applied verbatim as per-compile options (client-side
+    # XLA_FLAGS parsing is fatal for TPU-only flags under the relay). The
+    # zoo applies NO default options so cross-model rows stay comparable
+    # across rounds.
+    options = json.loads(os.environ.get("MPT_COMPILER_OPTIONS", "null"))
+    compiled = step.lower(state, device_batch).compile(
+        compiler_options=options or None
+    )
     flops_per_step = step_flops(compiled)
     dt, state = timed_train_steps(compiled, state, device_batch, steps, warmup)
 

@@ -19,8 +19,13 @@ echo "== flash vs full attention on the vit family =="
 python tools/bench_zoo.py --models vit_s16,vit_b16 --attn-impl flash \
     --out "$OUT/zoo_flash.json" || true
 
+echo "== resnet space-to-depth stem vs standard =="
+python tools/bench_zoo.py --models resnet18,resnet34 --stem-s2d \
+    --out "$OUT/zoo_s2d.json" || true
+
 echo "== attention microbench: flash vs full across sequence lengths =="
-timeout 3600 python tools/bench_attention.py --out "$OUT/attention_bench.json" || true
+timeout 3600 python tools/bench_attention.py --seqs 512,1024,2048,4096,8192 \
+    --out "$OUT/attention_bench.json" || true
 
 echo "== input/execution mode sweep (uint8 / cached / scan) =="
 timeout 3600 python tools/bench_modes.py --out "$OUT/modes_bench.json" || true

@@ -86,6 +86,24 @@ def main() -> None:
             print(json.dumps(r))
         print()
 
+    s2d = _load(os.path.join(out, "zoo_s2d.json"))
+    if s2d:
+        print("## resnet space-to-depth stem vs standard (zoo rows above "
+              "are the standard stem)\n")
+        for r in s2d:
+            print(json.dumps(r))
+        print()
+
+    vmem = _load(os.path.join(out, "flags_vmem_sweep.json"))
+    if vmem:
+        print("## scoped-VMEM compiler-option sweep (headline)\n")
+        print("| set | img/s/chip | MFU |")
+        print("|---|---|---|")
+        for r in vmem:
+            print(f"| {_cell(r.get('label'))} | {r.get('value', 0):,.0f} | "
+                  f"{r.get('mfu_pct', '?')}% |")
+        print()
+
     modes = _load(os.path.join(out, "modes_bench.json"))
     if modes:
         print("## input/execution modes (§4c)\n")
