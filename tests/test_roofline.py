@@ -1,0 +1,98 @@
+"""Fixture tests for the roofline instrument's HLO parsers.
+
+The conv FLOP counter shipped with a silent ~30x over-count on backward
+convolutions (a kernel-shaped heuristic applied to activation-shaped rhs
+operands) that poisoned a committed artifact; these fixtures pin the
+HLO-semantic count (2 * out_numel * window_numel * rhs_input_feature) on
+representative forward / grad-style / grouped instruction lines so an XLA
+printer change or a parser regression fails loudly instead of returning
+silent zeros or exaflops.
+"""
+
+import pytest
+
+import tools.roofline as rl
+
+
+def _parse_line(line):
+    m = rl._INSTR_RE.match(line)
+    assert m, f"instruction regex failed on: {line}"
+    return m.group(1), m.group(2), m.group(3), m.group(4)
+
+
+def _conv_flops_from(lines, target):
+    shapes, rows = {}, {}
+    for line in lines:
+        name, shape, op, rest = _parse_line(line)
+        shapes[name] = shape
+        rows[name] = (shape, op, rest)
+    shape, _, rest = rows[target]
+    return rl.conv_flops(shape, rest, shapes)
+
+
+def test_forward_conv_flops_exact():
+    # resnet stem shape: 7x7 s2 conv, 3->64 channels, 128px -> 64px.
+    lines = [
+        "  %p0 = bf16[8,128,128,3]{3,2,1,0} parameter(0)",
+        "  %p1 = bf16[7,7,3,64]{3,2,1,0} parameter(1)",
+        "  %conv = bf16[8,64,64,64]{3,2,1,0} convolution(%p0, %p1),"
+        " window={size=7x7 stride=2x2 pad=3_3x3_3}, dim_labels=b01f_01io->b01f",
+    ]
+    # 2 * out_numel * kh*kw * Cin
+    expected = 2 * (8 * 64 * 64 * 64) * (7 * 7) * 3
+    assert _conv_flops_from(lines, "conv") == expected
+
+
+def test_gradw_style_conv_not_exaflops():
+    """grad-w convs have an ACTIVATION rhs and an image-sized window; the
+    old heuristic (kernel_numel/Cout) attributed petaflops here."""
+    lines = [
+        "  %acts = bf16[8,32,32,112]{3,2,1,0} parameter(0)",
+        "  %grads = bf16[8,32,32,128]{3,2,1,0} parameter(1)",
+        "  %dw = bf16[3,3,112,128]{3,2,1,0} convolution(%acts, %grads),"
+        " window={size=32x32 pad=1_1x1_1}, dim_labels=f01b_i01o->01bf",
+    ]
+    # rhs labels i01o: i at dim 0 -> rhs_dims[0] = 8 (the batch, which is
+    # the contracted "feature" dim of a grad-w conv in this layout).
+    expected = 2 * (3 * 3 * 112 * 128) * (32 * 32) * 8
+    got = _conv_flops_from(lines, "dw")
+    assert got == expected
+    assert got < 1e12  # the regression: old code returned ~1e15 here
+
+
+def test_grouped_conv_uses_hlo_per_group_features():
+    """Depthwise conv: HLO rhs input-feature dim is already Cin/groups=1."""
+    lines = [
+        "  %x = bf16[8,56,56,32]{3,2,1,0} parameter(0)",
+        "  %w = bf16[3,3,1,32]{3,2,1,0} parameter(1)",
+        "  %dwise = bf16[8,56,56,32]{3,2,1,0} convolution(%x, %w),"
+        " window={size=3x3 pad=1_1x1_1}, dim_labels=b01f_01io->b01f,"
+        " feature_group_count=32",
+    ]
+    expected = 2 * (8 * 56 * 56 * 32) * (3 * 3) * 1
+    assert _conv_flops_from(lines, "dwise") == expected
+
+
+def test_unparseable_conv_returns_zero_not_garbage():
+    lines = [
+        "  %x = bf16[8,56,56,32]{3,2,1,0} parameter(0)",
+        "  %w = bf16[3,3,1,32]{3,2,1,0} parameter(1)",
+        "  %weird = bf16[8,56,56,32]{3,2,1,0} convolution(%x, %w)",
+    ]
+    assert _conv_flops_from(lines, "weird") == 0.0
+
+
+def test_dot_flops_mnk():
+    lines = [
+        "  %a = bf16[2048,512]{1,0} parameter(0)",
+        "  %b = bf16[512,64500]{1,0} parameter(1)",
+        "  %mm = bf16[2048,64500]{1,0} dot(%a, %b),"
+        " lhs_contracting_dims={1}, rhs_contracting_dims={0}",
+    ]
+    shapes, rows = {}, {}
+    for line in lines:
+        name, shape, op, rest = _parse_line(line)
+        shapes[name] = shape
+        rows[name] = (shape, op, rest)
+    shape, _, rest = rows["mm"]
+    assert rl.dot_flops(shape, rest, shapes) == 2 * 2048 * 64500 * 512

@@ -8,9 +8,10 @@ instruction estimates
 - ``bytes``: HBM traffic = operand sizes + output size (fusion parameters
   are real HBM reads and the fusion output a real HBM write, so
   instruction-level accounting is the right granularity after XLA fusion);
-- ``flops``: exact for ``convolution`` (2 · out_numel · kh·kw·Cin) and
-  ``dot`` (2 · M·N·K), 0 for data movement and elementwise work (their cost
-  is the bytes);
+- ``flops``: HLO-semantic for ``convolution``
+  (2 · out_numel · window_numel · rhs_input_feature — valid for forward,
+  grad-x, and grad-w convs alike) and ``dot`` (2 · M·N·K), 0 for data
+  movement and elementwise work (their cost is the bytes);
 - ``attainable_ms``: max(flops / peak_FLOPs, bytes / peak_BW) — the roofline
   lower bound for that op on this chip.
 
@@ -127,28 +128,38 @@ def _comp_flops(instrs) -> float:
 
 
 def conv_flops(shape_text: str, rest: str, shapes: dict) -> float:
-    """2 · out_numel · kh·kw·Cin from the kernel operand's shape."""
+    """2 · out_numel · window_numel · rhs_input_feature — the HLO-semantic
+    count, valid for forward, grad-x, AND grad-w convolutions alike.
+
+    The window spatial size and the rhs operand's input-feature dim come
+    from the instruction's own ``window={size=...}`` / ``dim_labels=`` —
+    NOT from assuming the rhs is a (kh,kw,Ci,Co) kernel: in backward convs
+    the rhs is an activation tensor and the window spans the whole image
+    (a densenet grad-w conv was attributed ~2.0e15 FLOPs, ~30x its true
+    cost, by the old kernel-shaped heuristic, poisoning the whole
+    roofline). Grouped
+    convs need no special case: the HLO rhs input-feature dim is already
+    Cin/groups."""
     _, out_dims = _shape_dims(shape_text)
     ops = re.findall(r"%([\w.\-]+)", rest.split("),")[0])
     if len(ops) < 2 or not out_dims:
         return 0.0
-    _, k_dims = _shape_dims(shapes.get(ops[1], ""))
-    if len(k_dims) != 4:
+    mw = re.search(r"window=\{size=([\dx]+)", rest)
+    ml = re.search(r"dim_labels=[\w?]+_([\w?]+)->", rest)
+    _, rhs_dims = _shape_dims(shapes.get(ops[1], ""))
+    if not (mw and ml and rhs_dims):
         return 0.0
-    # dim_labels tells which kernel dims are spatial/in/out; for the common
-    # f01io / o01i layouts the product of all kernel dims / Cout is kh·kw·Cin.
+    window_numel = 1
+    for d in mw.group(1).split("x"):
+        window_numel *= int(d)
+    rhs_labels = ml.group(1)
+    i_idx = rhs_labels.find("i")
+    if i_idx < 0 or i_idx >= len(rhs_dims):
+        return 0.0
     out_numel = 1
     for d in out_dims:
         out_numel *= d
-    kernel_numel = 1
-    for d in k_dims:
-        kernel_numel *= d
-    # Cout is the kernel dim that also appears as the output's feature dim;
-    # heuristic: the kernel dim equal to out_dims' last (NHWC) or dim 1
-    # (NCHW). Fall back to the max dim if ambiguous.
-    feat_candidates = [d for d in (out_dims[-1], out_dims[min(1, len(out_dims) - 1)]) if d in k_dims]
-    cout = feat_candidates[0] if feat_candidates else max(k_dims)
-    return 2.0 * out_numel * (kernel_numel / max(cout, 1))
+    return 2.0 * out_numel * window_numel * rhs_dims[i_idx]
 
 
 def dot_flops(shape_text: str, rest: str, shapes: dict) -> float:
