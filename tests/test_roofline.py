@@ -1,12 +1,21 @@
 """Fixture tests for the roofline instrument's HLO parsers.
 
-The conv FLOP counter shipped with a silent ~30x over-count on backward
-convolutions (a kernel-shaped heuristic applied to activation-shaped rhs
-operands) that poisoned a committed artifact; these fixtures pin the
-HLO-semantic count (2 * out_numel * window_numel * rhs_input_feature) on
-representative forward / grad-style / grouped instruction lines so an XLA
-printer change or a parser regression fails loudly instead of returning
-silent zeros or exaflops.
+Two silent-overcount regressions are pinned here because each one poisoned
+a committed artifact before it was caught:
+
+- the conv FLOP counter applied a kernel-shaped heuristic to
+  activation-shaped rhs operands, attributing ~30x over-counts (petaflops)
+  to grad-w convolutions (densenet);
+- the naive 2*out*window*rhs_i count charges padding positions as real
+  MACs, a 4096x over-count on the grad-x of a 1x1 conv, which XLA
+  canonicalizes into a 64x64-window conv over the 63-padded weight
+  (mobilenet_v2) — pushing Σ attainable above the *measured* step time,
+  an impossible "lower bound".
+
+The fixed semantics: per-axis valid-MAC counting (padding/dilation
+positions excluded), window-less convs scored as the dots they are, and
+HBM byte accounting that skips VMEM/SMEM-pinned (``S(n)``) buffers and
+alias-only ops (``*-done``, ``ConcatBitcast``).
 """
 
 import pytest
@@ -30,7 +39,7 @@ def _conv_flops_from(lines, target):
     return rl.conv_flops(shape, rest, shapes)
 
 
-def test_forward_conv_flops_exact():
+def test_forward_conv_flops_valid_macs():
     # resnet stem shape: 7x7 s2 conv, 3->64 channels, 128px -> 64px.
     lines = [
         "  %p0 = bf16[8,128,128,3]{3,2,1,0} parameter(0)",
@@ -38,9 +47,38 @@ def test_forward_conv_flops_exact():
         "  %conv = bf16[8,64,64,64]{3,2,1,0} convolution(%p0, %p1),"
         " window={size=7x7 stride=2x2 pad=3_3x3_3}, dim_labels=b01f_01io->b01f",
     ]
-    # 2 * out_numel * kh*kw * Cin
-    expected = 2 * (8 * 64 * 64 * 64) * (7 * 7) * 3
-    assert _conv_flops_from(lines, "conv") == expected
+    # Per-axis valid (o,k) pairs: j = 2o + k - 3 must land in [0,128).
+    t_axis = sum(
+        1
+        for k in range(7)
+        for o in range(64)
+        if 0 <= 2 * o + k - 3 < 128
+    )
+    assert t_axis == 442  # naive O*W = 448; 6 edge pairs hit padding
+    expected = 2 * (8 * 64) * (t_axis**2) * 3
+    got = _conv_flops_from(lines, "conv")
+    assert got == expected
+    # within ~3% of the padding-blind count — edge effects only
+    naive = 2 * (8 * 64 * 64 * 64) * 49 * 3
+    assert 0.97 < got / naive < 1.0
+
+
+def test_gradx_of_1x1_conv_not_4096x():
+    """XLA canonicalizes the grad-x of a 1x1 conv into a full-image-window
+    conv over the (W-1)-padded weight: 4095 of 4096 window positions hit
+    padding. The naive count was 4096x the true cost (mobilenet_v2)."""
+    lines = [
+        "  %w = bf16[1,1,16,96]{3,2,1,0} parameter(0)",
+        "  %dy = bf16[1024,64,64,96]{0,3,2,1} parameter(1)",
+        "  %dx = bf16[1024,64,64,16]{0,3,2,1} convolution(%w, %dy),"
+        " window={size=64x64 pad=63_63x63_63 rhs_reversal=1x1},"
+        " dim_labels=01bf_o01i->f01b",
+    ]
+    got = _conv_flops_from(lines, "dx")
+    # true grad-x cost: 2 * N * H * W * Cin * Cout
+    assert got == 2 * 1024 * 64 * 64 * 16 * 96
+    naive = 2 * (1024 * 64 * 64 * 16) * (64 * 64) * 96
+    assert got * 4096 == naive  # the regression magnitude, pinned
 
 
 def test_gradw_style_conv_not_exaflops():
@@ -52,12 +90,53 @@ def test_gradw_style_conv_not_exaflops():
         "  %dw = bf16[3,3,112,128]{3,2,1,0} convolution(%acts, %grads),"
         " window={size=32x32 pad=1_1x1_1}, dim_labels=f01b_i01o->01bf",
     ]
+    # Valid (o,k) pairs along one axis: out=3, lhs=32, window=32, pad 1.
+    t_axis = sum(
+        1 for k in range(32) for o in range(3) if 0 <= o + k - 1 < 32
+    )
+    assert t_axis == 94  # naive O*W = 96
     # rhs labels i01o: i at dim 0 -> rhs_dims[0] = 8 (the batch, which is
     # the contracted "feature" dim of a grad-w conv in this layout).
-    expected = 2 * (3 * 3 * 112 * 128) * (32 * 32) * 8
+    expected = 2 * (112 * 128) * (t_axis**2) * 8
     got = _conv_flops_from(lines, "dw")
     assert got == expected
     assert got < 1e12  # the regression: old code returned ~1e15 here
+
+
+def test_strided_backward_lhs_dilation_counts_real_macs_only():
+    """grad-x of a stride-2 conv: lhs_dilate=2 inserts zeros between every
+    lhs element; window positions landing on inserted zeros are skipped."""
+    lines = [
+        "  %dy = bf16[8,16,16,64]{3,2,1,0} parameter(0)",
+        "  %w = bf16[3,3,32,64]{3,2,1,0} parameter(1)",
+        "  %dx = bf16[8,32,32,32]{3,2,1,0} convolution(%dy, %w),"
+        " window={size=3x3 pad=1_2x1_2 lhs_dilate=2x2 rhs_reversal=1x1},"
+        " dim_labels=b01f_01oi->b01f",
+    ]
+    t_axis = 0
+    for k in range(3):
+        for o in range(32):
+            j = o + k - 1
+            if 0 <= j <= (16 - 1) * 2 and j % 2 == 0:
+                t_axis += 1
+    expected = 2 * (8 * 32) * (t_axis**2) * 64
+    assert _conv_flops_from(lines, "dx") == expected
+    # roughly half the window positions land on dilation zeros
+    naive = 2 * (8 * 32 * 32 * 32) * 9 * 64
+    assert expected < 0.6 * naive
+
+
+def test_windowless_conv_is_a_dot():
+    """XLA prints the head matmul as `convolution ... dim_labels=bf_io->bf`
+    with NO window attribute; skipping it dropped ~500 GFLOP/step of the
+    64 500-class head from mobilenet's roofline."""
+    lines = [
+        "  %x = bf16[1024,1280]{1,0} parameter(0)",
+        "  %w = bf16[1280,64500]{1,0} parameter(1)",
+        "  %mm = bf16[1024,64500]{1,0} convolution(%x, %w),"
+        " dim_labels=bf_io->bf",
+    ]
+    assert _conv_flops_from(lines, "mm") == 2 * 1024 * 64500 * 1280
 
 
 def test_grouped_conv_uses_hlo_per_group_features():
@@ -69,7 +148,10 @@ def test_grouped_conv_uses_hlo_per_group_features():
         " window={size=3x3 pad=1_1x1_1}, dim_labels=b01f_01io->b01f,"
         " feature_group_count=32",
     ]
-    expected = 2 * (8 * 56 * 56 * 32) * (3 * 3) * 1
+    t_axis = sum(
+        1 for k in range(3) for o in range(56) if 0 <= o + k - 1 < 56
+    )
+    expected = 2 * (8 * 32) * (t_axis**2) * 1
     assert _conv_flops_from(lines, "dwise") == expected
 
 
@@ -96,3 +178,56 @@ def test_dot_flops_mnk():
         rows[name] = (shape, op, rest)
     shape, _, rest = rows["mm"]
     assert rl.dot_flops(shape, rest, shapes) == 2 * 2048 * 64500 * 512
+
+
+def test_vmem_pinned_buffers_are_not_hbm_bytes():
+    """S(n) memory-space layouts (VMEM/SMEM/sync) consume no HBM bandwidth;
+    counting them pushed mobilenet's Σ attainable ABOVE its measured step."""
+    hbm = "bf16[1024,64,64,96]{0,3,2,1:T(8,128)(2,1)}"
+    vmem = "bf16[1024,16,16,32]{0,3,2,1:T(8,128)(2,1)S(1)}"
+    smem_flag = "u32[]{:S(2)}"
+    assert rl.shape_hbm_bytes(hbm) == 1024 * 64 * 64 * 96 * 2
+    assert rl.shape_hbm_bytes(vmem) == 0
+    assert rl.shape_hbm_bytes(smem_flag) == 0
+    # tuple: only the HBM element counts
+    assert rl.shape_hbm_bytes(f"({hbm}, {vmem})") == 1024 * 64 * 64 * 96 * 2
+    # plain shape_bytes (cost attribution, not HBM) still counts everything
+    assert rl.shape_bytes(vmem) == 1024 * 16 * 16 * 32 * 2
+
+
+def test_alias_ops_carry_no_bytes():
+    """*-done ops re-surface the transfer their *-start already counted;
+    ConcatBitcast stitches async slice DMAs by aliasing. Counting either
+    double-charges the same bytes."""
+    hlo = """\
+ENTRY %main (p0: bf16[1024,1024]) -> bf16[1024,1024] {
+  %p0 = bf16[1024,1024]{1,0} parameter(0)
+  %copy-start.1 = (bf16[1024,1024]{1,0:S(1)}, bf16[1024,1024]{1,0}, u32[]{:S(2)}) copy-start(%p0)
+  %copy-done.1 = bf16[1024,1024]{1,0:S(1)} copy-done(%copy-start.1)
+  %concat = bf16[1024,1024]{1,0} custom-call(%copy-done.1), custom_call_target="ConcatBitcast"
+  ROOT %out = bf16[1024,1024]{1,0} fusion(%concat), kind=kLoop, calls=%fc
+}
+"""
+    rows = rl.roofline(hlo, 197.0, 819.0)
+    ops = {r["op"] for r in rows}
+    assert "copy-done" not in ops
+    assert "custom-call" not in ops  # the ConcatBitcast
+    # copy-start counted once: reads p0 from HBM (1024*1024*2); the result
+    # tuple's HBM element is an ALIAS of the operand (the real destination
+    # is the S(1) element), so no write is charged.
+    start = next(r for r in rows if r["op"] == "copy-start")
+    assert start["bytes"] == 1024 * 1024 * 2
+
+
+def test_collective_start_write_is_not_subtracted():
+    """all-reduce-start's result is a real write (no operand alias in the
+    tuple); zeroing it would understate multi-chip bounds."""
+    hlo = """\
+ENTRY %main (p0: bf16[4096,512]) -> bf16[4096,512] {
+  %p0 = bf16[4096,512]{1,0} parameter(0)
+  ROOT %ar = bf16[4096,512]{1,0} all-reduce-start(%p0), replica_groups={}
+}
+"""
+    rows = rl.roofline(hlo, 197.0, 819.0)
+    ar = next(r for r in rows if r["op"] == "all-reduce-start")
+    assert ar["bytes"] == 2 * 4096 * 512 * 2  # read + write, both charged

@@ -7,11 +7,16 @@ instruction estimates
 
 - ``bytes``: HBM traffic = operand sizes + output size (fusion parameters
   are real HBM reads and the fusion output a real HBM write, so
-  instruction-level accounting is the right granularity after XLA fusion);
-- ``flops``: HLO-semantic for ``convolution``
-  (2 · out_numel · window_numel · rhs_input_feature — valid for forward,
-  grad-x, and grad-w convs alike) and ``dot`` (2 · M·N·K), 0 for data
-  movement and elementwise work (their cost is the bytes);
+  instruction-level accounting is the right granularity after XLA fusion)
+  — EXCLUDING buffers pinned on-chip (``S(n)`` memory-space layouts),
+  alias-only ops (``*-done``, ``ConcatBitcast``, ``bitcast``), and the
+  operand-alias element of ``*-start`` tuples;
+- ``flops``: per-axis valid-MAC counting for ``convolution``
+  (2 · out_batch·out_feat · Π_axis valid (o,k) pairs · rhs_input_feature —
+  padding/striding/dilation positions excluded; valid for forward, grad-x,
+  and grad-w convs alike, and window-less head-matmul convolutions score
+  as the dots they are) and ``dot`` (2 · M·N·K), 0 for data movement and
+  elementwise work (their cost is the bytes);
 - ``attainable_ms``: max(flops / peak_FLOPs, bytes / peak_BW) — the roofline
   lower bound for that op on this chip.
 
@@ -35,6 +40,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import re
 import sys
@@ -51,13 +57,16 @@ _DTYPE_BYTES = {
 }
 
 _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+# Shape + its layout braces, e.g. bf16[1024,64,64,96]{0,3,2,1:T(8,128)(2,1)S(1)}
+_SHAPE_LAYOUT_RE = re.compile(r"(\w+)\[([\d,]*)\](\{[^{}]*\})?")
 
 
-def shape_bytes(shape_text: str) -> int:
-    """Total bytes of an HLO shape string (tuples: sum of elements)."""
+def _bytes_of(shape_text: str, hbm_only: bool) -> int:
     total = 0
-    for dtype, dims in _SHAPE_RE.findall(shape_text):
+    for dtype, dims, layout in _SHAPE_LAYOUT_RE.findall(shape_text):
         if dtype not in _DTYPE_BYTES:
+            continue
+        if hbm_only and layout and "S(" in layout:
             continue
         n = 1
         for d in dims.split(","):
@@ -65,6 +74,23 @@ def shape_bytes(shape_text: str) -> int:
                 n *= int(d)
         total += n * _DTYPE_BYTES[dtype]
     return total
+
+
+def shape_bytes(shape_text: str) -> int:
+    """Total bytes of an HLO shape string (tuples: sum of elements)."""
+    return _bytes_of(shape_text, hbm_only=False)
+
+
+def shape_hbm_bytes(shape_text: str) -> int:
+    """Bytes of an HLO shape that actually live in HBM.
+
+    A layout with an ``S(n)`` memory-space annotation is NOT in HBM
+    (on TPU, space 1 = VMEM, 2 = SMEM, 6 = sync flags): XLA pins those
+    inter-kernel buffers on-chip, so their reads/writes consume zero HBM
+    bandwidth. Counting them as HBM traffic pushed mobilenet_v2's
+    Σ attainable above its *measured* step time — an impossible "lower
+    bound"."""
+    return _bytes_of(shape_text, hbm_only=True)
 
 
 def _shape_dims(shape_text: str):
@@ -127,39 +153,125 @@ def _comp_flops(instrs) -> float:
     return total
 
 
-def conv_flops(shape_text: str, rest: str, shapes: dict) -> float:
-    """2 · out_numel · window_numel · rhs_input_feature — the HLO-semantic
-    count, valid for forward, grad-x, AND grad-w convolutions alike.
+def _parse_window(rest: str):
+    """window={size=.. stride=.. pad=.. lhs_dilate=.. rhs_dilate=..} →
+    (sizes, strides, pads_lo, lhs_dil, rhs_dil) per spatial axis."""
+    m = re.search(r"window=\{([^}]*)\}", rest)
+    if not m:
+        # 0-spatial-dim convs (XLA canonicalizes the head matmul into
+        # `convolution ... dim_labels=bf_io->bf` with no window attribute):
+        # zero axes → the formula degenerates to 2·out_numel·rhs_i, the
+        # exact dot count.
+        return [], [], [], [], []
+    body = m.group(1)
+    mk = re.search(r"size=([\dx]+)", body)
+    if not mk:
+        return None
+    sizes = [int(x) for x in mk.group(1).split("x")]
+    n = len(sizes)
 
-    The window spatial size and the rhs operand's input-feature dim come
-    from the instruction's own ``window={size=...}`` / ``dim_labels=`` —
-    NOT from assuming the rhs is a (kh,kw,Ci,Co) kernel: in backward convs
-    the rhs is an activation tensor and the window spans the whole image
-    (a densenet grad-w conv was attributed ~2.0e15 FLOPs, ~30x its true
-    cost, by the old kernel-shaped heuristic, poisoning the whole
-    roofline). Grouped
-    convs need no special case: the HLO rhs input-feature dim is already
-    Cin/groups."""
+    def vec(key, default):
+        mv = re.search(rf"{key}=([\dx]+)", body)
+        if not mv:
+            return [default] * n
+        return [int(x) for x in mv.group(1).split("x")]
+
+    strides = vec("stride", 1)
+    lhs_dil = vec("lhs_dilate", 1)
+    rhs_dil = vec("rhs_dilate", 1)
+    mp = re.search(r"pad=([\d_x\-]+)", body)
+    if mp:
+        pads_lo = [int(x.split("_")[0]) for x in mp.group(1).split("x")]
+    else:
+        pads_lo = [0] * n
+    return sizes, strides, pads_lo, lhs_dil, rhs_dil
+
+
+def _axis_macs(out_size, lhs_size, window, stride, pad_lo, lhs_d, rhs_d):
+    """Valid (output-position, window-element) pairs along one spatial axis.
+
+    A window element k at output position o reads base-input coordinate
+    j = o·stride + k·rhs_dilate − pad_lo, which holds real data only when
+    0 ≤ j ≤ (lhs_size−1)·lhs_dilate and j is a multiple of lhs_dilate —
+    everything else is padding/dilation zeros a real implementation skips.
+    Counting only those pairs keeps Σ attainable a true LOWER bound."""
+    total = 0
+    ext = (lhs_size - 1) * lhs_d
+    for k in range(window):
+        base = k * rhs_d - pad_lo
+        lo = max(0, math.ceil(-base / stride))
+        hi = min(out_size - 1, math.floor((ext - base) / stride))
+        if hi < lo:
+            continue
+        if lhs_d == 1:
+            total += hi - lo + 1
+        else:
+            total += sum(
+                1 for o in range(lo, hi + 1) if (o * stride + base) % lhs_d == 0
+            )
+    return total
+
+
+def conv_flops(shape_text: str, rest: str, shapes: dict) -> float:
+    """2 · out_batch·out_feat · Π_axis valid_MACs(axis) · rhs_input_feature.
+
+    Two refinements over naive 2·out_numel·window_numel·rhs_i, both needed
+    for the count to stay a valid roofline LOWER bound on executed work:
+
+    - window/dim_labels come from the instruction itself, NOT from assuming
+      the rhs is a (kh,kw,Ci,Co) kernel: in backward convs the rhs is an
+      activation tensor and the window spans the whole image (a densenet
+      grad-w conv was attributed ~2.0e15 FLOPs, ~30x its true cost, by the
+      old kernel-shaped heuristic).
+    - padding/dilation positions are EXCLUDED per axis (``_axis_macs``).
+      XLA canonicalizes the grad-x of a 1×1 conv into a 64×64-window conv
+      over the 63-padded weight — 4095 of 4096 window positions hit
+      padding, so the naive count was 4096× too high (mobilenet_v2's
+      "52.8 TFLOP" fusion is really 12.9 GFLOP).
+
+    Grouped convs need no special case: the HLO rhs input-feature dim is
+    already Cin/groups."""
     _, out_dims = _shape_dims(shape_text)
     ops = re.findall(r"%([\w.\-]+)", rest.split("),")[0])
     if len(ops) < 2 or not out_dims:
         return 0.0
-    mw = re.search(r"window=\{size=([\dx]+)", rest)
-    ml = re.search(r"dim_labels=[\w?]+_([\w?]+)->", rest)
+    win = _parse_window(rest)
+    ml = re.search(r"dim_labels=([\w?]+)_([\w?]+)->([\w?]+)", rest)
+    _, lhs_dims = _shape_dims(shapes.get(ops[0], ""))
     _, rhs_dims = _shape_dims(shapes.get(ops[1], ""))
-    if not (mw and ml and rhs_dims):
+    if not (win and ml and rhs_dims):
         return 0.0
-    window_numel = 1
-    for d in mw.group(1).split("x"):
-        window_numel *= int(d)
-    rhs_labels = ml.group(1)
+    sizes, strides, pads_lo, lhs_dil, rhs_dil = win
+    lhs_labels, rhs_labels, out_labels = ml.groups()
     i_idx = rhs_labels.find("i")
     if i_idx < 0 or i_idx >= len(rhs_dims):
         return 0.0
+
     out_numel = 1
     for d in out_dims:
         out_numel *= d
-    return 2.0 * out_numel * window_numel * rhs_dims[i_idx]
+    naive = 2.0 * out_numel * math.prod(sizes) * rhs_dims[i_idx]
+
+    # Per-axis valid-MAC refinement; fall back to the naive count when the
+    # label→dim mapping doesn't resolve (defensive: never return 0 for a
+    # conv we can see).
+    bf_numel = 1.0
+    for label, d in zip(out_labels, out_dims):
+        if label in ("b", "f"):
+            bf_numel *= d
+    macs = 1.0
+    for axis, w in enumerate(sizes):
+        a = str(axis)
+        o_idx, l_idx = out_labels.find(a), lhs_labels.find(a)
+        if o_idx < 0 or l_idx < 0 or o_idx >= len(out_dims) or l_idx >= len(
+            lhs_dims or []
+        ):
+            return naive
+        macs *= _axis_macs(
+            out_dims[o_idx], lhs_dims[l_idx], w,
+            strides[axis], pads_lo[axis], lhs_dil[axis], rhs_dil[axis],
+        )
+    return min(naive, 2.0 * bf_numel * macs * rhs_dims[i_idx])
 
 
 def dot_flops(shape_text: str, rest: str, shapes: dict) -> float:
@@ -197,11 +309,24 @@ def roofline(hlo_text: str, peak_tflops: float | None, peak_gbps: float | None):
 
     rows = []
     for name, shape_text, op, rest in instrs:
-        if op in ("parameter", "constant", "tuple", "get-tuple-element"):
+        if op in ("parameter", "constant", "tuple", "get-tuple-element", "bitcast"):
             continue
-        out_b = shape_bytes(shape_text)
+        # *-done ops alias the transfer their *-start already counted;
+        # ConcatBitcast stitches async slice DMAs together by aliasing —
+        # neither moves a byte of its own.
+        if op.endswith("-done") or "ConcatBitcast" in rest:
+            continue
+        out_b = shape_hbm_bytes(shape_text)
         operand_names = re.findall(r"%([\w.\-]+)", rest.split(", kind=")[0])
-        in_b = sum(shape_bytes(shapes.get(o, "")) for o in operand_names)
+        in_b = sum(shape_hbm_bytes(shapes.get(o, "")) for o in operand_names)
+        if op in ("copy-start", "async-start"):
+            # These start ops' result tuples carry an ALIAS of the operand
+            # alongside the real destination; subtracting the operand
+            # footprint leaves exactly the destination write (0 for
+            # HBM→VMEM prefetches, dest size for HBM→HBM copies).
+            # Collective starts (all-reduce-start etc.) are NOT included:
+            # their results are real writes, not aliases.
+            out_b = max(0, out_b - in_b)
         fl = 0.0
         if op == "convolution":
             fl = conv_flops(shape_text, rest, shapes)
@@ -246,7 +371,13 @@ def main() -> None:
         args.model, args.batch, args.image
     )
     step = make_train_step(jnp.bfloat16)
-    compiled = step.lower(state, batch).compile()
+    # Score the exact compile that runs: MPT_COMPILER_OPTIONS (same JSON
+    # contract as bench.py/bench_zoo.py) reaches this compile too, so the
+    # roofline of e.g. the shipped vmem-64M configuration is the roofline
+    # OF that configuration (more S(1)-pinned buffers → fewer HBM bytes).
+    env_options = os.environ.get("MPT_COMPILER_OPTIONS")
+    options = json.loads(env_options) if env_options else {}
+    compiled = step.lower(state, batch).compile(compiler_options=options or None)
     hlo = compiled.as_text()
     dev = jax.devices()[0]
     peak_t, peak_b = peak_bf16_tflops(dev), peak_hbm_gbps(dev)
