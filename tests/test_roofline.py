@@ -231,3 +231,39 @@ ENTRY %main (p0: bf16[4096,512]) -> bf16[4096,512] {
     rows = rl.roofline(hlo, 197.0, 819.0)
     ar = next(r for r in rows if r["op"] == "all-reduce-start")
     assert ar["bytes"] == 2 * 4096 * 512 * 2  # read + write, both charged
+
+
+def test_aliasing_collective_start_operand_is_subtracted():
+    """all-gather-start / collective-permute-start return (operand, result)
+    tuples whose first element ALIASES the input; charging it as an HBM
+    write double-counts the operand on multi-chip HLOs (the exact
+    impossible-lower-bound failure class the S(1) fix addressed)."""
+    hlo = """\
+ENTRY %main (p0: bf16[4096,512]) -> bf16[4096,4096] {
+  %p0 = bf16[4096,512]{1,0} parameter(0)
+  %ag = (bf16[4096,512]{1,0}, bf16[4096,4096]{1,0}) all-gather-start(%p0), replica_groups={}, dimensions={1}
+  %p1 = bf16[4096,512]{1,0} parameter(1)
+  ROOT %cp = (bf16[4096,512]{1,0}, bf16[4096,512]{1,0}) collective-permute-start(%p1), source_target_pairs={{0,1}}
+}
+"""
+    rows = rl.roofline(hlo, 197.0, 819.0)
+    ag = next(r for r in rows if r["op"] == "all-gather-start")
+    # read p0 once + write only the RESULT element (8x the shard), not the
+    # aliased operand element.
+    assert ag["bytes"] == 4096 * 512 * 2 + 4096 * 4096 * 2
+    cp = next(r for r in rows if r["op"] == "collective-permute-start")
+    # (operand_alias, result): charge read + one result write.
+    assert cp["bytes"] == 2 * 4096 * 512 * 2
+
+
+def test_sizeless_window_does_not_zero_conv_flops():
+    """A window={...} attribute without size= must degrade to the
+    dot-degenerate count (like a missing window), never to 0 FLOPs."""
+    assert rl._parse_window("window={stride=1x1}") == ([], [], [], [], [])
+    shapes = {
+        "lhs": "bf16[2048,512]{1,0}",
+        "rhs": "bf16[512,64500]{1,0}",
+    }
+    rest = ("%lhs, %rhs), window={stride=1}, dim_labels=bf_io->bf")
+    fl = rl.conv_flops("bf16[2048,64500]{1,0}", rest, shapes)
+    assert fl == 2.0 * 2048 * 64500 * 512

@@ -166,7 +166,12 @@ def _parse_window(rest: str):
     body = m.group(1)
     mk = re.search(r"size=([\dx]+)", body)
     if not mk:
-        return None
+        # A window={...} attribute with no size= key carries no spatial
+        # extent — treat it exactly like a missing window (zero spatial
+        # axes → the dot-degenerate count), NOT as unparseable: returning
+        # None here would zero the conv's FLOPs, contradicting the
+        # "never return 0 for a conv we can see" stance below.
+        return [], [], [], [], []
     sizes = [int(x) for x in mk.group(1).split("x")]
     n = len(sizes)
 
@@ -319,13 +324,23 @@ def roofline(hlo_text: str, peak_tflops: float | None, peak_gbps: float | None):
         out_b = shape_hbm_bytes(shape_text)
         operand_names = re.findall(r"%([\w.\-]+)", rest.split(", kind=")[0])
         in_b = sum(shape_hbm_bytes(shapes.get(o, "")) for o in operand_names)
-        if op in ("copy-start", "async-start"):
+        if op in (
+            "copy-start",
+            "async-start",
+            "all-gather-start",
+            "collective-permute-start",
+        ):
             # These start ops' result tuples carry an ALIAS of the operand
             # alongside the real destination; subtracting the operand
             # footprint leaves exactly the destination write (0 for
             # HBM→VMEM prefetches, dest size for HBM→HBM copies).
-            # Collective starts (all-reduce-start etc.) are NOT included:
-            # their results are real writes, not aliases.
+            # all-gather-start and collective-permute-start return
+            # (operand, result) tuples whose FIRST element aliases the
+            # input — without the subtraction the operand is double-charged
+            # as an HBM write on multi-chip HLOs, recreating the
+            # "Σ attainable above measured" impossible-lower-bound failure.
+            # all-reduce-start is NOT included: its result is the reduced
+            # output itself, a real write with no alias element.
             out_b = max(0, out_b - in_b)
         fl = 0.0
         if op == "convolution":
