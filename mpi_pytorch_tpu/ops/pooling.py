@@ -1,0 +1,160 @@
+"""Index-based max pooling — the byte-budget replacement for XLA's
+``select-and-scatter`` backward.
+
+Why this op exists (docs/RESULTS.md §4d): in the resnet18 roofline the
+single largest row is the stem maxpool's backward ``select-and-scatter``
+(2 416 MB: it re-reads the full pre-pool activation [B,64,64,64] to
+re-discover which window element won, reads the pooled gradient, and
+writes the input gradient). The winner was already known at forward time.
+This module computes the pool as an elementwise max over the window's
+strided slices and records the FIRST-match argmax as a uint8 window
+offset; the backward then scatters the pooled gradient through that index
+— reading ``g`` (268 MB) + ``idx`` (134 MB) instead of the 1 073 MB
+activation — and needs no select-and-scatter at all. The slice/where/pad
+formulation is deliberately XLA-fusion-friendly: forward fuses into one
+multi-output fusion (and pulls the producing elementwise chain in with
+it), backward fuses the nine masked pads into a single kLoop fusion that
+downstream BN/conv-backward fusions can consume inline.
+
+Semantics match ``flax.linen.max_pool`` exactly, gradients included:
+
+- values: elementwise max over strided slices ≡ ``reduce_window`` max
+  (same ``lax.max`` combiner, -inf edge padding);
+- gradient ties: select-and-scatter folds the window with a ``ge`` select,
+  so the FIRST element equal to the max wins; here a strict ``>`` update
+  keeps the first max too. tests/test_pooling.py pins value and gradient
+  equality against ``nn.max_pool`` on tie-heavy inputs for every pool
+  config the model zoo uses (≙ the reference's torch maxpools,
+  e.g. ``models.py:33-95`` resnet/alexnet/vgg/squeezenet/densenet stems).
+
+Used by every CNN in the zoo via ``models.common.max_pool``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Padding2 = tuple[tuple[int, int], tuple[int, int]]
+
+
+def _out_len(size: int, window: int, stride: int, pad: tuple[int, int]) -> int:
+    return (size + pad[0] + pad[1] - window) // stride + 1
+
+
+def _window_slices(x, window, strides, padding: Padding2):
+    """The padded input's strided slice for each window offset (dh, dw),
+    in row-major window order — the iteration order that defines
+    first-match tie-breaking."""
+    kh, kw = window
+    sh, sw = strides
+    (plh, phh), (plw, phw) = padding
+    b, h, w, c = x.shape
+    oh = _out_len(h, kh, sh, (plh, phh))
+    ow = _out_len(w, kw, sw, (plw, phw))
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    xp = jnp.pad(x, ((0, 0), (plh, phh), (plw, phw), (0, 0)), constant_values=neg)
+    for dh in range(kh):
+        for dw in range(kw):
+            yield lax.slice(
+                xp,
+                (0, dh, dw, 0),
+                (b, dh + (oh - 1) * sh + 1, dw + (ow - 1) * sw + 1, c),
+                (1, sh, sw, 1),
+            )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def max_pool_argmax(x, window, strides, padding: Padding2):
+    """NHWC max pool with an index-based backward. Drop-in value-equal
+    replacement for ``nn.max_pool(x, window, strides, padding)`` with
+    explicit numeric padding. The primal (non-differentiated) path computes
+    only the max — eval forwards pay nothing for the index machinery."""
+    parts = _window_slices(x, window, strides, padding)
+    return functools.reduce(jnp.maximum, parts)
+
+
+def _fwd(x, window, strides, padding: Padding2):
+    best = None
+    bestk = None
+    for k, part in enumerate(_window_slices(x, window, strides, padding)):
+        if best is None:
+            best = part
+            bestk = jnp.zeros(part.shape, jnp.uint8)
+        else:
+            better = part > best  # strict: the FIRST max keeps the window
+            best = jnp.where(better, part, best)
+            bestk = jnp.where(better, jnp.uint8(k), bestk)
+    return best, (bestk, x.shape)
+
+
+def _shifted(mk, off_h, off_w, ha, wa, zero):
+    """t[a, b] = mk[a + off_h, b + off_w] on an (ha, wa) grid, zero outside
+    — one edge-only ``lax.pad`` (negative edges trim), which the TPU fusion
+    emitter happily inlines. Interior-dilated pads — the naive per-offset
+    scatter — do NOT fuse: XLA materialized nine full-size dilated tensors
+    (an 11.9 GB fusion, measured), which is why the backward is phrased as
+    this phase-gather instead."""
+    oh, ow = mk.shape[1], mk.shape[2]
+    cfg = (
+        (0, 0, 0),
+        (-off_h, ha - (oh - off_h), 0),
+        (-off_w, wa - (ow - off_w), 0),
+        (0, 0, 0),
+    )
+    return lax.pad(mk, zero, cfg)
+
+
+def _bwd(window, strides, padding: Padding2, res, g):
+    """Input-gradient as a parity-phase gather: input position h = sh·a + t
+    receives contributions only from window offsets dh with
+    (t + pad_lo − dh) ≡ 0 (mod sh), at output row a + (t + pad_lo − dh)/sh.
+    Each phase (t, u) is therefore a SUM OF SHIFTED SLICES of the masked
+    pooled gradient — elementwise ops, edge pads, and one interleaving
+    stack/reshape, all fusible on TPU. Total HBM traffic: read g + idx,
+    write the input gradient; no select-and-scatter, no dilated pads."""
+    bestk, in_shape = res
+    kh, kw = window
+    sh, sw = strides
+    (plh, _), (plw, _) = padding
+    b, h, w, c = in_shape
+    ha, wa = -(-h // sh), -(-w // sw)  # phase grid (padded up to a multiple)
+    zero = jnp.asarray(0, g.dtype)
+
+    masked = {}
+
+    def mk(k):
+        if k not in masked:
+            masked[k] = jnp.where(bestk == jnp.uint8(k), g, zero)
+        return masked[k]
+
+    def phase(t, u):
+        acc = None
+        for dh in range(kh):
+            if (t + plh - dh) % sh:
+                continue
+            off_h = (t + plh - dh) // sh
+            for dw in range(kw):
+                if (u + plw - dw) % sw:
+                    continue
+                off_w = (u + plw - dw) // sw
+                sl = _shifted(mk(dh * kw + dw), off_h, off_w, ha, wa, zero)
+                acc = sl if acc is None else acc + sl
+        if acc is None:
+            acc = jnp.zeros((b, ha, wa, c), g.dtype)
+        return acc
+
+    rows = [
+        jnp.stack([phase(t, u) for u in range(sw)], axis=3) for t in range(sh)
+    ]  # each [B, ha, wa, sw, C]
+    out = jnp.stack(rows, axis=2)  # [B, ha, sh, wa, sw, C]
+    out = out.reshape(b, ha * sh, wa * sw, c)
+    if out.shape[1] != h or out.shape[2] != w:
+        out = out[:, :h, :w, :]
+    return (out,)
+
+
+max_pool_argmax.defvjp(_fwd, _bwd)

@@ -1,0 +1,109 @@
+"""max_pool_argmax ≡ nn.max_pool — values AND gradients, every zoo config.
+
+The index-based pool (ops/pooling.py) replaces XLA's select-and-scatter
+backward; these tests pin exact equivalence on the pool configs the model
+zoo actually uses, including tie-heavy integer-valued inputs where the
+first-match tie-break rule is load-bearing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_pytorch_tpu.models.common import max_pool_xla
+from mpi_pytorch_tpu.ops.pooling import max_pool_argmax
+
+# (window, stride, padding) as used by the zoo:
+# resnet/densenet stems (3,2,1); alexnet/squeezenet/inception (3,2,VALID);
+# vgg (2,2,VALID).
+ZOO_CONFIGS = [(3, 2, 1), (3, 2, "VALID"), (2, 2, "VALID")]
+
+
+def _pad(padding):
+    if isinstance(padding, int):
+        return ((padding, padding), (padding, padding))
+    return ((0, 0), (0, 0))
+
+
+def max_pool(x, window, stride, padding):
+    return max_pool_argmax(x, (window, window), (stride, stride), _pad(padding))
+
+
+def _rand(shape, seed, tie_heavy=False, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    if tie_heavy:
+        # Small-integer values + zeros: many exact window ties, and
+        # all-zero windows (the post-relu case where the relu mask matters).
+        x = rng.integers(-2, 3, size=shape).astype(np.float32)
+    else:
+        x = rng.standard_normal(shape).astype(np.float32)
+    return jnp.asarray(x, dtype)
+
+
+@pytest.mark.parametrize("window,stride,padding", ZOO_CONFIGS)
+@pytest.mark.parametrize("tie_heavy", [False, True])
+def test_values_and_grads_match_xla(window, stride, padding, tie_heavy):
+    x = _rand((2, 13, 13, 8), seed=window * 10 + stride, tie_heavy=tie_heavy)
+
+    def f_new(x):
+        return jnp.sum(max_pool(x, window, stride, padding) ** 2)
+
+    def f_ref(x):
+        return jnp.sum(max_pool_xla(x, window, stride, padding) ** 2)
+
+    v_new, g_new = jax.value_and_grad(f_new)(x)
+    v_ref, g_ref = jax.value_and_grad(f_ref)(x)
+    np.testing.assert_array_equal(np.asarray(v_new), np.asarray(v_ref))
+    np.testing.assert_array_equal(np.asarray(g_new), np.asarray(g_ref))
+
+
+def test_values_match_bf16():
+    x = _rand((2, 16, 16, 8), seed=0, dtype=jnp.bfloat16)
+    got = max_pool(x, 3, 2, 1)
+    want = max_pool_xla(x, 3, 2, 1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_grad_ties_first_match():
+    """A window of identical values routes the whole gradient to the FIRST
+    element (select-and-scatter's ge-fold semantics)."""
+    x = jnp.ones((1, 2, 2, 1), jnp.float32)
+    g = jax.grad(lambda x: jnp.sum(max_pool(x, 2, 2, "VALID")))(x)
+    want = np.zeros((1, 2, 2, 1), np.float32)
+    want[0, 0, 0, 0] = 1.0
+    np.testing.assert_array_equal(np.asarray(g), want)
+
+
+def test_grad_under_jit_and_odd_sizes():
+    """299px-style odd spatial dims (inception) under jit."""
+    x = _rand((2, 17, 17, 4), seed=3)
+
+    @jax.jit
+    def g_new(x):
+        return jax.grad(lambda x: jnp.sum(max_pool(x, 3, 2, "VALID") * 3.0))(x)
+
+    @jax.jit
+    def g_ref(x):
+        return jax.grad(lambda x: jnp.sum(max_pool_xla(x, 3, 2, "VALID") * 3.0))(x)
+
+    np.testing.assert_array_equal(np.asarray(g_new(x)), np.asarray(g_ref(x)))
+
+
+def test_eval_path_has_no_index_output():
+    """The primal (non-differentiated) path computes only the max — the
+    jaxpr must contain no uint8 argmax bookkeeping."""
+    x = _rand((1, 8, 8, 4), seed=5)
+    jaxpr = jax.make_jaxpr(
+        lambda x: max_pool_argmax(x, (3, 3), (2, 2), ((1, 1), (1, 1)))
+    )(x)
+    assert "u8" not in str(jaxpr)
+
+
+def test_vmap_compat():
+    x = _rand((3, 2, 12, 12, 4), seed=6)
+    got = jax.vmap(lambda x: max_pool(x, 3, 2, 1))(x)
+    want = jax.vmap(lambda x: max_pool_xla(x, 3, 2, 1))(x)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

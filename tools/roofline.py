@@ -369,6 +369,9 @@ def main() -> None:
     ap.add_argument("--image", type=int, default=128)
     ap.add_argument("--top", type=int, default=20)
     ap.add_argument("--json", default="", help="write full rows to this path")
+    ap.add_argument("--dump-hlo", default="",
+                    help="write the optimized HLO text to this path (the "
+                    "instruction names in the roofline rows index into it)")
     ap.add_argument("--measured-ms", type=float, default=0.0,
                     help="measured step ms (from bench_zoo) for the ceiling line")
     args = ap.parse_args()
@@ -394,6 +397,10 @@ def main() -> None:
     options = json.loads(env_options) if env_options else {}
     compiled = step.lower(state, batch).compile(compiler_options=options or None)
     hlo = compiled.as_text()
+    if args.dump_hlo:
+        with open(args.dump_hlo, "w") as f:
+            f.write(hlo)
+        print(f"optimized HLO written: {args.dump_hlo}")
     dev = jax.devices()[0]
     peak_t, peak_b = peak_bf16_tflops(dev), peak_hbm_gbps(dev)
 
