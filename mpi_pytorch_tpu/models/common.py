@@ -48,9 +48,9 @@ def max_pool(x: jnp.ndarray, window: int, stride: int, padding: Any = "VALID") -
     An index-based alternative exists (``ops/pooling.py``) but measured
     WORSE as a general drop-in: XLA materializes the scatter's dilated
     pads (or the phase-interleave copies) instead of fusing them, so the
-    roofline bound regressed 62.4→79.5 ms on resnet18. The byte win is
-    taken where it actually pays: the fused stem (``ops/fused_stem.py``)
-    keeps the argmax in VMEM inside a Pallas kernel."""
+    roofline bound regressed 62.4→79.5 ms on resnet18 (docs/RESULTS.md
+    §4d records the full negative result). It is kept, unused, as the
+    pinned-semantics base for a future VMEM-resident fused-stem kernel."""
     if isinstance(padding, int):
         padding = [(padding, padding), (padding, padding)]
     return nn.max_pool(x, (window, window), strides=(stride, stride), padding=padding)

@@ -27,7 +27,16 @@ Semantics match ``flax.linen.max_pool`` exactly, gradients included:
   config the model zoo uses (≙ the reference's torch maxpools,
   e.g. ``models.py:33-95`` resnet/alexnet/vgg/squeezenet/densenet stems).
 
-Used by every CNN in the zoo via ``models.common.max_pool``.
+STATUS: measured and REJECTED as the zoo-wide default (docs/RESULTS.md
+§4d). As a standalone drop-in for ``models.common.max_pool`` the roofline
+bound regressed 62.4 → 79.5 ms on resnet18: XLA keeps the phase-gather
+byte win in theory but spends it back in practice on the interleave
+stack/reshape copies it would not fuse. ``models.common.max_pool`` still
+calls ``nn.max_pool`` — this op has NO production call sites and is kept
+(a) as the pinned-semantics reference for the index-based backward and
+(b) as the building block for a VMEM-resident fused-stem kernel, where
+the argmax never round-trips through HBM and the failure mode above
+cannot occur.
 """
 
 from __future__ import annotations
@@ -86,7 +95,11 @@ def _fwd(x, window, strides, padding: Padding2):
             bestk = jnp.zeros(part.shape, jnp.uint8)
         else:
             better = part > best  # strict: the FIRST max keeps the window
-            best = jnp.where(better, part, best)
+            # jnp.maximum (not where(better)) so NaN propagates exactly like
+            # the primal path's reduce — where() would silently drop a NaN
+            # in `part`, making grad-traced forward values diverge from the
+            # un-traced forward on NaN inputs.
+            best = jnp.maximum(best, part)
             bestk = jnp.where(better, jnp.uint8(k), bestk)
     return best, (bestk, x.shape)
 
