@@ -97,9 +97,15 @@ def main() -> None:
     batch = BATCH_PER_CHIP * n_chips
 
     mesh = create_mesh(Config().mesh)
+    # Fused bn1+relu+maxpool stem (ops/fused_stem.py): the headline winner
+    # on chip (docs/RESULTS.md §4d). MPT_FUSED_STEM=0 reverts to the
+    # unfused XLA stem for A/B.
+    from mpi_pytorch_tpu.models.registry import fused_stem_default
+
     bundle, variables = create_model_bundle(
         MODEL, NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=IMAGE,
         dtype=jnp.bfloat16, param_dtype=jnp.float32,
+        fused_stem=fused_stem_default(MODEL),
     )
     state = TrainState.create(
         apply_fn=bundle.model.apply, variables=variables,

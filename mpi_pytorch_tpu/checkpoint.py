@@ -50,6 +50,38 @@ def _state_arrays(state: Any) -> dict:
     }
 
 
+# D2H chunk size for the background writer. One monolithic device_get of
+# the full state (~0.5 GB at headline scale) enqueues the whole transfer at
+# once and the training loop's dispatches queue behind it on the device
+# relay; leaf-at-a-time fetches with big leaves split along axis 0 leave
+# gaps the next epoch's steps slip into (VERDICT r4 item 6's "chunked
+# leaf-by-leaf D2H overlapped with next-epoch compute").
+_D2H_CHUNK_BYTES = 32 * 1024 * 1024
+
+
+def _chunked_device_get(tree):
+    def get(x):
+        if isinstance(x, np.ndarray):
+            # Already host memory (the sharded path gathers to numpy
+            # before serializing) — chunking would only add a copy.
+            return x
+        if not hasattr(x, "nbytes") or getattr(x, "ndim", 0) == 0:
+            return np.asarray(jax.device_get(x))
+        n = x.shape[0] if x.ndim else 0
+        if x.nbytes <= _D2H_CHUNK_BYTES or n < 2:
+            return np.asarray(jax.device_get(x))
+        rows = max(1, int(n * _D2H_CHUNK_BYTES / x.nbytes))
+        return np.concatenate(
+            [
+                np.asarray(jax.device_get(x[s : min(s + rows, n)]))
+                for s in range(0, n, rows)
+            ],
+            axis=0,
+        )
+
+    return jax.tree_util.tree_map(get, tree)
+
+
 def _payload_from(arrays: dict, epoch: int, loss: float) -> dict:
     """The single checkpoint schema, built from a ``_state_arrays`` dict
     (live state or async snapshot) — save paths and the restore template all
@@ -58,11 +90,11 @@ def _payload_from(arrays: dict, epoch: int, loss: float) -> dict:
         "epoch": epoch,
         "step": np.asarray(jax.device_get(arrays["step"])),
         "loss": np.asarray(loss, np.float32),
-        "params": jax.device_get(arrays["params"]),
-        "batch_stats": jax.device_get(arrays["batch_stats"])
+        "params": _chunked_device_get(arrays["params"]),
+        "batch_stats": _chunked_device_get(arrays["batch_stats"])
         if arrays["batch_stats"] is not None
         else {},
-        "opt_state": jax.device_get(arrays["opt_state"]),
+        "opt_state": _chunked_device_get(arrays["opt_state"]),
         "rng": jax.device_get(arrays["rng"]),
     }
 
@@ -188,6 +220,35 @@ def _copy_fn(out_sharding=None):
     return jax.jit(copy, out_shardings=out_sharding)
 
 
+# Optimizer-moment tensors at or above this element count are cast to bf16
+# by the ``moments_bf16`` snapshot option; schedule scalars / step counts
+# below it stay exact (a bf16 Adam count would corrupt bias correction).
+_MOMENT_CAST_MIN_SIZE = 4096
+
+
+@functools.lru_cache(maxsize=None)
+def _moment_cast_fn():
+    """Jitted device-side cast of the big f32 optimizer-moment tensors to
+    bf16 — fused into the snapshot so the D2H transfer and the file carry
+    half the bytes (~540 MB → ~270 MB of Adam moments at headline scale).
+    Shardings pass through untouched (no donation: the live state keeps
+    training). Lossy by design: restore returns moments quantized to bf16
+    (~3 decimal digits), which perturbs the post-resume trajectory within
+    optimizer-noise — the flag trades that for 2× faster snapshots."""
+
+    import jax.numpy as jnp  # local: keep module import surface minimal
+
+    def cast(opt_state):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if x.dtype == jnp.float32 and x.size >= _MOMENT_CAST_MIN_SIZE
+            else x,
+            opt_state,
+        )
+
+    return jax.jit(cast)
+
+
 def _replicated_sharding(arrays: dict):
     """``NamedSharding(mesh, P())`` over the mesh the state lives on, or None
     for states that aren't mesh-placed (plain host/numpy test states)."""
@@ -277,9 +338,15 @@ class AsyncCheckpointer:
         keep: int = 3,
         on_durable=None,
         dirty: bool = False,
+        moments_bf16: bool = False,
     ) -> str | None:
         """Snapshot now, write in the background; returns the path that will
         exist once the write completes (None on processes > 0).
+
+        ``moments_bf16`` casts the large f32 optimizer-moment tensors to
+        bf16 on device before the snapshot (``--ckpt-bf16-moments``):
+        halves the moment D2H bytes and the file size; restore casts back
+        to the optimizer's dtype (values quantized to bf16).
 
         EVERY process must call this (the trainer does): the snapshot is a
         global SPMD computation on multi-host meshes, so gating it to
@@ -294,6 +361,8 @@ class AsyncCheckpointer:
         after which the writer only serializes."""
         self.wait()
         arrays = _state_arrays(state)
+        if moments_bf16:
+            arrays = dict(arrays, opt_state=_moment_cast_fn()(arrays["opt_state"]))
         repl = _replicated_sharding(arrays)
         if repl is not None and _any_sharded(arrays):
             # Sharded state: leaf-by-leaf host gather (see _gather_to_host)
@@ -354,11 +423,21 @@ def load_checkpoint(path: str, state: Any) -> tuple[Any, int, float]:
     with open(path, "rb") as f:
         data = f.read()
     restored = serialization.from_bytes(_payload(state), data)
+    # A moments_bf16 checkpoint stores the big moment tensors in bf16; the
+    # optimizer expects its own dtype (f32) back. Cast against the live
+    # state's opt_state as the dtype template (no-op for exact saves).
+    opt_state = jax.tree_util.tree_map(
+        lambda tmpl, got: np.asarray(got).astype(tmpl.dtype)
+        if hasattr(tmpl, "dtype") and got.dtype != tmpl.dtype
+        else got,
+        _state_arrays(state)["opt_state"],
+        restored["opt_state"],
+    )
     new_state = state.replace(
         step=jax.numpy.asarray(restored["step"]),
         params=restored["params"],
         batch_stats=restored["batch_stats"] if state.batch_stats is not None else None,
-        opt_state=restored["opt_state"],
+        opt_state=opt_state,
         rng=jax.numpy.asarray(restored["rng"]),
     )
     return new_state, int(restored["epoch"]), float(restored["loss"])

@@ -178,6 +178,14 @@ class Config:
     # (4,4,12,64) kernel; pretrained 7×7 weights load through the exact
     # transform (models/resnet.py s2d_stem_kernel). Requires even image size.
     stem_s2d: bool = False
+    # Fused stem for the resnet family (registry.FUSED_STEM_MODELS):
+    # bn1+relu+maxpool(3,2,1) as one Pallas kernel pair (ops/fused_stem.py) —
+    # the conv1 activation never round-trips HBM between BN and the pool, and
+    # the pool backward is an index gather instead of select-and-scatter
+    # (docs/RESULTS.md §4d). Same variable tree as the unfused stem, so
+    # checkpoints interchange. TPU only (XLA composition elsewhere); requires
+    # even post-conv spatial dims (any even image size) and local BN.
+    fused_stem: bool = False
 
     # --- input pipeline ---
     shuffle: bool = True
@@ -228,6 +236,12 @@ class Config:
     # --- checkpoint ---
     keep_checkpoints: int = 3
     checkpoint_every_epochs: int = 1
+    # Cast the large f32 Adam-moment tensors to bf16 in the snapshot:
+    # halves the moment D2H bytes and the file (~540 MB → ~270 MB at
+    # headline scale). Lossy for the moments only (params stay exact);
+    # restore casts back to f32, so resume continues with bf16-quantized
+    # moments — a trajectory perturbation within optimizer noise.
+    ckpt_bf16_moments: bool = False
     # Track the best-validation checkpoint: on a val-accuracy improvement the
     # epoch's checkpoint is dispatched (even when the periodic save isn't
     # due) and best.json points at it; retention never deletes it; evaluate
@@ -387,6 +401,26 @@ class Config:
                 raise ValueError(
                     "stem_s2d folds 2×2 spatial patches into channels and "
                     f"requires even image dims, got {self.width}x{self.height}"
+                )
+        if self.fused_stem:
+            from mpi_pytorch_tpu.models.registry import FUSED_STEM_MODELS
+
+            if self.model_name not in FUSED_STEM_MODELS:
+                raise ValueError(
+                    f"fused_stem is only implemented for the 7×7-stem family "
+                    f"({', '.join(FUSED_STEM_MODELS)}); {self.model_name!r} "
+                    "has no such stem"
+                )
+            # conv1 output dim: 7×7/s2/p3 → (N-1)//2 + 1; with stem_s2d
+            # the equivalent 4×4/s1 conv gives N/2 (even N already required).
+            def post_conv(n: int) -> int:
+                return n // 2 if self.stem_s2d else (n - 1) // 2 + 1
+
+            if post_conv(self.width) % 2 or post_conv(self.height) % 2:
+                raise ValueError(
+                    "fused_stem needs even post-conv spatial dims; "
+                    f"{self.width}x{self.height} gives "
+                    f"{post_conv(self.width)}x{post_conv(self.height)}"
                 )
         if self.accum_steps < 1:
             raise ValueError(f"accum_steps must be >= 1, got {self.accum_steps}")

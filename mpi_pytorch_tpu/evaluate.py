@@ -76,6 +76,7 @@ def build_inference(cfg: Config, mesh=None, manifests=None):
         ep_mesh=flat_mesh(mesh, "expert") if cfg.expert_parallel else None,
         attn_impl=cfg.attn_impl,
         stem_s2d=cfg.stem_s2d,
+        fused_stem=cfg.fused_stem,
     )
     state = TrainState.create(
         apply_fn=bundle.model.apply,

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Sequence
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -40,6 +41,61 @@ def batch_norm(
         axis_name=axis_name,
         name=name,
     )
+
+
+class FusedStemBNReluPool(nn.Module):
+    """BatchNorm + ReLU + 3×3/s2/p1 max-pool as ONE fused op — the resnet
+    stem tail (reference ``models.py:30-45`` → torchvision ``bn1``/``relu``/
+    ``maxpool``), executed by the ``ops/fused_stem.py`` Pallas kernel pair
+    on TPU (docs/RESULTS.md §4d: removes the 1 GB intermediate activation
+    and the select-and-scatter backward from the HBM budget).
+
+    Variable layout is IDENTICAL to ``batch_norm(name)`` + separate pool:
+    params ``{scale, bias}``, batch_stats ``{mean, var}`` (biased batch
+    variance, torch/flax momentum convention) — checkpoints move freely
+    between the fused and unfused stem. Stats are computed in f32 from the
+    conv output (XLA fuses that reduce into the conv epilogue, as it does
+    for the unfused path); the kernel receives the folded affine
+    a = γ·rsqrt(var+ε), b = β − μ·a. Sync-BN (``axis_name``) is not
+    supported here — the fused stem exists for the reference's local-BN
+    data-parallel semantics (``mpi_tools.py:30-37``)."""
+
+    momentum: float = BN_MOMENTUM
+    eps: float = BN_EPS
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, y: jnp.ndarray, use_running_average: bool) -> jnp.ndarray:
+        from mpi_pytorch_tpu.ops.fused_stem import stem_affine_relu_pool
+
+        c = y.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (c,), self.param_dtype)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((c,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((c,), jnp.float32)
+        )
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            yf = y.astype(jnp.float32)
+            mean = yf.mean(axis=(0, 1, 2))
+            var = jnp.square(yf).mean(axis=(0, 1, 2)) - jnp.square(mean)
+            if not self.is_initializing():
+                ra_mean.value = (
+                    self.momentum * ra_mean.value + (1 - self.momentum) * mean
+                )
+                ra_var.value = (
+                    self.momentum * ra_var.value + (1 - self.momentum) * var
+                )
+        a = scale.astype(jnp.float32) * jax.lax.rsqrt(var + self.eps)
+        b = bias.astype(jnp.float32) - mean * a
+        # Output in the module's compute dtype, matching what the unfused
+        # batch_norm(dtype=...) -> relu -> pool composition produces.
+        return stem_affine_relu_pool(y, a, b).astype(self.dtype)
 
 
 def max_pool(x: jnp.ndarray, window: int, stride: int, padding: Any = "VALID") -> jnp.ndarray:

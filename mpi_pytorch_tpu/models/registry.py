@@ -102,6 +102,26 @@ def supports_remat_blocks(model_name: str) -> bool:
 # 12-channel conv; models/resnet.py s2d_stem_input/s2d_stem_kernel).
 S2D_MODELS = ("resnet18", "resnet34")
 
+# Architectures whose factories accept fused_stem (the bn1+relu+maxpool
+# Pallas kernel pair, ops/fused_stem.py — same 7×7-stem family; the fused
+# module mirrors flax BatchNorm's variable tree so checkpoints interchange).
+FUSED_STEM_MODELS = ("resnet18", "resnet34")
+
+
+def fused_stem_default(model_name: str) -> bool:
+    """The benchmark harnesses' shared gate: fused stem ON for the 7x7-stem
+    family on TPU unless MPT_FUSED_STEM=0 (the A/B escape hatch). The
+    trainer/eval CLIs stay explicit via ``--fused-stem``."""
+    import os
+
+    import jax
+
+    return (
+        model_name in FUSED_STEM_MODELS
+        and os.environ.get("MPT_FUSED_STEM", "1") not in ("", "0", "false")
+        and jax.devices()[0].platform == "tpu"
+    )
+
 
 def initialize_model(
     model_name: str,
@@ -119,6 +139,7 @@ def initialize_model(
     ep_mesh: Any = None,
     attn_impl: str = "full",
     stem_s2d: bool = False,
+    fused_stem: bool = False,
 ) -> tuple[nn.Module, int]:
     """Reference-parity signature (``models.py:16``): returns (model, input_size)."""
     if model_name not in _REGISTRY:
@@ -172,6 +193,15 @@ def initialize_model(
                 f"({', '.join(S2D_MODELS)}); {model_name!r} has no such stem"
             )
         kw["stem_s2d"] = True
+    if fused_stem:
+        if model_name not in FUSED_STEM_MODELS:
+            raise ValueError(
+                f"fused_stem is only implemented for the 7×7-stem family "
+                f"({', '.join(FUSED_STEM_MODELS)}); {model_name!r} has no such stem"
+            )
+        if bn_axis_name is not None:
+            raise ValueError("fused_stem does not support sync-BN (bn_axis_name)")
+        kw["fused_stem"] = True
     model = factory(num_classes, **kw)
     return model, input_size
 
@@ -213,6 +243,7 @@ def create_model_bundle(
     ep_mesh: Any = None,
     attn_impl: str = "full",
     stem_s2d: bool = False,
+    fused_stem: bool = False,
 ) -> tuple[ModelBundle, dict]:
     """Full-fat factory: returns the bundle plus initialized variables."""
     model, canonical = initialize_model(
@@ -220,6 +251,7 @@ def create_model_bundle(
         dtype=dtype, param_dtype=param_dtype, bn_axis_name=bn_axis_name,
         remat_blocks=remat_blocks, sp_strategy=sp_strategy, sp_mesh=sp_mesh,
         ep_mesh=ep_mesh, attn_impl=attn_impl, stem_s2d=stem_s2d,
+        fused_stem=fused_stem,
     )
     size = image_size or (299 if model_name == "inception_v3" else 128)
     rng = rng if rng is not None else jax.random.PRNGKey(0)

@@ -15,7 +15,12 @@ from typing import Any, Sequence
 import jax.numpy as jnp
 from flax import linen as nn
 
-from mpi_pytorch_tpu.models.common import batch_norm, global_avg_pool, max_pool
+from mpi_pytorch_tpu.models.common import (
+    FusedStemBNReluPool,
+    batch_norm,
+    global_avg_pool,
+    max_pool,
+)
 
 
 def s2d_stem_input(x: jnp.ndarray) -> jnp.ndarray:
@@ -105,6 +110,11 @@ class ResNet(nn.Module):
     # same param name ("conv1"), kernel shape (4,4,12,64). Pretrained 7×7
     # weights load through s2d_stem_kernel.
     stem_s2d: bool = False
+    # Fuse bn1+relu+maxpool into the ops/fused_stem.py Pallas kernel pair
+    # (TPU; XLA composition elsewhere). Same variable tree as the unfused
+    # stem (FusedStemBNReluPool mirrors flax BatchNorm's layout), so
+    # checkpoints interchange. Requires sync-BN off (bn_axis_name=None).
+    fused_stem: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -118,11 +128,18 @@ class ResNet(nn.Module):
                 64, (7, 7), strides=(2, 2), padding=3, use_bias=False,
                 dtype=self.dtype, param_dtype=self.param_dtype, name="conv1",
             )(x)
-        x = batch_norm("bn1", dtype=self.dtype, axis_name=self.bn_axis_name)(
-            x, use_running_average=not train
-        )
-        x = nn.relu(x)
-        x = max_pool(x, 3, 2, padding=1)
+        if self.fused_stem:
+            if self.bn_axis_name is not None:
+                raise ValueError("fused_stem does not support sync-BN (bn_axis_name)")
+            x = FusedStemBNReluPool(
+                dtype=self.dtype, param_dtype=self.param_dtype, name="bn1"
+            )(x, use_running_average=not train)
+        else:
+            x = batch_norm("bn1", dtype=self.dtype, axis_name=self.bn_axis_name)(
+                x, use_running_average=not train
+            )
+            x = nn.relu(x)
+            x = max_pool(x, 3, 2, padding=1)
 
         block_cls = (
             nn.remat(BasicBlock, static_argnums=(2,))  # (self, x, train)

@@ -204,6 +204,7 @@ def build_training(cfg: Config, mesh=None):
         ep_mesh=flat_mesh(mesh, "expert") if cfg.expert_parallel else None,
         attn_impl=cfg.attn_impl,
         stem_s2d=cfg.stem_s2d,
+        fused_stem=cfg.fused_stem,
     )
     # Total optimizer steps for cosine-style schedules: the globally-computed
     # per-epoch step count (identical on every host) x epochs.
@@ -810,6 +811,7 @@ def train(cfg: Config) -> TrainSummary:
                 path = checkpointer.save(
                     cfg.checkpoint_dir, epoch=epoch, state=state, loss=epoch_loss,
                     keep=cfg.keep_checkpoints,
+                    moments_bf16=cfg.ckpt_bf16_moments,
                 )
                 last_saved_epoch = epoch
                 if path:
@@ -880,6 +882,7 @@ def train(cfg: Config) -> TrainSummary:
                             cfg.checkpoint_dir, epoch=epoch, state=state,
                             loss=epoch_loss, keep=cfg.keep_checkpoints,
                             on_durable=_mark_best,
+                            moments_bf16=cfg.ckpt_bf16_moments,
                         )
                         last_saved_epoch = epoch
                         if best_path:
@@ -912,6 +915,7 @@ def train(cfg: Config) -> TrainSummary:
             path = checkpointer.save(
                 cfg.checkpoint_dir, epoch=completed, state=state, loss=epoch_loss,
                 keep=cfg.keep_checkpoints, dirty=stopped_mid_epoch,
+                moments_bf16=cfg.ckpt_bf16_moments,
             )
             if path:
                 summary.checkpoint_path = path

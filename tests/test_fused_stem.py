@@ -1,0 +1,151 @@
+"""Pin the fused stem kernel (ops/fused_stem.py) to the unfused XLA
+composition it replaces — values AND gradients, via the Pallas interpreter
+on CPU (the same kernel code path the TPU compiles).
+
+Reference semantics: ``max_pool3x3s2p1(relu(y·a + b))`` with f32 math
+(≙ the torchvision resnet stem tail, reference ``models.py:30-45``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_pytorch_tpu.ops.fused_stem import (
+    _reference_impl,
+    stem_affine_relu_pool,
+)
+
+B, H, W, C = 4, 16, 16, 64
+
+
+def _inputs(rng, tie_heavy=False, dtype=jnp.float32):
+    y = rng.standard_normal((B, H, W, C)).astype(np.float32)
+    if tie_heavy:
+        # Quantize hard so pool windows tie constantly (and relu produces
+        # exact-zero plateaus) — the select-and-scatter tie-break regime.
+        y = np.round(y * 2) / 2
+    a = (0.5 + rng.random(C)).astype(np.float32)
+    b = rng.standard_normal(C).astype(np.float32) * 0.1
+    return jnp.asarray(y, dtype), jnp.asarray(a), jnp.asarray(b)
+
+
+@pytest.mark.parametrize("tie_heavy", [False, True])
+def test_forward_matches_reference(rng, tie_heavy):
+    y, a, b = _inputs(rng, tie_heavy)
+    got = stem_affine_relu_pool(y, a, b, interpret=True)
+    want = _reference_impl(y, a, b)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("tie_heavy", [False, True])
+def test_gradients_match_reference(rng, tie_heavy):
+    y, a, b = _inputs(rng, tie_heavy)
+    co = jnp.asarray(rng.standard_normal((B, H // 2, W // 2, C)), jnp.float32)
+
+    def loss(fn):
+        return lambda y, a, b: jnp.sum(fn(y, a, b) * co)
+
+    gy, ga, gb = jax.grad(
+        loss(lambda y, a, b: stem_affine_relu_pool(y, a, b, interpret=True)),
+        argnums=(0, 1, 2),
+    )(y, a, b)
+    ry, ra, rb = jax.grad(loss(_reference_impl), argnums=(0, 1, 2))(y, a, b)
+    np.testing.assert_allclose(gy, ry, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(ga, ra, rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(gb, rb, rtol=1e-5, atol=1e-4)
+
+
+def test_bf16_storage_roundtrip(rng):
+    """Production dtype: bf16 in/out, f32 compute inside the kernel."""
+    y, a, b = _inputs(rng, dtype=jnp.bfloat16)
+    got = stem_affine_relu_pool(y, a, b, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    want = _reference_impl(y, a, b)
+    np.testing.assert_allclose(
+        got.astype(np.float32), want.astype(np.float32), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_shape_guards(rng):
+    y, a, b = _inputs(rng)
+    with pytest.raises(ValueError):
+        stem_affine_relu_pool(y[:, :15], a, b, interpret=True)
+    with pytest.raises(ValueError):
+        stem_affine_relu_pool(y, a[:3], b, interpret=True)
+
+
+def test_module_runs_kernel_under_env_gate(rng, monkeypatch):
+    """MPT_STEM_INTERPRET routes the module through the REAL kernel code
+    path (Pallas interpreter) instead of the XLA fallback — the gate the
+    whole-model CPU tests rely on."""
+    monkeypatch.setenv("MPT_STEM_INTERPRET", "1")
+    from mpi_pytorch_tpu.models.common import FusedStemBNReluPool
+
+    y = jnp.asarray(rng.standard_normal((B, H, W, C)), jnp.float32)
+    m = FusedStemBNReluPool()
+    v = m.init(jax.random.PRNGKey(0), y, True)
+    out, _ = m.apply(v, y, False, mutable=["batch_stats"])
+    monkeypatch.delenv("MPT_STEM_INTERPRET")
+    want = m.apply(v, y, False, mutable=["batch_stats"])[0]
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+def test_module_matches_unfused_stem(rng):
+    """FusedStemBNReluPool ≡ batch_norm → relu → max_pool(3,2,1): same
+    output, same batch_stats update, same eval-mode behavior, and the
+    SAME variable tree (checkpoints interchange)."""
+    from flax import linen as nn
+
+    from mpi_pytorch_tpu.models.common import (
+        FusedStemBNReluPool,
+        batch_norm,
+        max_pool,
+    )
+
+    class Unfused(nn.Module):
+        @nn.compact
+        def __call__(self, y, use_running_average):
+            z = batch_norm("bn1")(y, use_running_average=use_running_average)
+            return max_pool(nn.relu(z), 3, 2, padding=1)
+
+    class Fused(nn.Module):
+        @nn.compact
+        def __call__(self, y, use_running_average):
+            return FusedStemBNReluPool(name="bn1")(y, use_running_average)
+
+    y = jnp.asarray(rng.standard_normal((B, H, W, C)), jnp.float32)
+    uf, fu = Unfused(), Fused()
+    vu = uf.init(jax.random.PRNGKey(0), y, True)
+    vf = fu.init(jax.random.PRNGKey(0), y, True)
+    assert jax.tree.structure(vu) == jax.tree.structure(vf)
+
+    # Train mode: same output, same running-stat update (from shared params).
+    ou, su = uf.apply(vu, y, False, mutable=["batch_stats"])
+    of, sf = fu.apply(vu, y, False, mutable=["batch_stats"])
+    np.testing.assert_allclose(ou, of, rtol=1e-5, atol=1e-5)
+    jax.tree.map(
+        lambda x, z: np.testing.assert_allclose(x, z, rtol=1e-5, atol=1e-6),
+        su["batch_stats"], sf["batch_stats"],
+    )
+
+    # Eval mode: running stats drive both identically.
+    eu = uf.apply(vu, y, True)
+    ef = fu.apply(vu, y, True)
+    np.testing.assert_allclose(eu, ef, rtol=1e-5, atol=1e-5)
+
+    # Gradients through the module (params + input) agree.
+    def tloss(m):
+        def f(params, y):
+            out, _ = m.apply(
+                {"params": params, "batch_stats": vu["batch_stats"]},
+                y, False, mutable=["batch_stats"],
+            )
+            return jnp.sum(out * out)
+        return f
+
+    gu = jax.grad(tloss(uf), argnums=(0, 1))(vu["params"], y)
+    gf = jax.grad(tloss(fu), argnums=(0, 1))(vu["params"], y)
+    jax.tree.map(
+        lambda x, z: np.testing.assert_allclose(x, z, rtol=1e-4, atol=1e-4),
+        gu, gf,
+    )

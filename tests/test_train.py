@@ -145,6 +145,106 @@ def test_async_checkpointer_roundtrip(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_bf16_moments_checkpoint_roundtrip(tmp_path):
+    """moments_bf16 snapshots: params restore EXACTLY, big moment tensors
+    restore as f32 values quantized to bf16, small/integer optimizer leaves
+    (Adam count) stay exact, and the file actually shrinks."""
+    import jax.numpy as jnp
+    import optax
+    from flax import linen as nn
+
+    from mpi_pytorch_tpu import checkpoint as ckpt
+    from mpi_pytorch_tpu.train.state import TrainState
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(4)(nn.Dense(2048)(x))
+
+    model = M()
+
+    def fresh(seed):
+        return TrainState.create(
+            apply_fn=model.apply,
+            variables=model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 8))),
+            tx=optax.adam(1e-3), rng=jax.random.PRNGKey(seed + 1),
+        )
+
+    state = fresh(0)
+    # Take one real optimizer step so the moments are non-zero (a zero
+    # moment would trivially be bf16-exact and prove nothing).
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.asarray(np.random.default_rng(0).normal(size=p.shape), p.dtype),
+        state.params,
+    )
+
+    def step(st, grads):
+        updates, opt_state = st.tx.update(grads, st.opt_state, st.params)
+        return st.replace(
+            step=st.step + 1,
+            params=optax.apply_updates(st.params, updates),
+            opt_state=opt_state,
+        )
+
+    state = step(state, grads)
+
+    cp = ckpt.AsyncCheckpointer()
+    exact = cp.save(str(tmp_path / "exact"), epoch=0, state=state, loss=1.0)
+    cp.wait()
+    lossy = cp.save(
+        str(tmp_path / "bf16"), epoch=0, state=state, loss=1.0, moments_bf16=True
+    )
+    cp.wait()
+    assert os.path.getsize(lossy) < 0.75 * os.path.getsize(exact)
+
+    restored, _, _ = ckpt.load_checkpoint(lossy, fresh(9))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(restored.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Restored moments: f32 dtype (the optimizer's), values == bf16(quantized).
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.opt_state),
+        jax.tree_util.tree_leaves(restored.opt_state),
+    ):
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.dtype == b.dtype, (a.dtype, b.dtype)
+        if a.dtype == np.float32 and a.size >= 4096:
+            np.testing.assert_array_equal(
+                a.astype(jnp.bfloat16).astype(np.float32), b
+            )
+        else:  # count / small leaves: exact
+            np.testing.assert_array_equal(a, b)
+    # The restored state steps (dtype-clean for the optimizer).
+    step(restored, grads)
+
+
+def test_chunked_device_get_matches_whole_tree():
+    """The background writer's chunked D2H (big leaves split along axis 0)
+    reassembles exactly the array a monolithic device_get returns."""
+    import jax.numpy as jnp
+
+    from mpi_pytorch_tpu import checkpoint as ckpt
+
+    rng = np.random.default_rng(3)
+    big = jnp.asarray(
+        rng.normal(size=(4096 + 37, 32 * 1024 // 4)).astype(np.float32)
+    )  # ~0.5 GB/chunk-size ratio >1 with an uneven tail row count
+    small = jnp.asarray(rng.normal(size=(8, 8)).astype(np.float32))
+    scalar = jnp.asarray(3, jnp.int32)
+    tree = {"a": big, "b": small, "c": scalar}
+    old = ckpt._D2H_CHUNK_BYTES
+    ckpt._D2H_CHUNK_BYTES = 1024 * 1024  # force the split path
+    try:
+        got = ckpt._chunked_device_get(tree)
+    finally:
+        ckpt._D2H_CHUNK_BYTES = old
+    want = jax.device_get(tree)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(got[k]), np.asarray(want[k]))
+
+
 def test_dirty_checkpoint_marker_and_resume_warning(tmp_path):
     """A mid-epoch preemption save is marked dirty (sidecar): resume warns
     that the replayed epoch double-applies the partial epoch's updates, a

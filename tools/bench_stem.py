@@ -1,0 +1,54 @@
+"""Microbench: fused stem kernel vs XLA composition, headline shape, on chip."""
+import time, functools
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp
+import numpy as np
+
+from mpi_pytorch_tpu.ops.fused_stem import stem_affine_relu_pool, _reference_impl
+
+B, H, W, C = 2048, 64, 64, 64
+key = jax.random.PRNGKey(0)
+y = jax.random.normal(key, (B, H, W, C), jnp.bfloat16)
+a = jnp.abs(jax.random.normal(key, (C,), jnp.float32)) + 0.5
+b = jax.random.normal(key, (C,), jnp.float32) * 0.1
+co = jax.random.normal(key, (B, H//2, W//2, C), jnp.bfloat16)
+
+def make(fn):
+    @jax.jit
+    def fwd(y, a, b):
+        return fn(y, a, b)
+    @jax.jit
+    def fwdbwd(y, a, b, co):
+        l, grads = jax.value_and_grad(
+            lambda y, a, b: jnp.sum((fn(y, a, b) * co).astype(jnp.float32)),
+            argnums=(0, 1, 2))(y, a, b)
+        return l, grads
+    return fwd, fwdbwd
+
+def timeit(f, *args, n=30):
+    r = f(*args)
+    jax.block_until_ready(r)
+    # value-fetch barrier (docs/RESULTS.md 4c: block_until_ready can lie here)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = f(*args)
+    leaf = jax.tree.leaves(r)[0]
+    _ = float(jnp.sum(leaf.astype(jnp.float32)))
+    return (time.perf_counter() - t0) / n * 1000
+
+ref_fwd, ref_fb = make(lambda y,a,b: _reference_impl(y,a,b))
+fus_fwd, fus_fb = make(lambda y,a,b: stem_affine_relu_pool(y,a,b))
+
+# correctness on chip first
+rf = ref_fwd(y,a,b); ff = fus_fwd(y,a,b)
+np.testing.assert_allclose(np.asarray(rf, np.float32), np.asarray(ff, np.float32), rtol=2e-2, atol=2e-2)
+_, gr = ref_fb(y,a,b,co); _, gf = fus_fb(y,a,b,co)
+for u, v, name in [(gr[0], gf[0], "dy"), (gr[1], gf[1], "da"), (gr[2], gf[2], "db")]:
+    np.testing.assert_allclose(np.asarray(u, np.float32), np.asarray(v, np.float32), rtol=3e-2, atol=3e-1)
+print("on-chip correctness OK")
+
+print(f"ref  fwd: {timeit(ref_fwd, y, a, b):8.3f} ms")
+print(f"fused fwd: {timeit(fus_fwd, y, a, b):8.3f} ms")
+print(f"ref  fwd+bwd: {timeit(ref_fb, y, a, b, co):8.3f} ms")
+print(f"fused fwd+bwd: {timeit(fus_fb, y, a, b, co):8.3f} ms")

@@ -57,6 +57,7 @@ ZOO = {
 def build_state_and_batch(
     model_name: str, batch_per_chip: int, image: int, optimizer: bool = True,
     remat_blocks: bool = False, attn_impl: str = "full", stem_s2d: bool = False,
+    fused_stem: bool | None = None,
 ):
     """Shared harness setup (also used by tools/bench_eval.py and
     tools/profile_step.py): mesh, placed train state, and a random sharded
@@ -73,10 +74,16 @@ def build_state_and_batch(
     n_chips = jax.device_count()
     batch = batch_per_chip * n_chips
     mesh = create_mesh(Config().mesh)
+    if fused_stem is None:
+        # Same contract as bench.py: the fused stem is the headline resnet
+        # configuration on TPU; MPT_FUSED_STEM=0 reverts for A/B.
+        from mpi_pytorch_tpu.models.registry import fused_stem_default
+
+        fused_stem = fused_stem_default(model_name)
     bundle, variables = create_model_bundle(
         model_name, NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=image,
         dtype=jnp.bfloat16, param_dtype=jnp.float32, remat_blocks=remat_blocks,
-        attn_impl=attn_impl, stem_s2d=stem_s2d,
+        attn_impl=attn_impl, stem_s2d=stem_s2d, fused_stem=fused_stem,
     )
     state = TrainState.create(
         apply_fn=bundle.model.apply, variables=variables,
