@@ -156,6 +156,13 @@ class Config:
     # (Pallas block-tiled online-softmax kernel on TPU, identical-math
     # fallback on other backends — ops/flash_attention.py).
     attn_impl: str = "full"
+    # Fuse the q/k/v projections into one [D, 3·H·Dh] matmul (vit family;
+    # same param tree, exactly the same math — models/vit.py qkv_fused).
+    qkv_fused: bool = False
+    # Predictions pass: stream the head weights through VMEM computing
+    # loss+argmax online instead of materializing [B, num_classes] logits
+    # (ops/fused_head_ce.head_predict; TPU only, XLA path elsewhere).
+    fused_head_eval: bool = False
     # Expert parallelism for MoE models (vit_moe_s16): shard the experts
     # over all devices on an ("expert", "_") mesh; tokens travel by
     # all_to_all (ops/moe.py). MoE models only.

@@ -140,6 +140,7 @@ def initialize_model(
     attn_impl: str = "full",
     stem_s2d: bool = False,
     fused_stem: bool = False,
+    qkv_fused: bool = False,
 ) -> tuple[nn.Module, int]:
     """Reference-parity signature (``models.py:16``): returns (model, input_size)."""
     if model_name not in _REGISTRY:
@@ -158,6 +159,13 @@ def initialize_model(
                 "attention"
             )
         kw["attn_impl"] = attn_impl
+    if qkv_fused:
+        if model_name not in SP_MODELS:
+            raise ValueError(
+                f"qkv_fused applies only to the attention family "
+                f"({', '.join(SP_MODELS)}); {model_name!r} has no attention"
+            )
+        kw["qkv_fused"] = True
     if sp_strategy != "none":
         if model_name not in SP_MODELS:
             raise ValueError(
@@ -244,6 +252,7 @@ def create_model_bundle(
     attn_impl: str = "full",
     stem_s2d: bool = False,
     fused_stem: bool = False,
+    qkv_fused: bool = False,
 ) -> tuple[ModelBundle, dict]:
     """Full-fat factory: returns the bundle plus initialized variables."""
     model, canonical = initialize_model(
@@ -251,7 +260,7 @@ def create_model_bundle(
         dtype=dtype, param_dtype=param_dtype, bn_axis_name=bn_axis_name,
         remat_blocks=remat_blocks, sp_strategy=sp_strategy, sp_mesh=sp_mesh,
         ep_mesh=ep_mesh, attn_impl=attn_impl, stem_s2d=stem_s2d,
-        fused_stem=fused_stem,
+        fused_stem=fused_stem, qkv_fused=qkv_fused,
     )
     size = image_size or (299 if model_name == "inception_v3" else 128)
     rng = rng if rng is not None else jax.random.PRNGKey(0)

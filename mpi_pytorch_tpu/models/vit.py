@@ -30,6 +30,40 @@ from jax import nn as jnn
 from mpi_pytorch_tpu.models.common import Dtype
 
 
+class _ProjParams(nn.Module):
+    """Parameter-only twin of ``nn.DenseGeneral((H, Dh))``: declares the
+    SAME variable tree (``<name>/kernel`` [in, H, Dh] lecun-normal,
+    ``<name>/bias`` [H, Dh] zeros — flax folds the init RNG by module
+    path, so even the initial values match), without computing anything.
+    Lets the fused-QKV path own the matmul while checkpoints remain
+    interchangeable with the three-DenseGeneral layout."""
+
+    features: tuple[int, int]
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        import numpy as np
+
+        def kernel_init(rng, shape, dtype):
+            # DenseGeneral initializes the kernel in FLATTENED 2-D form
+            # (fan-in = in_features, fan-out = prod(features)) and then
+            # reshapes — calling lecun-normal on the 3-D shape directly
+            # would compute fan-in from the wrong axis.
+            flat = nn.linear.default_kernel_init(
+                rng, (in_features, int(np.prod(self.features))), dtype
+            )
+            return flat.reshape(shape)
+
+        kernel = self.param(
+            "kernel", kernel_init, (in_features,) + self.features, self.param_dtype
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), self.features, self.param_dtype
+        )
+        return kernel, bias
+
+
 class MultiHeadAttention(nn.Module):
     """MHA whose core attention is pluggable: ``sp_strategy`` of ``none``
     (single-device attention — vanilla ``full`` or the Pallas ``flash``
@@ -45,6 +79,11 @@ class MultiHeadAttention(nn.Module):
     # through VMEM with an online softmax (ops/flash_attention.py — Pallas
     # on TPU, identical-math fallback elsewhere). Same function either way.
     attn_impl: str = "full"
+    # One [D, 3·H·Dh] projection matmul instead of three [D, H·Dh] ones:
+    # x is read once, one MXU dispatch, same param tree (docs/RESULTS.md
+    # §4 vit_s16 row). Identical math — the concatenated matmul computes
+    # each output column independently.
+    qkv_fused: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -59,11 +98,28 @@ class MultiHeadAttention(nn.Module):
         if hidden % self.num_heads:
             raise ValueError(f"hidden {hidden} not divisible by {self.num_heads} heads")
         head_dim = hidden // self.num_heads
-        proj = lambda name: nn.DenseGeneral(
-            (self.num_heads, head_dim), dtype=self.dtype,
-            param_dtype=self.param_dtype, name=name,
-        )
-        q, k, v = proj("q")(x), proj("k")(x), proj("v")(x)
+        if self.qkv_fused:
+            shapes = (self.num_heads, head_dim)
+            wq, bq = _ProjParams(shapes, self.param_dtype, name="q")(hidden)
+            wk, bk = _ProjParams(shapes, self.param_dtype, name="k")(hidden)
+            wv, bv = _ProjParams(shapes, self.param_dtype, name="v")(hidden)
+            wqkv = jnp.concatenate(
+                [w.reshape(hidden, -1) for w in (wq, wk, wv)], axis=1
+            ).astype(self.dtype)
+            bqkv = jnp.concatenate(
+                [b.reshape(-1) for b in (bq, bk, bv)]
+            ).astype(self.dtype)
+            fused = x.astype(self.dtype) @ wqkv + bqkv  # [B, S, 3·H·Dh]
+            q, k, v = (
+                part.reshape(x.shape[:-1] + (self.num_heads, head_dim))
+                for part in jnp.split(fused, 3, axis=-1)
+            )
+        else:
+            proj = lambda name: nn.DenseGeneral(
+                (self.num_heads, head_dim), dtype=self.dtype,
+                param_dtype=self.param_dtype, name=name,
+            )
+            q, k, v = proj("q")(x), proj("k")(x), proj("v")(x)
         if self.sp_strategy == "none":
             if self.attn_impl == "flash":
                 out = flash_attention(q, k, v)
@@ -170,6 +226,7 @@ class EncoderBlock(nn.Module):
     sp_strategy: str = "none"
     sp_mesh: Any = None
     attn_impl: str = "full"
+    qkv_fused: bool = False
     num_experts: int = 0
     moe_k: int = 2
     moe_capacity: int | None = None
@@ -184,7 +241,8 @@ class EncoderBlock(nn.Module):
         y = MultiHeadAttention(
             num_heads=self.num_heads, dtype=self.dtype,
             param_dtype=self.param_dtype, sp_strategy=self.sp_strategy,
-            sp_mesh=self.sp_mesh, attn_impl=self.attn_impl, name="attn",
+            sp_mesh=self.sp_mesh, attn_impl=self.attn_impl,
+            qkv_fused=self.qkv_fused, name="attn",
         )(ln("ln1")(x))
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -228,6 +286,7 @@ class VisionTransformer(nn.Module):
     sp_strategy: str = "none"
     sp_mesh: Any = None
     attn_impl: str = "full"
+    qkv_fused: bool = False
     # MoE: every `moe_every`-th block (0-indexed blocks moe_every-1,
     # 2·moe_every-1, ...; =2 → the odd blocks) swaps its dense MLP for a
     # `num_experts`-expert MoE. 0 disables.
@@ -270,6 +329,7 @@ class VisionTransformer(nn.Module):
                 dropout=self.dropout, dtype=self.dtype,
                 param_dtype=self.param_dtype, sp_strategy=self.sp_strategy,
                 sp_mesh=self.sp_mesh, attn_impl=self.attn_impl,
+                qkv_fused=self.qkv_fused,
                 num_experts=self.num_experts if is_moe else 0,
                 moe_k=self.moe_k, moe_capacity=self.moe_capacity,
                 moe_group_size=self.moe_group_size,

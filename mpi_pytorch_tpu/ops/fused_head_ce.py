@@ -245,6 +245,133 @@ def _bwd_rule(interpret, residuals, g):
 _fused_head_ce.defvjp(_fwd_rule, _bwd_rule)
 
 
+def _predict_kernel(
+    labels_ref, feats_ref, w_ref, b_ref,
+    loss_ref, pred_ref, m_ref, l_ref, picked_ref, arg_ref,
+):
+    """Inference sibling of ``_fwd_kernel``: same online softmax, plus a
+    running ARGMAX (the predictions-pass output) — so eval accuracy, loss,
+    and per-image predictions all come out of one pass that never
+    materializes [B, V]. Grid: (num_v_blocks,); m/l/picked/arg alias one
+    block across the sequential grid as accumulators."""
+    j = pl.program_id(0)
+    feats = feats_ref[...]  # [B, D] bf16
+    w = w_ref[...]  # [D, BV] bf16
+    logits = lax.dot_general(
+        feats, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + b_ref[...].astype(jnp.float32)  # [B, BV] f32
+    b_rows, bv = logits.shape
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        picked_ref[...] = jnp.zeros_like(picked_ref)
+        arg_ref[...] = jnp.zeros_like(arg_ref)
+
+    block_max = jnp.max(logits, axis=1, keepdims=True)  # [B, 1]
+    # First column attaining the block max — jnp.argmax's tie convention.
+    # All-f32 arithmetic: an int32 min-reduce in this kernel crashes the
+    # TPU compile helper; vocab indices are exact in f32 up to 2^24.
+    cols_f = lax.broadcasted_iota(jnp.int32, (b_rows, bv), 1).astype(jnp.float32)
+    first_hit = jnp.min(
+        jnp.where(logits == block_max, cols_f, float(bv)), axis=1, keepdims=True
+    )
+    m_prev = m_ref[...]
+    # Strict >: on a cross-block tie the EARLIER block keeps the argmax,
+    # matching argmax over the concatenated vocab.
+    better = block_max > m_prev
+    arg_ref[...] = jnp.where(better, j * bv + first_hit, arg_ref[...])
+    m_new = jnp.maximum(m_prev, block_max)
+    l_ref[...] = l_ref[...] * jnp.exp(m_prev - m_new) + jnp.sum(
+        jnp.exp(logits - m_new), axis=1, keepdims=True
+    )
+    m_ref[...] = m_new
+
+    labels = labels_ref[...]  # [B, 1] int32
+    local = labels - j * bv
+    cols = lax.broadcasted_iota(jnp.int32, (b_rows, bv), 1)  # label hit only
+    hit = cols == local
+    picked_ref[...] += jnp.sum(jnp.where(hit, logits, 0.0), axis=1, keepdims=True)
+
+    @pl.when(j == pl.num_programs(0) - 1)
+    def _finish():
+        valid = labels >= 0
+        loss = jnp.log(l_ref[...]) + m_ref[...] - picked_ref[...]
+        loss_ref[...] = jnp.where(valid, loss, 0.0)
+        pred_ref[...] = arg_ref[...]
+
+
+def head_predict_reference(feats, w, b, labels):
+    """Plain-XLA reference/fallback: explicit logits, CE + argmax."""
+    logits = (feats.astype(jnp.float32) @ w.astype(jnp.float32)) + b.astype(jnp.float32)
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return head_ce_reference(feats, w, b, labels), preds
+
+
+def head_predict(
+    feats: jnp.ndarray,
+    w: jnp.ndarray,
+    b: jnp.ndarray,
+    labels: jnp.ndarray,
+    interpret: bool | None = None,
+    kernel_rows: int | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(per-example CE [B] f32, argmax predictions [B] int32) of
+    ``softmax(feats @ w + b)`` without materializing [B, V] — the
+    inference pass of the reference's predictor ranks
+    (``evaluation_pipeline.py:149-158``) as one VMEM-streaming kernel.
+    Forward-only (no VJP): the predictions path never backpropagates.
+
+    Argmax note: logits are computed bf16×bf16→f32 (the production head's
+    dtype); near-ties within bf16 rounding can pick a different index
+    than an f32-matmul argmax would — same caveat as the XLA bf16 head
+    (models/resnet.py head dtype note).
+    """
+    if interpret is None:
+        from mpi_pytorch_tpu.utils.hardware import tpu_backend
+
+        if not tpu_backend():
+            return head_predict_reference(feats, w, b, labels)
+        interpret = False
+    if (kernel_rows or feats.shape[0]) > 1024 and not interpret:
+        # Envelope (measured): at 4096 rows the [rows, BLOCK_V] f32 logits
+        # block exceeds the scoped-VMEM budget and the TPU compile rejects;
+        # larger batches take the XLA path rather than failing. Under a
+        # partitioned multi-chip call, pass ``kernel_rows`` = the PER-CHIP
+        # row count (feats.shape[0] is the global batch inside jit).
+        return head_predict_reference(feats, w, b, labels)
+    labels = labels.astype(jnp.int32)
+    wp, bp, v = _pad_wb(w, b, _BLOCK_V)
+    bsz, d = feats.shape
+    grid = wp.shape[1] // _BLOCK_V
+    loss, pred, *_ = pl.pallas_call(
+        _predict_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((bsz, 1), lambda j: (0, 0)),  # labels
+            pl.BlockSpec((bsz, d), lambda j: (0, 0)),  # feats (resident)
+            pl.BlockSpec((d, _BLOCK_V), lambda j: (0, j)),  # W block
+            pl.BlockSpec((1, _BLOCK_V), lambda j: (0, j)),  # bias block
+        ],
+        out_specs=[
+            pl.BlockSpec((bsz, 1), lambda j: (0, 0)),  # loss
+            pl.BlockSpec((bsz, 1), lambda j: (0, 0)),  # pred
+            pl.BlockSpec((bsz, 1), lambda j: (0, 0)),  # m
+            pl.BlockSpec((bsz, 1), lambda j: (0, 0)),  # l
+            pl.BlockSpec((bsz, 1), lambda j: (0, 0)),  # picked
+            pl.BlockSpec((bsz, 1), lambda j: (0, 0)),  # arg
+        ],
+        out_shape=[jax.ShapeDtypeStruct((bsz, 1), jnp.float32)] * 6,
+        interpret=interpret,
+    )(
+        labels.reshape(bsz, 1), feats.astype(jnp.bfloat16), wp,
+        bp.reshape(1, -1),
+    )
+    return loss[:, 0], pred[:, 0].astype(jnp.int32)
+
+
 def head_ce_reference(feats, w, b, labels) -> jnp.ndarray:
     """Plain-XLA reference/fallback: explicit logits + fused-by-XLA CE."""
     import optax

@@ -203,6 +203,7 @@ def build_training(cfg: Config, mesh=None):
         sp_mesh=flat_mesh(mesh, "seq") if cfg.sp_strategy != "none" else None,
         ep_mesh=flat_mesh(mesh, "expert") if cfg.expert_parallel else None,
         attn_impl=cfg.attn_impl,
+        qkv_fused=cfg.qkv_fused,
         stem_s2d=cfg.stem_s2d,
         fused_stem=cfg.fused_stem,
     )

@@ -73,3 +73,106 @@ def test_weighted_upstream_gradient():
     gf = jax.grad(weighted)(feats)
     rf = jax.grad(weighted_ref)(feats)
     np.testing.assert_allclose(np.asarray(gf), np.asarray(rf), rtol=2e-2, atol=2e-3)
+
+
+def test_head_predict_matches_reference():
+    """The inference sibling: per-example loss AND argmax predictions from
+    one streaming pass — vs explicit-logits CE + argmax."""
+    from mpi_pytorch_tpu.ops.fused_head_ce import (
+        head_predict,
+        head_predict_reference,
+    )
+
+    feats, w, b, labels = _inputs()
+    loss, preds = head_predict(feats, w, b, labels, interpret=True)
+    ref_loss, ref_preds = head_predict_reference(feats, w, b, labels)
+    np.testing.assert_allclose(
+        np.asarray(loss), np.asarray(ref_loss), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_array_equal(np.asarray(preds), np.asarray(ref_preds))
+    assert preds.dtype == jnp.int32
+    assert float(loss[3]) == 0.0 and float(loss[11]) == 0.0  # padding rows
+
+
+def test_head_predict_cross_block_tie_prefers_first():
+    """An exact tie across vocab blocks must resolve to the LOWER index —
+    jnp.argmax's convention over the concatenated vocab."""
+    from mpi_pytorch_tpu.ops.fused_head_ce import head_predict
+
+    feats = jnp.ones((2, 8), jnp.float32)
+    v = 5000
+    w = jnp.zeros((8, v), jnp.float32)
+    b = np.zeros((v,), np.float32)
+    b[100] = 7.0   # block 0
+    b[4000] = 7.0  # block 1, exact same logit
+    _, preds = head_predict(feats, w, jnp.asarray(b), jnp.zeros((2,), jnp.int32),
+                            interpret=True)
+    np.testing.assert_array_equal(np.asarray(preds), [100, 100])
+
+
+def test_fused_head_predict_step_matches_plain(tmp_path):
+    """The eval driver's fused-head predict step (interceptor + streamed
+    head) returns the same metrics and predictions as the plain
+    logits-materializing step, through a real zoo model."""
+    from jax.sharding import Mesh
+
+    from mpi_pytorch_tpu.evaluate import _make_predict_step
+    from mpi_pytorch_tpu.models import create_model_bundle
+    from mpi_pytorch_tpu.train.state import TrainState
+
+    import optax
+
+    bundle, variables = create_model_bundle(
+        "resnet18", 200, rng=jax.random.PRNGKey(0), image_size=32
+    )
+    state = TrainState.create(
+        apply_fn=bundle.model.apply, variables=variables,
+        tx=optax.identity(), rng=jax.random.PRNGKey(1),
+    )
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("data", "model"))
+    images = np.random.default_rng(0).normal(size=(8, 32, 32, 3)).astype(np.float32)
+    labels = np.asarray([3, 5, -1, 9, 0, 1, -1, 7], np.int32)
+    batch = (jnp.asarray(images), jnp.asarray(labels))
+
+    plain = _make_predict_step(mesh, jnp.float32)
+    fused = _make_predict_step(mesh, jnp.float32, fused_head=True)
+    m1, p1 = plain(state, batch)
+    m2, p2 = fused(state, batch)
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+    for k in ("loss", "correct", "count"):
+        np.testing.assert_allclose(
+            float(m1[k]), float(m2[k]), rtol=1e-4, atol=1e-4
+        )
+
+
+def test_fused_head_predict_step_falls_back_for_conv_head(tmp_path):
+    """squeezenet's classifier is an nn.Conv named 'head' (and not the last
+    op) — the interceptor must not fire, and the step must return the plain
+    path's results instead of failing."""
+    from jax.sharding import Mesh
+
+    import optax
+
+    from mpi_pytorch_tpu.evaluate import _make_predict_step
+    from mpi_pytorch_tpu.models import create_model_bundle
+    from mpi_pytorch_tpu.train.state import TrainState
+
+    bundle, variables = create_model_bundle(
+        "squeezenet1_0", 50, rng=jax.random.PRNGKey(0), image_size=32
+    )
+    state = TrainState.create(
+        apply_fn=bundle.model.apply, variables=variables,
+        tx=optax.identity(), rng=jax.random.PRNGKey(1),
+    )
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("data", "model"))
+    images = np.random.default_rng(0).normal(size=(4, 32, 32, 3)).astype(np.float32)
+    labels = np.asarray([3, -1, 9, 0], np.int32)
+    batch = (jnp.asarray(images), jnp.asarray(labels))
+
+    plain = _make_predict_step(mesh, jnp.float32)
+    fused = _make_predict_step(mesh, jnp.float32, fused_head=True)
+    m1, p1 = plain(state, batch)
+    m2, p2 = fused(state, batch)
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+    for k in ("loss", "correct", "count"):
+        np.testing.assert_allclose(float(m1[k]), float(m2[k]), rtol=1e-5, atol=1e-5)

@@ -220,31 +220,6 @@ def test_bf16_moments_checkpoint_roundtrip(tmp_path):
     step(restored, grads)
 
 
-def test_chunked_device_get_matches_whole_tree():
-    """The background writer's chunked D2H (big leaves split along axis 0)
-    reassembles exactly the array a monolithic device_get returns."""
-    import jax.numpy as jnp
-
-    from mpi_pytorch_tpu import checkpoint as ckpt
-
-    rng = np.random.default_rng(3)
-    big = jnp.asarray(
-        rng.normal(size=(4096 + 37, 32 * 1024 // 4)).astype(np.float32)
-    )  # ~0.5 GB/chunk-size ratio >1 with an uneven tail row count
-    small = jnp.asarray(rng.normal(size=(8, 8)).astype(np.float32))
-    scalar = jnp.asarray(3, jnp.int32)
-    tree = {"a": big, "b": small, "c": scalar}
-    old = ckpt._D2H_CHUNK_BYTES
-    ckpt._D2H_CHUNK_BYTES = 1024 * 1024  # force the split path
-    try:
-        got = ckpt._chunked_device_get(tree)
-    finally:
-        ckpt._D2H_CHUNK_BYTES = old
-    want = jax.device_get(tree)
-    for k in tree:
-        np.testing.assert_array_equal(np.asarray(got[k]), np.asarray(want[k]))
-
-
 def test_dirty_checkpoint_marker_and_resume_warning(tmp_path):
     """A mid-epoch preemption save is marked dirty (sidecar): resume warns
     that the replayed epoch double-applies the partial epoch's updates, a

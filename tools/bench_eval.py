@@ -74,6 +74,64 @@ def bench_eval(model_name: str, batch_per_chip: int, image: int, steps: int, war
     return rec
 
 
+def bench_head(batch: int, d: int, steps: int, warmup: int):
+    """A/B of the PREDICTIONS-PASS head stage in isolation (the eval path's
+    [B, 64 500] logits question — VERDICT r4 item 5): the XLA composition
+    (bf16 matmul → pinned-f32 logits → CE + argmax, what
+    evaluate._make_predict_step runs today) vs ``ops.fused_head_ce.
+    head_predict`` (one VMEM-streaming kernel, no [B, V] tensor). Chained
+    on-device accumulator barrier, same as bench_eval."""
+    import numpy as np
+
+    from mpi_pytorch_tpu.ops.fused_head_ce import head_predict
+    from mpi_pytorch_tpu.train.step import metrics_from_logits
+
+    rng = np.random.default_rng(0)
+    feats = jnp.asarray(rng.normal(size=(batch, d)), jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(d, NUM_CLASSES)) * 0.05, jnp.float32)
+    b = jnp.asarray(rng.normal(size=(NUM_CLASSES,)) * 0.1, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(batch,)), jnp.int32)
+
+    from jax import lax
+
+    # w/b travel as ARGUMENTS: a 132 MB closure constant gets baked into
+    # the remote-compile request body, which the relay rejects (HTTP
+    # 413/500 — same failure mode as bench_stem's first version).
+    @jax.jit
+    def xla_head(feats, labels, w, b):
+        logits = feats @ w.astype(jnp.bfloat16) + b.astype(jnp.bfloat16)
+        logits = lax.optimization_barrier(logits.astype(jnp.float32))
+        m = metrics_from_logits(logits, labels)
+        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return m["loss"] + jnp.sum(preds)
+
+    @jax.jit
+    def fused_head(feats, labels, w, b):
+        loss, preds = head_predict(feats, w, b, labels)
+        return jnp.sum(loss) + jnp.sum(preds)
+
+    out = []
+    fused_label = "fused" if batch <= 1024 else "fused(>envelope: xla fallback)"
+    for label, fn in (("xla", xla_head), (fused_label, fused_head)):
+        add = jax.jit(lambda acc, v: acc + v)
+        acc = jnp.zeros((), jnp.float32)
+        for _ in range(warmup + 1):
+            acc = add(acc, fn(feats, labels, w, b))
+        float(acc)  # value fetch: block_until_ready lies here (§4c)
+        acc = jnp.zeros((), jnp.float32)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            acc = add(acc, fn(feats, labels, w, b))
+        float(acc)  # a fetched value cannot be fabricated
+        dt = time.perf_counter() - t0
+        out.append({
+            "metric": f"predictions head ms (B={batch}, D={d}, V={NUM_CLASSES})",
+            "head": label,
+            "step_ms": round(dt / steps * 1e3, 3),
+        })
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet18")
@@ -81,9 +139,16 @@ def main() -> None:
     ap.add_argument("--batches", default="256,1024,4096")
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--head", action="store_true",
+                    help="A/B the isolated predictions-pass head stage "
+                    "(XLA vs ops.fused_head_ce.head_predict) per batch size")
     args = ap.parse_args()
     for b in (x.strip() for x in args.batches.split(",") if x.strip()):
         try:
+            if args.head:
+                for rec in bench_head(int(b), 512, args.steps, args.warmup):
+                    print(json.dumps(rec), flush=True)
+                continue
             rec = bench_eval(args.model, int(b), args.image, args.steps, args.warmup)
         except Exception as e:
             rec = {"model": args.model, "batch_per_chip": int(b),

@@ -57,7 +57,7 @@ ZOO = {
 def build_state_and_batch(
     model_name: str, batch_per_chip: int, image: int, optimizer: bool = True,
     remat_blocks: bool = False, attn_impl: str = "full", stem_s2d: bool = False,
-    fused_stem: bool | None = None,
+    fused_stem: bool | None = None, qkv_fused: bool = False,
 ):
     """Shared harness setup (also used by tools/bench_eval.py and
     tools/profile_step.py): mesh, placed train state, and a random sharded
@@ -84,6 +84,7 @@ def build_state_and_batch(
         model_name, NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=image,
         dtype=jnp.bfloat16, param_dtype=jnp.float32, remat_blocks=remat_blocks,
         attn_impl=attn_impl, stem_s2d=stem_s2d, fused_stem=fused_stem,
+        qkv_fused=qkv_fused,
     )
     state = TrainState.create(
         apply_fn=bundle.model.apply, variables=variables,
@@ -122,13 +123,14 @@ def timed_train_steps(compiled, state, device_batch, steps, warmup, trace_dir=""
 
 
 def bench_one(model_name: str, batch_per_chip: int, image: int, steps: int,
-              warmup: int, attn_impl: str = "full", stem_s2d: bool = False):
+              warmup: int, attn_impl: str = "full", stem_s2d: bool = False,
+              qkv_fused: bool = False):
     from mpi_pytorch_tpu.train.step import make_train_step
     from mpi_pytorch_tpu.utils.hardware import peak_bf16_tflops, step_flops
 
     mesh, state, device_batch, n_chips, batch = build_state_and_batch(
         model_name, batch_per_chip, image, attn_impl=attn_impl,
-        stem_s2d=stem_s2d,
+        stem_s2d=stem_s2d, qkv_fused=qkv_fused,
     )
     step = make_train_step(jnp.bfloat16)
 
@@ -161,13 +163,16 @@ def bench_one(model_name: str, batch_per_chip: int, image: int, steps: int,
         rec["attn_impl"] = attn_impl
     if stem_s2d:
         rec["stem_s2d"] = True
+    if qkv_fused:
+        rec["qkv_fused"] = True
     if peak and flops_per_step > 0:
         rec["mfu_pct"] = round(100.0 * tflops_per_chip / peak, 1)
     return rec
 
 
 def bench_one_in_child(name: str, steps: int, warmup: int, timeout_s: int,
-                       attn_impl: str = "full", stem_s2d: bool = False) -> dict:
+                       attn_impl: str = "full", stem_s2d: bool = False,
+                       qkv_fused: bool = False) -> dict:
     """Run one model's bench in a fresh child interpreter with a hard
     timeout. A wedged TPU relay blocks inside a compile/execute RPC that no
     in-process watchdog can interrupt (observed: a full-sweep hang with zero
@@ -181,7 +186,8 @@ def bench_one_in_child(name: str, steps: int, warmup: int, timeout_s: int,
         sys.executable, os.path.abspath(__file__), "--in-process",
         "--models", name, "--steps", str(steps), "--warmup", str(warmup),
         "--attn-impl", attn_impl,
-    ] + (["--stem-s2d"] if stem_s2d else [])
+    ] + (["--stem-s2d"] if stem_s2d else []) + (
+        ["--qkv-fused"] if qkv_fused else [])
     try:
         proc = subprocess.run(
             cmd, cwd=repo, capture_output=True, text=True, timeout=timeout_s
@@ -202,6 +208,8 @@ def main() -> None:
     ap.add_argument("--attn-impl", default="full", choices=["full", "flash"],
                     help="vit family only: dense-attention implementation")
     ap.add_argument("--models", default=",".join(ZOO), help="comma-separated subset")
+    ap.add_argument("--qkv-fused", action="store_true",
+                    help="fuse q/k/v projections into one matmul (vit family)")
     ap.add_argument("--stem-s2d", action="store_true",
                     help="resnet family only: space-to-depth stem conv")
     ap.add_argument("--out", default="", help="also write a JSON array to this path")
@@ -219,11 +227,13 @@ def main() -> None:
             batch, image = ZOO[name]  # inside try: a typo'd name must not
             if args.in_process:  # kill the sweep or discard --out
                 rec = bench_one(name, batch, image, args.steps, args.warmup,
-                                attn_impl=args.attn_impl, stem_s2d=args.stem_s2d)
+                                attn_impl=args.attn_impl, stem_s2d=args.stem_s2d,
+                                qkv_fused=args.qkv_fused)
             else:
                 rec = bench_one_in_child(
                     name, args.steps, args.warmup, args.model_timeout,
                     attn_impl=args.attn_impl, stem_s2d=args.stem_s2d,
+                    qkv_fused=args.qkv_fused,
                 )
         except Exception as e:
             rec = {"model": name, "error": f"{type(e).__name__}: {e}"[:300]}
