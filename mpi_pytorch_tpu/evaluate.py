@@ -208,6 +208,13 @@ def _make_predict_step(mesh, compute_dtype, fused_head: bool = False):
     from mpi_pytorch_tpu.ops.fused_head_ce import head_predict
 
     n_data = mesh.shape[mesh.axis_names[0]]
+    if n_data > 1:
+        # A Mosaic custom call has no GSPMD partitioning rule: on a
+        # multi-chip data axis the kernel would be instantiated at the
+        # GLOBAL batch (blowing its per-chip VMEM envelope) behind an
+        # all-gather of the features. Until the call is shard_map-wrapped,
+        # the fused head is a single-data-axis optimization — fall back.
+        return _make_predict_step(mesh, compute_dtype, fused_head=False)
 
     @jax.jit
     def predict_fused(state, batch):

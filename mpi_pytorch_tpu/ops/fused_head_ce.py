@@ -58,6 +58,10 @@ _BLOCK_V = 2048  # fwd vocab tile; [B, BV] f32 = 4 MB at B=512 (4096 OOMs scoped
 # onehot, dlog, dW) plus feats/dfeats — 2048 blows the 16 MB scoped-VMEM
 # limit at B=512 (measured: 23.4 MB), so it tiles half as wide.
 _BLOCK_V_BWD = 1024
+# head_predict's VMEM envelope: beyond this many rows the [rows, _BLOCK_V]
+# f32 logits block exceeds scoped VMEM (measured at 4096) — the wrapper
+# falls back to the XLA reference.
+PREDICT_MAX_ROWS = 1024
 
 
 def _fwd_kernel(labels_ref, feats_ref, w_ref, b_ref, loss_ref, m_ref, l_ref, picked_ref):
@@ -335,7 +339,7 @@ def head_predict(
         if not tpu_backend():
             return head_predict_reference(feats, w, b, labels)
         interpret = False
-    if (kernel_rows or feats.shape[0]) > 1024 and not interpret:
+    if (kernel_rows or feats.shape[0]) > PREDICT_MAX_ROWS and not interpret:
         # Envelope (measured): at 4096 rows the [rows, BLOCK_V] f32 logits
         # block exceeds the scoped-VMEM budget and the TPU compile rejects;
         # larger batches take the XLA path rather than failing. Under a

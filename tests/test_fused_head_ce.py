@@ -110,10 +110,13 @@ def test_head_predict_cross_block_tie_prefers_first():
     np.testing.assert_array_equal(np.asarray(preds), [100, 100])
 
 
-def test_fused_head_predict_step_matches_plain(tmp_path):
-    """The eval driver's fused-head predict step (interceptor + streamed
-    head) returns the same metrics and predictions as the plain
-    logits-materializing step, through a real zoo model."""
+@pytest.mark.parametrize("n_data", [1, 8])
+def test_fused_head_predict_step_matches_plain(tmp_path, n_data):
+    """The eval driver's fused-head predict step returns the same metrics
+    and predictions as the plain logits-materializing step, through a real
+    zoo model. n_data=1 exercises the interceptor + streamed-head path;
+    n_data=8 exercises the multi-data-axis gate (a Mosaic call has no
+    GSPMD rule, so the fused build must fall back to the plain step)."""
     from jax.sharding import Mesh
 
     from mpi_pytorch_tpu.evaluate import _make_predict_step
@@ -129,7 +132,9 @@ def test_fused_head_predict_step_matches_plain(tmp_path):
         apply_fn=bundle.model.apply, variables=variables,
         tx=optax.identity(), rng=jax.random.PRNGKey(1),
     )
-    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("data", "model"))
+    mesh = Mesh(
+        np.array(jax.devices()[:n_data]).reshape(n_data, 1), ("data", "model")
+    )
     images = np.random.default_rng(0).normal(size=(8, 32, 32, 3)).astype(np.float32)
     labels = np.asarray([3, 5, -1, 9, 0, 1, -1, 7], np.int32)
     batch = (jnp.asarray(images), jnp.asarray(labels))

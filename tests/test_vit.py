@@ -189,3 +189,35 @@ def test_vit_rejects_bad_patch_grid():
             {"params": jax.random.PRNGKey(0)},
             jnp.zeros((1, 30, 30, 3)), train=False,
         )
+
+
+def test_qkv_fused_parity():
+    """--qkv-fused: identical param tree, bit-identical INIT values (the
+    _ProjParams kernel init replicates DenseGeneral's flatten-then-reshape
+    fan-in), equal forward and gradients — the checkpoint-interchange
+    claim, pinned (it depends on flax DenseGeneral internals)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_pytorch_tpu.models.vit import VisionTransformer
+
+    kw = dict(num_classes=10, patch_size=8, hidden=32, depth=2,
+              num_heads=4, mlp_dim=64)
+    vu = VisionTransformer(**kw)
+    vf = VisionTransformer(**kw, qkv_fused=True)
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 16, 16, 3)), jnp.float32
+    )
+    p1 = vu.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    p2 = vf.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    assert jax.tree.structure(p1) == jax.tree.structure(p2)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    o1 = vu.apply(p1, x, train=False)
+    o2 = vf.apply(p1, x, train=False)  # SAME params through both layouts
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), rtol=2e-5, atol=2e-5)
+    g1 = jax.grad(lambda p: jnp.sum(vu.apply(p, x, train=False) ** 2))(p1)
+    g2 = jax.grad(lambda p: jnp.sum(vf.apply(p, x, train=False) ** 2))(p1)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)

@@ -111,7 +111,12 @@ def bench_head(batch: int, d: int, steps: int, warmup: int):
         return jnp.sum(loss) + jnp.sum(preds)
 
     out = []
-    fused_label = "fused" if batch <= 1024 else "fused(>envelope: xla fallback)"
+    from mpi_pytorch_tpu.ops.fused_head_ce import PREDICT_MAX_ROWS
+
+    fused_label = (
+        "fused" if batch <= PREDICT_MAX_ROWS
+        else "fused(>envelope: xla fallback)"
+    )
     for label, fn in (("xla", xla_head), (fused_label, fused_head)):
         add = jax.jit(lambda acc, v: acc + v)
         acc = jnp.zeros((), jnp.float32)

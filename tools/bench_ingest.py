@@ -107,7 +107,10 @@ def main() -> None:
     # --- pack build ------------------------------------------------------
     packed_dir = os.path.join(args.workdir, "packed")
     pack_build_s = None
+    pack_ok = True
     if not os.path.isdir(packed_dir) or not os.listdir(packed_dir):
+        import shutil
+
         t0 = time.perf_counter()
         proc = subprocess.run(
             [sys.executable, "-m", "mpi_pytorch_tpu.data.packed",
@@ -123,12 +126,15 @@ def main() -> None:
             env=dict(os.environ, MPT_PLATFORM="cpu"),
         )
         pack_build_s = round(time.perf_counter() - t0, 1)
-        ok = proc.returncode == 0
+        pack_ok = proc.returncode == 0
         print(json.dumps({
-            "row": "pack_build", "images": len(train_manifest) ,
-            "wall_s": pack_build_s, "ok": ok,
-            **({} if ok else {"err": (proc.stderr or "")[-300:]}),
+            "row": "pack_build", "images": len(train_manifest),
+            "wall_s": pack_build_s, "ok": pack_ok,
+            **({} if pack_ok else {"err": (proc.stderr or "")[-300:]}),
         }), flush=True)
+        if not pack_ok:
+            # A partial pack must not masquerade as complete on reruns.
+            shutil.rmtree(packed_dir, ignore_errors=True)
 
     # --- streaming decode: cold then warm --------------------------------
     dropped = _drop_page_cache()
@@ -144,6 +150,10 @@ def main() -> None:
     }), flush=True)
 
     # --- packed mmap: cold then warm --------------------------------------
+    if not pack_ok:
+        print(json.dumps({"row": "cold_packed", "skipped": "pack build failed"}),
+              flush=True)
+        return
     dropped = _drop_page_cache()
     wall, n = _epoch_throughput(make_loader(packed_dir=packed_dir), 0)
     print(json.dumps({
