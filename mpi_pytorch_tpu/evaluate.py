@@ -160,8 +160,15 @@ def evaluate(cfg: Config) -> EvalSummary:
     )
 
 
-@functools.lru_cache(maxsize=None)
 def _make_predict_step(mesh, compute_dtype, fused_head: bool = False):
+    # Canonicalize to positional args: lru_cache keys keyword and
+    # positional calls separately, which would double-compile and break
+    # the multi-axis gate's identity with the plain step.
+    return _make_predict_step_impl(mesh, compute_dtype, bool(fused_head))
+
+
+@functools.lru_cache(maxsize=None)
+def _make_predict_step_impl(mesh, compute_dtype, fused_head: bool):
     """ONE batched forward yielding both the eval metrics and the per-image
     argmax — predictions and accuracy come from the same pass (the
     reference's predictor ranks compute the per-image argmax and discard it,
@@ -213,8 +220,9 @@ def _make_predict_step(mesh, compute_dtype, fused_head: bool = False):
         # multi-chip data axis the kernel would be instantiated at the
         # GLOBAL batch (blowing its per-chip VMEM envelope) behind an
         # all-gather of the features. Until the call is shard_map-wrapped,
-        # the fused head is a single-data-axis optimization — fall back.
-        return _make_predict_step(mesh, compute_dtype, fused_head=False)
+        # the fused head is a single-data-axis optimization — fall back to
+        # the SAME cached object the plain path returns.
+        return _make_predict_step_impl(mesh, compute_dtype, False)
 
     @jax.jit
     def predict_fused(state, batch):

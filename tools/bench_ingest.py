@@ -112,28 +112,35 @@ def main() -> None:
         import shutil
 
         t0 = time.perf_counter()
-        proc = subprocess.run(
-            [sys.executable, "-m", "mpi_pytorch_tpu.data.packed",
-             "--packed-dir", packed_dir,
-             "--debug", "false", "--synthetic-data", "false",
-             "--num-classes", str(args.num_classes),
-             "--train-csv", cfg.train_csv, "--test-csv", cfg.test_csv,
-             "--train-img-dir", cfg.train_img_dir,
-             "--test-img-dir", cfg.test_img_dir,
-             "--width", str(args.image_size), "--height", str(args.image_size)],
-            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            capture_output=True, text=True, timeout=3600,
-            env=dict(os.environ, MPT_PLATFORM="cpu"),
-        )
+        err = ""
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "mpi_pytorch_tpu.data.packed",
+                 "--packed-dir", packed_dir,
+                 "--debug", "false", "--synthetic-data", "false",
+                 "--num-classes", str(args.num_classes),
+                 "--train-csv", cfg.train_csv, "--test-csv", cfg.test_csv,
+                 "--train-img-dir", cfg.train_img_dir,
+                 "--test-img-dir", cfg.test_img_dir,
+                 "--width", str(args.image_size), "--height", str(args.image_size)],
+                cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                capture_output=True, text=True, timeout=3600,
+                env=dict(os.environ, MPT_PLATFORM="cpu"),
+            )
+            pack_ok = proc.returncode == 0
+            if not pack_ok:
+                err = (proc.stderr or "")[-300:]
+        except subprocess.TimeoutExpired:
+            pack_ok, err = False, "pack build exceeded 3600s"
         pack_build_s = round(time.perf_counter() - t0, 1)
-        pack_ok = proc.returncode == 0
         print(json.dumps({
             "row": "pack_build", "images": len(train_manifest),
             "wall_s": pack_build_s, "ok": pack_ok,
-            **({} if pack_ok else {"err": (proc.stderr or "")[-300:]}),
+            **({} if pack_ok else {"err": err}),
         }), flush=True)
         if not pack_ok:
-            # A partial pack must not masquerade as complete on reruns.
+            # A partial pack must not masquerade as complete on reruns —
+            # covers crash, nonzero exit, AND timeout.
             shutil.rmtree(packed_dir, ignore_errors=True)
 
     # --- streaming decode: cold then warm --------------------------------

@@ -128,9 +128,12 @@ def bench_one(model_name: str, batch_per_chip: int, image: int, steps: int,
     from mpi_pytorch_tpu.train.step import make_train_step
     from mpi_pytorch_tpu.utils.hardware import peak_bf16_tflops, step_flops
 
+    from mpi_pytorch_tpu.models.registry import fused_stem_default
+
+    fused_stem = fused_stem_default(model_name)  # what the harness resolves
     mesh, state, device_batch, n_chips, batch = build_state_and_batch(
         model_name, batch_per_chip, image, attn_impl=attn_impl,
-        stem_s2d=stem_s2d, qkv_fused=qkv_fused,
+        stem_s2d=stem_s2d, qkv_fused=qkv_fused, fused_stem=fused_stem,
     )
     step = make_train_step(jnp.bfloat16)
 
@@ -165,6 +168,8 @@ def bench_one(model_name: str, batch_per_chip: int, image: int, steps: int,
         rec["stem_s2d"] = True
     if qkv_fused:
         rec["qkv_fused"] = True
+    if fused_stem:
+        rec["fused_stem"] = True
     if peak and flops_per_step > 0:
         rec["mfu_pct"] = round(100.0 * tflops_per_chip / peak, 1)
     return rec

@@ -23,6 +23,15 @@ echo "== resnet space-to-depth stem vs standard =="
 python tools/bench_zoo.py --models resnet18,resnet34 --stem-s2d \
     --out "$OUT/zoo_s2d.json" || true
 
+echo "== fused stem A/B (round 5: the headline lever) =="
+MPT_FUSED_STEM=0 python tools/bench_zoo.py --models resnet18,resnet34 \
+    --out "$OUT/zoo_stem_unfused.json" || true
+# (the default zoo sweep above already runs resnet18/34 WITH the fused stem)
+
+echo "== fused predictions head A/B (round 5) =="
+timeout 1800 python tools/bench_eval.py --head --batches 256,1024 \
+    | tee "$OUT/head_predict_bench.json" || true
+
 echo "== attention microbench: flash vs full across sequence lengths =="
 timeout 3600 python tools/bench_attention.py --seqs 512,1024,2048,4096,8192 \
     --out "$OUT/attention_bench.json" || true
@@ -45,5 +54,8 @@ timeout 1800 python tools/roofline.py --model densenet121 --batch 1024 \
 
 echo "== inference bench =="
 python tools/bench_eval.py | tee "$OUT/eval_bench.json" || true
+
+echo "== cold-start ingest at reference scale (host-side; no chip needed) =="
+timeout 3600 python tools/bench_ingest.py | tee "$OUT/ingest_bench.json" || true
 
 echo "done — update docs/RESULTS.md §3b/§4/§4c from these artifacts"
