@@ -101,18 +101,16 @@ class FusedStemBNReluPool(nn.Module):
 def max_pool(x: jnp.ndarray, window: int, stride: int, padding: Any = "VALID") -> jnp.ndarray:
     """XLA reduce_window max pool (select-and-scatter backward).
 
-    An index-based alternative exists (``ops/pooling.py``) but measured
-    WORSE as a general drop-in: XLA materializes the scatter's dilated
-    pads (or the phase-interleave copies) instead of fusing them, so the
-    roofline bound regressed 62.4→79.5 ms on resnet18 (docs/RESULTS.md
-    §4d records the full negative result). It is kept, unused, as the
-    pinned-semantics base for a future VMEM-resident fused-stem kernel."""
+    An XLA-level index-based alternative (round 4's ``ops/pooling.py``)
+    measured WORSE as a general drop-in — XLA materializes the scatter's
+    dilated pads (or the phase-interleave copies) instead of fusing them,
+    regressing the resnet18 roofline bound 62.4→79.5 ms — and was deleted
+    once ``ops/fused_stem.py`` landed the same byte win properly in VMEM
+    (docs/RESULTS.md §4d records both; git history has the code)."""
     if isinstance(padding, int):
         padding = [(padding, padding), (padding, padding)]
     return nn.max_pool(x, (window, window), strides=(stride, stride), padding=padding)
 
-
-max_pool_xla = max_pool  # reference implementation alias for tests/benches
 
 
 def adaptive_avg_pool(x: jnp.ndarray, out_hw: tuple[int, int]) -> jnp.ndarray:

@@ -31,9 +31,10 @@ This kernel pair removes the intermediate activation tensor entirely:
   fusion.765's 3.5 GB.
 - backward: the pool+relu gradient is a static phase-GATHER through the
   saved index (each input position is covered by ≤4 windows; offset
-  parity decides which — the in-VMEM version of ``ops/pooling.py``'s
-  phase decomposition, which LOST as an XLA-level graph because the
-  interleave copies would not fuse but costs nothing inside one kernel).
+  parity decides which — the in-VMEM version of round 4's XLA-level
+  phase decomposition (the since-deleted ``ops/pooling.py``), which LOST
+  as an XLA graph because the interleave copies would not fuse but costs
+  nothing inside one kernel).
   The relu mask is ``pooled > 0`` (the window max is post-relu: max > 0
   ⟺ the winner was a live activation). The same pass accumulates the
   BN reduces Σdu and Σdu·y across the sequential TPU grid, replacing
