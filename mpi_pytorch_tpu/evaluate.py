@@ -85,6 +85,9 @@ def build_inference(cfg: Config, mesh=None, manifests=None):
         tx=optax.identity(),
         rng=jax.random.PRNGKey(cfg.seed),
     )
+    from mpi_pytorch_tpu.train.trainer import warn_fused_stem_spmd
+
+    warn_fused_stem_spmd(cfg, mesh)
     if cfg.pp_stages > 1:
         # Same seam as build_training: PP is an execution strategy keyed on
         # state.apply_fn, so --pp-stages pipelines inference too (identical

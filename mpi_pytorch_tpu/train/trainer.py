@@ -44,7 +44,7 @@ from mpi_pytorch_tpu.train.step import (
     place_state_on_mesh,
 )
 from mpi_pytorch_tpu.utils import hardware as hw
-from mpi_pytorch_tpu.utils.logging import MetricsWriter, init_logger
+from mpi_pytorch_tpu.utils.logging import MetricsWriter, init_logger, run_logger
 
 
 @dataclass
@@ -138,6 +138,22 @@ def _dtype(name: str):
     return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[name]
 
 
+def warn_fused_stem_spmd(cfg: Config, mesh) -> None:
+    """A Mosaic custom call has no GSPMD partitioning rule: under a
+    multi-device data axis XLA keeps the math correct by replicating the
+    call's operands (an all-gather of the conv activation). The kernel's
+    measured win is single-chip; warn rather than fail so CPU-mesh tests
+    and small experiments still run. Shared by the train AND eval
+    builders — both construct the same fused-stem model."""
+    if cfg.fused_stem and mesh.shape[mesh.axis_names[0]] > 1:
+        run_logger().warning(
+            "--fused-stem on a %d-way data axis: the stem kernel is not "
+            "SPMD-partitioned; expect an activation all-gather around it "
+            "(single-chip is the measured envelope, docs/RESULTS.md §4d)",
+            mesh.shape[mesh.axis_names[0]],
+        )
+
+
 def build_training(cfg: Config, mesh=None):
     """Construct (mesh, bundle, state, loaders, step fns) for cfg — shared by
     the trainer, the eval pipeline, and the graft entry points."""
@@ -207,6 +223,7 @@ def build_training(cfg: Config, mesh=None):
         stem_s2d=cfg.stem_s2d,
         fused_stem=cfg.fused_stem,
     )
+    warn_fused_stem_spmd(cfg, mesh)
     # Total optimizer steps for cosine-style schedules: the globally-computed
     # per-epoch step count (identical on every host) x epochs.
     total_steps = (
