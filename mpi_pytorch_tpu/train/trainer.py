@@ -144,8 +144,16 @@ def warn_fused_stem_spmd(cfg: Config, mesh) -> None:
     call's operands (an all-gather of the conv activation). The kernel's
     measured win is single-chip; warn rather than fail so CPU-mesh tests
     and small experiments still run. Shared by the train AND eval
-    builders — both construct the same fused-stem model."""
-    if cfg.fused_stem and mesh.shape[mesh.axis_names[0]] > 1:
+    builders — both construct the same fused-stem model.
+
+    ``--spmd-mode`` is exempt: its shard_map step hands the kernel
+    PER-SHARD batches, so the call partitions correctly — that pairing is
+    the multi-chip fused-stem recipe."""
+    if (
+        cfg.fused_stem
+        and not cfg.spmd_mode
+        and mesh.shape[mesh.axis_names[0]] > 1
+    ):
         run_logger().warning(
             "--fused-stem on a %d-way data axis: the stem kernel is not "
             "SPMD-partitioned; expect an activation all-gather around it "
