@@ -102,10 +102,14 @@ def main() -> None:
     # unfused XLA stem for A/B.
     from mpi_pytorch_tpu.models.registry import fused_stem_default
 
+    _fused = fused_stem_default(MODEL)
     bundle, variables = create_model_bundle(
         MODEL, NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=IMAGE,
         dtype=jnp.bfloat16, param_dtype=jnp.float32,
-        fused_stem=fused_stem_default(MODEL),
+        fused_stem=_fused,
+        # Multi-chip: the stem kernel shard_maps itself over the data axis
+        # (ops/fused_stem.py, Multi-chip).
+        dp_mesh=mesh if _fused else None,
     )
     state = TrainState.create(
         apply_fn=bundle.model.apply, variables=variables,

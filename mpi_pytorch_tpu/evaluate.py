@@ -78,6 +78,9 @@ def build_inference(cfg: Config, mesh=None, manifests=None):
         qkv_fused=cfg.qkv_fused,
         stem_s2d=cfg.stem_s2d,
         fused_stem=cfg.fused_stem,
+        # Multi-chip fused stem: the model shard_maps the Mosaic call over
+        # the mesh's data axis (ops/fused_stem.py, Multi-chip).
+        dp_mesh=mesh if cfg.fused_stem else None,
     )
     state = TrainState.create(
         apply_fn=bundle.model.apply,
@@ -85,9 +88,6 @@ def build_inference(cfg: Config, mesh=None, manifests=None):
         tx=optax.identity(),
         rng=jax.random.PRNGKey(cfg.seed),
     )
-    from mpi_pytorch_tpu.train.trainer import warn_fused_stem_spmd
-
-    warn_fused_stem_spmd(cfg, mesh)
     if cfg.pp_stages > 1:
         # Same seam as build_training: PP is an execution strategy keyed on
         # state.apply_fn, so --pp-stages pipelines inference too (identical
@@ -165,8 +165,7 @@ def evaluate(cfg: Config) -> EvalSummary:
 
 def _make_predict_step(mesh, compute_dtype, fused_head: bool = False):
     # Canonicalize to positional args: lru_cache keys keyword and
-    # positional calls separately, which would double-compile and break
-    # the multi-axis gate's identity with the plain step.
+    # positional calls separately, which would double-compile the step.
     return _make_predict_step_impl(mesh, compute_dtype, bool(fused_head))
 
 
@@ -188,7 +187,11 @@ def _make_predict_step_impl(mesh, compute_dtype, fused_head: bool):
     ``ops.fused_head_ce.head_predict`` streams the head weights through
     VMEM computing per-example loss + argmax online (measured 2.31 vs
     2.74 ms per 1024-image batch against the XLA head — bench_eval
-    --head). The metrics are loss-sum/correct/count over the SAME
+    --head). On a multi-device data axis the kernel call is shard_map-
+    partitioned over the mesh inside ``head_predict`` (each chip streams
+    its own row shard), and batches beyond the per-block VMEM envelope
+    are row-tiled inside the kernel wrapper — no silent fallback on
+    either axis. The metrics are loss-sum/correct/count over the SAME
     quantities ``metrics_from_logits`` computes, so accuracy is identical
     up to the bf16-matmul argmax caveat in ``head_predict``'s docstring."""
     from flax import linen as flax_nn
@@ -216,16 +219,6 @@ def _make_predict_step_impl(mesh, compute_dtype, fused_head: bool):
         return predict
 
     from mpi_pytorch_tpu.ops.fused_head_ce import head_predict
-
-    n_data = mesh.shape[mesh.axis_names[0]]
-    if n_data > 1:
-        # A Mosaic custom call has no GSPMD partitioning rule: on a
-        # multi-chip data axis the kernel would be instantiated at the
-        # GLOBAL batch (blowing its per-chip VMEM envelope) behind an
-        # all-gather of the features. Until the call is shard_map-wrapped,
-        # the fused head is a single-data-axis optimization — fall back to
-        # the SAME cached object the plain path returns.
-        return _make_predict_step_impl(mesh, compute_dtype, False)
 
     @jax.jit
     def predict_fused(state, batch):
@@ -259,11 +252,20 @@ def _make_predict_step_impl(mesh, compute_dtype, fused_head: bool):
                 jnp.argmax(logits, axis=-1).astype(jnp.int32), row_sharding
             )
             return metrics_from_logits(logits, labels), preds
-        # feats.shape[0] is the GLOBAL batch inside jit; the kernel's VMEM
-        # envelope is per chip.
+        # The interceptor's dummy return must BE the model output — if an
+        # architecture ever routes more layers after its 'head' Dense, the
+        # captured features would not be the logits' features and the fused
+        # metrics would be silently wrong. Shapes are static under jit, so
+        # this costs nothing at runtime.
+        assert out.shape == box["feats"].shape[:-1] + (box["w"].shape[1],), (
+            "intercepted 'head' output shape does not match the model "
+            f"output: {out.shape} vs {box['feats'].shape[:-1] + (box['w'].shape[1],)}"
+        )
+        # head_predict shard_maps itself over the mesh's data axis (each
+        # chip streams its own row shard) and row-tiles beyond its
+        # per-block VMEM envelope.
         loss, preds = head_predict(
-            box["feats"], box["w"], box["b"], labels,
-            kernel_rows=box["feats"].shape[0] // n_data,
+            box["feats"], box["w"], box["b"], labels, dp_mesh=mesh
         )
         valid = labels >= 0
         metrics = {

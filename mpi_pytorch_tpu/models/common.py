@@ -64,6 +64,11 @@ class FusedStemBNReluPool(nn.Module):
     eps: float = BN_EPS
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
+    # Multi-chip: mesh whose leading (data) axis partitions the Mosaic call
+    # via shard_map (ops/fused_stem.py, Multi-chip). The BN statistics above
+    # the kernel stay GLOBAL-batch reductions either way (GSPMD lowers them
+    # to cross-device means under auto-jit — identical to the unfused stem).
+    dp_mesh: Any = None
 
     @nn.compact
     def __call__(self, y: jnp.ndarray, use_running_average: bool) -> jnp.ndarray:
@@ -95,7 +100,7 @@ class FusedStemBNReluPool(nn.Module):
         b = bias.astype(jnp.float32) - mean * a
         # Output in the module's compute dtype, matching what the unfused
         # batch_norm(dtype=...) -> relu -> pool composition produces.
-        return stem_affine_relu_pool(y, a, b).astype(self.dtype)
+        return stem_affine_relu_pool(y, a, b, dp_mesh=self.dp_mesh).astype(self.dtype)
 
 
 def max_pool(x: jnp.ndarray, window: int, stride: int, padding: Any = "VALID") -> jnp.ndarray:

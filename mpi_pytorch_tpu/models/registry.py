@@ -112,13 +112,13 @@ def fused_stem_default(model_name: str) -> bool:
     """The benchmark harnesses' shared gate: fused stem ON for the 7x7-stem
     family on TPU unless MPT_FUSED_STEM=0 (the A/B escape hatch). The
     trainer/eval CLIs stay explicit via ``--fused-stem``."""
-    import os
-
     import jax
+
+    from mpi_pytorch_tpu.utils.env import env_flag
 
     return (
         model_name in FUSED_STEM_MODELS
-        and os.environ.get("MPT_FUSED_STEM", "1") not in ("", "0", "false")
+        and env_flag("MPT_FUSED_STEM", default=True)
         and jax.devices()[0].platform == "tpu"
     )
 
@@ -140,6 +140,7 @@ def initialize_model(
     attn_impl: str = "full",
     stem_s2d: bool = False,
     fused_stem: bool = False,
+    dp_mesh: Any = None,
     qkv_fused: bool = False,
 ) -> tuple[nn.Module, int]:
     """Reference-parity signature (``models.py:16``): returns (model, input_size)."""
@@ -210,6 +211,11 @@ def initialize_model(
         if bn_axis_name is not None:
             raise ValueError("fused_stem does not support sync-BN (bn_axis_name)")
         kw["fused_stem"] = True
+        if dp_mesh is not None:
+            # Multi-chip: the stem module shard_maps its Mosaic call over
+            # this mesh's data axis (ops/fused_stem.py, Multi-chip). Only
+            # meaningful with fused_stem — silently ignored otherwise.
+            kw["dp_mesh"] = dp_mesh
     model = factory(num_classes, **kw)
     return model, input_size
 
@@ -252,6 +258,7 @@ def create_model_bundle(
     attn_impl: str = "full",
     stem_s2d: bool = False,
     fused_stem: bool = False,
+    dp_mesh: Any = None,
     qkv_fused: bool = False,
 ) -> tuple[ModelBundle, dict]:
     """Full-fat factory: returns the bundle plus initialized variables."""
@@ -260,7 +267,7 @@ def create_model_bundle(
         dtype=dtype, param_dtype=param_dtype, bn_axis_name=bn_axis_name,
         remat_blocks=remat_blocks, sp_strategy=sp_strategy, sp_mesh=sp_mesh,
         ep_mesh=ep_mesh, attn_impl=attn_impl, stem_s2d=stem_s2d,
-        fused_stem=fused_stem, qkv_fused=qkv_fused,
+        fused_stem=fused_stem, dp_mesh=dp_mesh, qkv_fused=qkv_fused,
     )
     size = image_size or (299 if model_name == "inception_v3" else 128)
     rng = rng if rng is not None else jax.random.PRNGKey(0)

@@ -115,6 +115,11 @@ class ResNet(nn.Module):
     # stem (FusedStemBNReluPool mirrors flax BatchNorm's layout), so
     # checkpoints interchange. Requires sync-BN off (bn_axis_name=None).
     fused_stem: bool = False
+    # Multi-chip fused stem: the mesh whose leading (data) axis the Mosaic
+    # call is shard_map-partitioned over (ops/fused_stem.py, Multi-chip).
+    # None = single-call (single chip, or an spmd-mode step that is itself
+    # a shard_map handing the kernel per-shard batches).
+    dp_mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -132,7 +137,8 @@ class ResNet(nn.Module):
             if self.bn_axis_name is not None:
                 raise ValueError("fused_stem does not support sync-BN (bn_axis_name)")
             x = FusedStemBNReluPool(
-                dtype=self.dtype, param_dtype=self.param_dtype, name="bn1"
+                dtype=self.dtype, param_dtype=self.param_dtype,
+                dp_mesh=self.dp_mesh, name="bn1",
             )(x, use_running_average=not train)
         else:
             x = batch_norm("bn1", dtype=self.dtype, axis_name=self.bn_axis_name)(

@@ -238,13 +238,12 @@ def flash_attention(
     elsewhere (or the Pallas interpreter when ``MPT_FLASH_INTERPRET`` is
     set — how tests drive the real kernel path through a whole model on
     CPU); True forces the interpreter; False forces the compiled kernel."""
-    import os
-
     from mpi_pytorch_tpu.ops.ring_attention import full_attention
+    from mpi_pytorch_tpu.utils.env import env_flag
     from mpi_pytorch_tpu.utils.hardware import tpu_backend
 
     if interpret is None:
-        if os.environ.get("MPT_FLASH_INTERPRET"):
+        if env_flag("MPT_FLASH_INTERPRET"):
             interpret = True
         elif not tpu_backend():
             return full_attention(q, k, v, causal=causal)

@@ -47,6 +47,7 @@ mis-executes partial final blocks, fwd block 4096 → scoped-VMEM OOM).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -58,9 +59,12 @@ _BLOCK_V = 2048  # fwd vocab tile; [B, BV] f32 = 4 MB at B=512 (4096 OOMs scoped
 # onehot, dlog, dW) plus feats/dfeats — 2048 blows the 16 MB scoped-VMEM
 # limit at B=512 (measured: 23.4 MB), so it tiles half as wide.
 _BLOCK_V_BWD = 1024
-# head_predict's VMEM envelope: beyond this many rows the [rows, _BLOCK_V]
-# f32 logits block exceeds scoped VMEM (measured at 4096) — the wrapper
-# falls back to the XLA reference.
+# head_predict's per-ROW-BLOCK VMEM envelope: beyond this many rows the
+# [rows, _BLOCK_V] f32 logits block exceeds scoped VMEM (measured at 4096).
+# Larger batches are ROW-TILED: the wrapper runs a (row-block, vocab-block)
+# grid with ≤ this many rows resident per step, so B=4096+ streams through
+# the kernel instead of compile-rejecting (it falls back to the XLA
+# reference only when the batch has no usable row tiling).
 PREDICT_MAX_ROWS = 1024
 
 
@@ -145,10 +149,13 @@ def _bwd_kernel(
     dfeats_ref[...] += contrib
 
 
-def _pad_wb(w: jnp.ndarray, b: jnp.ndarray, block: int) -> tuple[jnp.ndarray, jnp.ndarray, int]:
-    """Pad the vocab dim to the block size and cast W to bf16: the kernels
-    matmul in bf16 anyway, and streaming W through VMEM at half the bytes is
-    where the fusion's bandwidth win comes from (W is the one large operand)."""
+def _pad_wb(
+    w: jnp.ndarray, b: jnp.ndarray, block: int, dtype=jnp.bfloat16
+) -> tuple[jnp.ndarray, jnp.ndarray, int]:
+    """Pad the vocab dim to the block size and cast W to the kernel compute
+    dtype (bf16 for the production head: streaming W through VMEM at half
+    the bytes is where the fusion's bandwidth win comes from — W is the one
+    large operand; f32 when the caller runs an f32-compute model)."""
     v = w.shape[1]
     pad = (-v) % block
     if pad:
@@ -156,7 +163,7 @@ def _pad_wb(w: jnp.ndarray, b: jnp.ndarray, block: int) -> tuple[jnp.ndarray, jn
         # exp(-inf)=0 to l and can never be a label or receive gradient.
         w = jnp.pad(w, ((0, 0), (0, pad)))
         b = jnp.pad(b, (0, pad), constant_values=-jnp.inf)
-    return w.astype(jnp.bfloat16), b, v
+    return w.astype(dtype), b, v
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
@@ -256,9 +263,12 @@ def _predict_kernel(
     """Inference sibling of ``_fwd_kernel``: same online softmax, plus a
     running ARGMAX (the predictions-pass output) — so eval accuracy, loss,
     and per-image predictions all come out of one pass that never
-    materializes [B, V]. Grid: (num_v_blocks,); m/l/picked/arg alias one
-    block across the sequential grid as accumulators."""
-    j = pl.program_id(0)
+    materializes [B, V]. Grid: (num_row_blocks, num_v_blocks) — the vocab
+    axis is the MINOR (fastest) grid dim, so for each row block the
+    m/l/picked/arg outputs alias one block across the sequential vocab
+    sweep as accumulators, then the grid advances to the next row block
+    (the B=4096+ row tiling; the single-block case is grid (1, n_v))."""
+    j = pl.program_id(1)
     feats = feats_ref[...]  # [B, D] bf16
     w = w_ref[...]  # [D, BV] bf16
     logits = lax.dot_general(
@@ -299,7 +309,7 @@ def _predict_kernel(
     hit = cols == local
     picked_ref[...] += jnp.sum(jnp.where(hit, logits, 0.0), axis=1, keepdims=True)
 
-    @pl.when(j == pl.num_programs(0) - 1)
+    @pl.when(j == pl.num_programs(1) - 1)
     def _finish():
         valid = labels >= 0
         loss = jnp.log(l_ref[...]) + m_ref[...] - picked_ref[...]
@@ -314,13 +324,47 @@ def head_predict_reference(feats, w, b, labels):
     return head_ce_reference(feats, w, b, labels), preds
 
 
+def _predict_row_block(rows: int) -> int | None:
+    """Rows resident per grid step: the whole batch when it fits the
+    measured per-block envelope, else the largest power-of-two divisor
+    ≤ PREDICT_MAX_ROWS (None = no usable tiling → XLA fallback)."""
+    if rows <= PREDICT_MAX_ROWS:
+        return rows
+    for rb in (1024, 512, 256, 128, 64, 32, 16, 8):
+        if rb <= PREDICT_MAX_ROWS and rows % rb == 0:
+            return rb
+    return None
+
+
+def _predict_call(labels, feats, wp, bp, *, block_r: int, interpret: bool):
+    """One (per-shard) row-tiled kernel invocation over pre-padded W/bias."""
+    bsz, d = feats.shape
+    row_spec = pl.BlockSpec((block_r, 1), lambda i, j: (i, 0))
+    loss, pred, *_ = pl.pallas_call(
+        _predict_kernel,
+        grid=(bsz // block_r, wp.shape[1] // _BLOCK_V),
+        in_specs=[
+            row_spec,  # labels
+            pl.BlockSpec((block_r, d), lambda i, j: (i, 0)),  # feats rows
+            pl.BlockSpec((d, _BLOCK_V), lambda i, j: (0, j)),  # W block
+            pl.BlockSpec((1, _BLOCK_V), lambda i, j: (0, j)),  # bias block
+        ],
+        # loss/pred/m/l/picked/arg: per-row-block accumulators (the vocab
+        # grid dim is minor, so each aliases one block across the v sweep).
+        out_specs=[row_spec] * 6,
+        out_shape=[jax.ShapeDtypeStruct((bsz, 1), jnp.float32)] * 6,
+        interpret=interpret,
+    )(labels.reshape(bsz, 1), feats, wp, bp.reshape(1, -1))
+    return loss[:, 0], pred[:, 0].astype(jnp.int32)
+
+
 def head_predict(
     feats: jnp.ndarray,
     w: jnp.ndarray,
     b: jnp.ndarray,
     labels: jnp.ndarray,
     interpret: bool | None = None,
-    kernel_rows: int | None = None,
+    dp_mesh=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(per-example CE [B] f32, argmax predictions [B] int32) of
     ``softmax(feats @ w + b)`` without materializing [B, V] — the
@@ -328,52 +372,73 @@ def head_predict(
     (``evaluation_pipeline.py:149-158``) as one VMEM-streaming kernel.
     Forward-only (no VJP): the predictions path never backpropagates.
 
-    Argmax note: logits are computed bf16×bf16→f32 (the production head's
-    dtype); near-ties within bf16 rounding can pick a different index
-    than an f32-matmul argmax would — same caveat as the XLA bf16 head
-    (models/resnet.py head dtype note).
+    Batches beyond PREDICT_MAX_ROWS are ROW-TILED (a (rows, vocab) grid
+    with the vocab sweep minor), so B=4096 streams through the kernel —
+    the former compile-rejection envelope is now an internal loop.
+
+    ``dp_mesh``: the eval mesh. When its leading (data) axis has >1
+    device, the call is ``shard_map``-partitioned over that axis — each
+    chip runs the Mosaic call on its own row shard (a Mosaic custom call
+    has no GSPMD partitioning rule; unwrapped, XLA would all-gather the
+    features and instantiate the kernel at the global batch). W/b stay
+    replicated inside the wrapper (a TP-sharded head is gathered once —
+    correctness over speed for that corner).
+
+    Argmax/compute-dtype note: the kernel matmuls in the FEATURE dtype —
+    bf16×bf16→f32 for the production bf16 head; an f32-compute model keeps
+    exact f32 semantics (no silent bf16 downcast). Under bf16, near-ties
+    within rounding can pick a different index than an f32-matmul argmax
+    would — same caveat as the XLA bf16 head (models/resnet.py head dtype
+    note).
     """
     if interpret is None:
+        from mpi_pytorch_tpu.utils.env import env_flag
         from mpi_pytorch_tpu.utils.hardware import tpu_backend
 
-        if not tpu_backend():
+        # MPT_HEAD_INTERPRET=1 drives the REAL kernel through the Pallas
+        # interpreter on CPU (mirrors MPT_STEM_INTERPRET — how the driver-
+        # level tests exercise the kernel + shard_map path without a TPU).
+        if env_flag("MPT_HEAD_INTERPRET"):
+            interpret = True
+        elif not tpu_backend():
             return head_predict_reference(feats, w, b, labels)
-        interpret = False
-    if (kernel_rows or feats.shape[0]) > PREDICT_MAX_ROWS and not interpret:
-        # Envelope (measured): at 4096 rows the [rows, BLOCK_V] f32 logits
-        # block exceeds the scoped-VMEM budget and the TPU compile rejects;
-        # larger batches take the XLA path rather than failing. Under a
-        # partitioned multi-chip call, pass ``kernel_rows`` = the PER-CHIP
-        # row count (feats.shape[0] is the global batch inside jit).
+        else:
+            interpret = False
+    n_data = 1
+    if dp_mesh is not None:
+        from mpi_pytorch_tpu.parallel.compat import axis_is_manual
+
+        # Already inside a shard_map over the data axis → the rows are
+        # per-shard and nesting over the same axis is an error.
+        if not axis_is_manual(dp_mesh.axis_names[0]):
+            n_data = dp_mesh.shape[dp_mesh.axis_names[0]]
+    rows = feats.shape[0]
+    if rows % n_data:
+        return head_predict_reference(feats, w, b, labels)
+    block_r = _predict_row_block(rows // n_data)
+    if block_r is None:
         return head_predict_reference(feats, w, b, labels)
     labels = labels.astype(jnp.int32)
-    wp, bp, v = _pad_wb(w, b, _BLOCK_V)
-    bsz, d = feats.shape
-    grid = wp.shape[1] // _BLOCK_V
-    loss, pred, *_ = pl.pallas_call(
-        _predict_kernel,
-        grid=(grid,),
-        in_specs=[
-            pl.BlockSpec((bsz, 1), lambda j: (0, 0)),  # labels
-            pl.BlockSpec((bsz, d), lambda j: (0, 0)),  # feats (resident)
-            pl.BlockSpec((d, _BLOCK_V), lambda j: (0, j)),  # W block
-            pl.BlockSpec((1, _BLOCK_V), lambda j: (0, j)),  # bias block
-        ],
-        out_specs=[
-            pl.BlockSpec((bsz, 1), lambda j: (0, 0)),  # loss
-            pl.BlockSpec((bsz, 1), lambda j: (0, 0)),  # pred
-            pl.BlockSpec((bsz, 1), lambda j: (0, 0)),  # m
-            pl.BlockSpec((bsz, 1), lambda j: (0, 0)),  # l
-            pl.BlockSpec((bsz, 1), lambda j: (0, 0)),  # picked
-            pl.BlockSpec((bsz, 1), lambda j: (0, 0)),  # arg
-        ],
-        out_shape=[jax.ShapeDtypeStruct((bsz, 1), jnp.float32)] * 6,
-        interpret=interpret,
-    )(
-        labels.reshape(bsz, 1), feats.astype(jnp.bfloat16), wp,
-        bp.reshape(1, -1),
-    )
-    return loss[:, 0], pred[:, 0].astype(jnp.int32)
+    # Compute dtype = the feature dtype: bf16 halves W's VMEM stream (the
+    # bandwidth win) for the production bf16 head; f32 models stay f32.
+    kdtype = jnp.bfloat16 if feats.dtype == jnp.bfloat16 else jnp.float32
+    wp, bp, v = _pad_wb(w, b, _BLOCK_V, dtype=kdtype)
+    feats = feats.astype(kdtype)
+    call = functools.partial(_predict_call, block_r=block_r, interpret=interpret)
+    if n_data > 1:
+        from jax.sharding import PartitionSpec as P
+
+        from mpi_pytorch_tpu.parallel.compat import shard_map
+
+        axis = dp_mesh.axis_names[0]
+        return shard_map(
+            call,
+            mesh=dp_mesh,
+            in_specs=(P(axis), P(axis), P(), P()),
+            out_specs=(P(axis), P(axis)),
+            check_vma=False,
+        )(labels, feats, wp, bp)
+    return call(labels, feats, wp, bp)
 
 
 def head_ce_reference(feats, w, b, labels) -> jnp.ndarray:

@@ -79,6 +79,40 @@ Non-TPU backends fall back to the identical-math XLA composition
 (``_reference_impl``), mirroring ``ops/flash_attention.py``'s gating;
 ``MPT_STEM_INTERPRET=1`` drives the real kernel through the Pallas
 interpreter on CPU (how the tests run it).
+
+Multi-chip: pass ``dp_mesh`` (the training mesh) and the public wrapper
+``shard_map``s the kernel over the mesh's leading (data) axis — each chip
+runs the Mosaic call on its own batch shard, which is exactly the shape
+regime the kernel was tuned for, instead of XLA replicating the call's
+operands behind an activation all-gather (a Mosaic custom call has no
+GSPMD partitioning rule of its own). The BN affine (a, b) stays replicated
+(``P()``), and shard_map's transpose psums the per-shard da/db cotangents,
+so gradients equal the single-call gradients exactly. Inside an ALREADY
+shard_map'd context over the same axis (the ``--spmd-mode`` train step),
+the wrapper detects the bound axis (``compat.axis_is_manual``) and runs
+the single per-shard call directly — so the mesh can be threaded
+unconditionally and spmd-mode VALIDATION (a plain-jit eval step over the
+same model) still gets the partitioned call instead of a global-batch
+replicated one.
+
+Byte-bound levers (docs/RESULTS.md §4d; the fwd runs 4.25 ms vs a 2.0 ms
+byte bound, the bwd ~6.1 vs 3.6): four candidates are implemented behind
+env gates, each microbenched by ``tools/bench_stem.py --levers`` and
+recorded as a ship-or-rejection row in §4d —
+
+- ``MPT_STEM_BF16_POOL=1``  — pooling compares/phases in bf16 (halves the
+  in-VMEM f32 working set; the affine stays f32);
+- ``MPT_STEM_LANES=256``    — 256-image batch block (two full vregs per op);
+- ``MPT_STEM_IDX_INT8=1``   — int8 window-argmax storage (k ∈ [0, 8] needs
+  4 bits; halves the idx tensor's HBM traffic vs bf16);
+- ``MPT_STEM_C_BLOCK=16``   — 16-channel sublane block (half the grid
+  steps at the same per-step tile bytes).
+
+All four preserve the reference semantics, pinned per-lever (values and
+all three gradients) in tests/test_fused_stem.py. Three are exact
+re-tilings; bf16 pooling is pinned tightly against pooling over
+bf16-ROUNDED activations (rounding is monotone, so window winners and
+first-match tie semantics transfer exactly).
 """
 
 from __future__ import annotations
@@ -96,8 +130,22 @@ _NEG = float("-inf")
 # Pool geometry is fixed: the torchvision stem (3×3, stride 2, pad 1).
 _WIN, _STRIDE, _PAD = 3, 2, 1
 
-# Channels per grid step (sublane dim: 8 = one full f32 sublane tile).
+# Channels per grid step (sublane dim: 8 = one full f32 sublane tile;
+# MPT_STEM_C_BLOCK=16 is the measured-lever override — see module docstring).
 _C_BLOCK = 8
+
+
+def _levers() -> dict:
+    """The §4d byte-bound lever configuration, read from the env at trace
+    time (defaults = the shipped round-5 kernel)."""
+    from mpi_pytorch_tpu.utils.env import env_flag
+
+    return {
+        "c_block": int(os.environ.get("MPT_STEM_C_BLOCK", str(_C_BLOCK))),
+        "lanes": int(os.environ.get("MPT_STEM_LANES", "128")),
+        "bf16_pool": env_flag("MPT_STEM_BF16_POOL"),
+        "idx_int8": env_flag("MPT_STEM_IDX_INT8"),
+    }
 
 # Mosaic's stack allocation for the fold's temporaries exceeds the 16 MB
 # default scoped-vmem budget at useful block sizes; v5e has 128 MB
@@ -171,8 +219,10 @@ def _interleave(e, o, axis):
 
 def _pool_argmax_t(z):
     """3×3/s2/p1 max-pool + first-match argmax of ``z`` [H, W, C, B]
-    (T-space). Returns (pooled [H/2, W/2, C, B], k [same], k = dh·3+dw)."""
-    neg = jnp.float32(_NEG)
+    (T-space). Returns (pooled [H/2, W/2, C, B], k [same], k = dh·3+dw).
+    Dtype-generic: runs in ``z.dtype`` (f32, or bf16 under the
+    MPT_STEM_BF16_POOL lever — phase codes 0..8 are exact in bf16)."""
+    neg = jnp.asarray(_NEG, z.dtype)
     # --- column pass at every row: fold over dw ∈ {0,1,2} -------------
     cm = _shift(z, 1, -1, neg)  # z[w-1]  (dw=0 candidate)
     cp = _shift(z, 1, +1, neg)  # z[w+1]  (dw=2)
@@ -204,23 +254,30 @@ def _pool_argmax_t(z):
     return bv, bdh * 3.0 + bdw
 
 
-def _fwd_kernel(yt_ref, a_ref, b_ref, out_ref, idx_ref):
+def _fwd_kernel(yt_ref, a_ref, b_ref, out_ref, idx_ref, *, bf16_pool=False):
     yt = yt_ref[...].astype(jnp.float32)  # [H, W, C_blk, B_blk]
     a = a_ref[...].reshape(1, 1, a_ref.shape[0], 1)
     b = b_ref[...].reshape(1, 1, b_ref.shape[0], 1)
     z = jax.nn.relu(yt * a + b)
+    if bf16_pool:
+        # Lever: the affine is exact in f32; the pool fold's working set
+        # (3 candidate tensors + phases) drops to half the VMEM bytes. The
+        # pooled VALUE is bf16-rounded — the same rounding the bf16 output
+        # store applies anyway — and near-ties within bf16 eps may pick a
+        # different (equal-value) window than the f32 fold.
+        z = z.astype(jnp.bfloat16)
     best, bestk = _pool_argmax_t(z)
     out_ref[...] = best.astype(out_ref.dtype)
     if idx_ref is not None:
         idx_ref[...] = bestk.astype(idx_ref.dtype)
 
 
-def _primal_kernel(yt_ref, a_ref, b_ref, out_ref):
-    _fwd_kernel(yt_ref, a_ref, b_ref, out_ref, None)
+def _primal_kernel(yt_ref, a_ref, b_ref, out_ref, *, bf16_pool=False):
+    _fwd_kernel(yt_ref, a_ref, b_ref, out_ref, None, bf16_pool=bf16_pool)
 
 
 def _bwd_kernel(g_ref, idx_ref, pooled_ref, yt_ref, a_ref,
-                dy_ref, da_ref, db_ref, da_scr, db_scr, *, n_c, n_b):
+                dy_ref, da_ref, db_ref, da_scr, db_scr, *, n_c, n_b, nc):
     jc, ib = pl.program_id(0), pl.program_id(1)
 
     @pl.when((jc == 0) & (ib == 0))
@@ -256,7 +313,7 @@ def _bwd_kernel(g_ref, idx_ref, pooled_ref, yt_ref, a_ref,
     # Accumulate into lane jc via a one-hot mask: a dynamic lane index in
     # a scratch store is not provably 128-aligned for Mosaic.
     onehot = (
-        lax.broadcasted_iota(jnp.int32, (_C_BLOCK, 128), 1) == jc
+        lax.broadcasted_iota(jnp.int32, (nc, 128), 1) == jc
     ).astype(jnp.float32)
     da_scr[:, :] += red_a[:, None] * onehot
     db_scr[:, :] += red_b[:, None] * onehot
@@ -267,11 +324,12 @@ def _bwd_kernel(g_ref, idx_ref, pooled_ref, yt_ref, a_ref,
         db_ref[:] = db_scr[:]
 
 
-def _lane_block(bsz: int) -> int:
+def _lane_block(bsz: int, max_lanes: int = 128) -> int:
     """Batch images per grid step (the lane dim): a full 128-lane tile
-    when the batch allows it."""
-    for nb in (128, 64, 32, 16, 8, 4, 2):
-        if bsz % nb == 0:
+    when the batch allows it — or two (MPT_STEM_LANES=256, the §4d lever:
+    every vector op then covers two full vregs per sublane row)."""
+    for nb in (256, 128, 64, 32, 16, 8, 4, 2):
+        if nb <= max_lanes and bsz % nb == 0:
             return nb
     return 1
 
@@ -285,8 +343,9 @@ def _check_shapes(y, a, b):
 
 
 def _fwd_impl(yt, a, b, *, want_idx, interpret):
+    lev = _levers()
     h, w, c, bsz = yt.shape
-    nb, nc = _lane_block(bsz), _C_BLOCK
+    nb, nc = _lane_block(bsz, lev["lanes"]), lev["c_block"]
     a2 = a.astype(jnp.float32).reshape(c, 1)
     b2 = b.astype(jnp.float32).reshape(c, 1)
     h2, w2 = h // 2, w // 2
@@ -297,21 +356,22 @@ def _fwd_impl(yt, a, b, *, want_idx, interpret):
     ]
     out_spec = pl.BlockSpec((h2, w2, nc, nb), lambda j, i: (0, 0, j, i))
     grid = (c // nc, bsz // nb)
+    idx_dtype = jnp.int8 if lev["idx_int8"] else jnp.bfloat16
     if want_idx:
         return pl.pallas_call(
-            _fwd_kernel,
+            functools.partial(_fwd_kernel, bf16_pool=lev["bf16_pool"]),
             grid=grid,
             in_specs=in_specs,
             out_specs=[out_spec, out_spec],
             out_shape=[
                 jax.ShapeDtypeStruct((h2, w2, c, bsz), yt.dtype),
-                jax.ShapeDtypeStruct((h2, w2, c, bsz), jnp.bfloat16),
+                jax.ShapeDtypeStruct((h2, w2, c, bsz), idx_dtype),
             ],
             interpret=interpret,
             compiler_params=_tpu_params() if not interpret else None,
         )(yt, a2, b2)
     return pl.pallas_call(
-        _primal_kernel,
+        functools.partial(_primal_kernel, bf16_pool=lev["bf16_pool"]),
         grid=grid,
         in_specs=in_specs,
         out_specs=out_spec,
@@ -324,14 +384,15 @@ def _fwd_impl(yt, a, b, *, want_idx, interpret):
 def _bwd_impl(gt, idxt, pooledt, yt, a, *, interpret):
     from jax.experimental.pallas import tpu as pltpu
 
+    lev = _levers()
     h, w, c, bsz = yt.shape
-    nb, nc = _lane_block(bsz), _C_BLOCK
+    nb, nc = _lane_block(bsz, lev["lanes"]), lev["c_block"]
     h2, w2 = h // 2, w // 2
     a2 = a.astype(jnp.float32).reshape(c, 1)
     small = pl.BlockSpec((h2, w2, nc, nb), lambda j, i: (0, 0, j, i))
     big = pl.BlockSpec((h, w, nc, nb), lambda j, i: (0, 0, j, i))
     dyt, da8, db8 = pl.pallas_call(
-        functools.partial(_bwd_kernel, n_c=c // nc, n_b=bsz // nb),
+        functools.partial(_bwd_kernel, n_c=c // nc, n_b=bsz // nb, nc=nc),
         grid=(c // nc, bsz // nb),
         in_specs=[
             small,  # g
@@ -342,23 +403,23 @@ def _bwd_impl(gt, idxt, pooledt, yt, a, *, interpret):
         ],
         out_specs=[
             big,
-            pl.BlockSpec((_C_BLOCK, 128), lambda j, i: (0, 0)),
-            pl.BlockSpec((_C_BLOCK, 128), lambda j, i: (0, 0)),
+            pl.BlockSpec((nc, 128), lambda j, i: (0, 0)),
+            pl.BlockSpec((nc, 128), lambda j, i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((h, w, c, bsz), yt.dtype),
-            jax.ShapeDtypeStruct((_C_BLOCK, 128), jnp.float32),
-            jax.ShapeDtypeStruct((_C_BLOCK, 128), jnp.float32),
+            jax.ShapeDtypeStruct((nc, 128), jnp.float32),
+            jax.ShapeDtypeStruct((nc, 128), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((_C_BLOCK, 128), jnp.float32),
-            pltpu.VMEM((_C_BLOCK, 128), jnp.float32),
+            pltpu.VMEM((nc, 128), jnp.float32),
+            pltpu.VMEM((nc, 128), jnp.float32),
         ],
         interpret=interpret,
         compiler_params=_tpu_params() if not interpret else None,
     )(gt, idxt, pooledt, yt, a2)
-    # scr[s, j] = grad for channel j*_C_BLOCK + s.
-    n_c = c // _C_BLOCK
+    # scr[s, j] = grad for channel j*nc + s.
+    n_c = c // nc
     da = jnp.transpose(da8[:, :n_c]).reshape(c)
     db = jnp.transpose(db8[:, :n_c]).reshape(c)
     return dyt, da, db
@@ -383,7 +444,15 @@ def _stem_pool_t_bwd(interpret, res, gt):
 _stem_pool_t.defvjp(_stem_pool_t_fwd, _stem_pool_t_bwd)
 
 
-def stem_affine_relu_pool(y, a, b, *, interpret: bool | None = None):
+def _stem_call(y, a, b, interpret):
+    """One (per-shard) kernel invocation: T-space transpose wrappers around
+    the custom-vjp Pallas pair."""
+    yt = jnp.transpose(y, (1, 2, 3, 0))
+    outt = _stem_pool_t(yt, a, b, interpret)
+    return jnp.transpose(outt, (3, 0, 1, 2))
+
+
+def stem_affine_relu_pool(y, a, b, *, interpret: bool | None = None, dp_mesh=None):
     """``max_pool3x3s2p1(relu(y·a + b))`` fused in VMEM, differentiable.
 
     ``y``: [B, H, W, C] (H, W even), any float dtype (bf16 in
@@ -398,21 +467,54 @@ def stem_affine_relu_pool(y, a, b, *, interpret: bool | None = None):
     ``interpret``: None = Pallas kernel on TPU, XLA composition elsewhere
     (or the Pallas interpreter when ``MPT_STEM_INTERPRET`` is set); True
     forces the interpreter; False forces the compiled kernel.
-    """
+
+    ``dp_mesh``: the training/eval mesh. When its leading (data) axis has
+    >1 device, the kernel call is ``shard_map``-partitioned over that axis
+    — each device runs the Mosaic call on its batch shard (see module
+    docstring, Multi-chip). The batch must divide the axis (the trainer
+    validates this; indivisible batches fall back to the XLA composition
+    rather than silently replicating the call). If the axis is ALREADY
+    bound (calling from inside the spmd-mode step's shard_map), the
+    per-shard call runs directly — no nesting."""
     from mpi_pytorch_tpu.utils.hardware import tpu_backend
 
     _check_shapes(y, a, b)
-    if y.shape[-1] % _C_BLOCK:
-        # Channel count must tile the sublane block; every 7×7 stem in
-        # the zoo has C=64. Anything else takes the XLA path.
+    n_data = 1
+    if dp_mesh is not None:
+        from mpi_pytorch_tpu.parallel.compat import axis_is_manual
+
+        axis = dp_mesh.axis_names[0]
+        # Inside a shard_map over the data axis (the spmd-mode train step)
+        # the operands are already per-shard and a nested wrap over the
+        # same axis would be an error — run the single call directly.
+        if not axis_is_manual(axis):
+            n_data = dp_mesh.shape[axis]
+    if y.shape[-1] % _levers()["c_block"] or (n_data > 1 and y.shape[0] % n_data):
+        # Channel count must tile the sublane block (every 7×7 stem in the
+        # zoo has C=64) and the batch must tile the data axis. Anything
+        # else takes the XLA path.
         return _reference_impl(y, a, b)
     if interpret is None:
-        if os.environ.get("MPT_STEM_INTERPRET"):
+        from mpi_pytorch_tpu.utils.env import env_flag
+
+        if env_flag("MPT_STEM_INTERPRET"):
             interpret = True
         elif not tpu_backend():
             return _reference_impl(y, a, b)
         else:
             interpret = False
-    yt = jnp.transpose(y, (1, 2, 3, 0))
-    outt = _stem_pool_t(yt, a.astype(jnp.float32), b.astype(jnp.float32), interpret)
-    return jnp.transpose(outt, (3, 0, 1, 2))
+    a32, b32 = a.astype(jnp.float32), b.astype(jnp.float32)
+    if n_data > 1:
+        from jax.sharding import PartitionSpec as P
+
+        from mpi_pytorch_tpu.parallel.compat import shard_map
+
+        axis = dp_mesh.axis_names[0]
+        return shard_map(
+            functools.partial(_stem_call, interpret=interpret),
+            mesh=dp_mesh,
+            in_specs=(P(axis), P(), P()),
+            out_specs=P(axis),
+            check_vma=False,
+        )(y, a32, b32)
+    return _stem_call(y, a32, b32, interpret)

@@ -32,8 +32,9 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from mpi_pytorch_tpu.parallel.compat import shard_map
 
 
 def init_moe_params(rng, d_model: int, d_hidden: int, num_experts: int) -> dict:

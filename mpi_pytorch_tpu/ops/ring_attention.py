@@ -25,8 +25,9 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from mpi_pytorch_tpu.parallel.compat import shard_map
 
 
 def full_attention(q, k, v, *, causal: bool = False) -> jnp.ndarray:

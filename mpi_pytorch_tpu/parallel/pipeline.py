@@ -37,10 +37,10 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mpi_pytorch_tpu.parallel import collectives
+from mpi_pytorch_tpu.parallel.compat import shard_map
 
 
 def stack_stage_params(per_stage_params: list) -> object:

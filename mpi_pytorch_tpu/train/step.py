@@ -31,7 +31,8 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from mpi_pytorch_tpu.parallel.compat import shard_map
 
 from mpi_pytorch_tpu.config import IMAGENET_MEAN, IMAGENET_STD
 from mpi_pytorch_tpu.ops.losses import accuracy_count, classification_loss, valid_count

@@ -138,30 +138,6 @@ def _dtype(name: str):
     return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[name]
 
 
-def warn_fused_stem_spmd(cfg: Config, mesh) -> None:
-    """A Mosaic custom call has no GSPMD partitioning rule: under a
-    multi-device data axis XLA keeps the math correct by replicating the
-    call's operands (an all-gather of the conv activation). The kernel's
-    measured win is single-chip; warn rather than fail so CPU-mesh tests
-    and small experiments still run. Shared by the train AND eval
-    builders — both construct the same fused-stem model.
-
-    ``--spmd-mode`` is exempt: its shard_map step hands the kernel
-    PER-SHARD batches, so the call partitions correctly — that pairing is
-    the multi-chip fused-stem recipe."""
-    if (
-        cfg.fused_stem
-        and not cfg.spmd_mode
-        and mesh.shape[mesh.axis_names[0]] > 1
-    ):
-        run_logger().warning(
-            "--fused-stem on a %d-way data axis: the stem kernel is not "
-            "SPMD-partitioned; expect an activation all-gather around it "
-            "(single-chip is the measured envelope, docs/RESULTS.md §4d)",
-            mesh.shape[mesh.axis_names[0]],
-        )
-
-
 def build_training(cfg: Config, mesh=None):
     """Construct (mesh, bundle, state, loaders, step fns) for cfg — shared by
     the trainer, the eval pipeline, and the graft entry points."""
@@ -230,8 +206,14 @@ def build_training(cfg: Config, mesh=None):
         qkv_fused=cfg.qkv_fused,
         stem_s2d=cfg.stem_s2d,
         fused_stem=cfg.fused_stem,
+        # Multi-chip fused stem: the model shard_maps the Mosaic call over
+        # the mesh's data axis (ops/fused_stem.py, Multi-chip). Threaded
+        # in spmd mode too: inside the spmd step's shard_map the wrapper
+        # detects the bound axis and runs the per-shard call directly,
+        # while spmd-mode VALIDATION (plain-jit eval over the same model)
+        # still gets the partitioned call.
+        dp_mesh=mesh if cfg.fused_stem else None,
     )
-    warn_fused_stem_spmd(cfg, mesh)
     # Total optimizer steps for cosine-style schedules: the globally-computed
     # per-epoch step count (identical on every host) x epochs.
     total_steps = (

@@ -1,0 +1,18 @@
+"""Boolean ``MPT_*`` env-knob parsing — ONE definition of truthiness.
+
+Every boolean knob in the framework reads through here so the convention
+(case-insensitive; '', '0', 'false' mean off, anything else means on)
+cannot drift between call sites.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """The value of boolean env knob ``name``; ``default`` when unset."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.lower() not in ("", "0", "false")

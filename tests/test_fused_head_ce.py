@@ -110,16 +110,92 @@ def test_head_predict_cross_block_tie_prefers_first():
     np.testing.assert_array_equal(np.asarray(preds), [100, 100])
 
 
-@pytest.mark.parametrize("n_data", [1, 8])
-def test_fused_head_predict_step_matches_plain(tmp_path, n_data):
-    """The eval driver's fused-head predict step returns the same metrics
-    and predictions as the plain logits-materializing step, through a real
-    zoo model. n_data=1 exercises the interceptor + streamed-head path;
-    n_data=8 exercises the multi-data-axis gate (a Mosaic call has no
-    GSPMD rule, so the fused build must fall back to the plain step)."""
+@pytest.mark.parametrize("rows", [2048, 4096])
+def test_head_predict_row_tiled_beyond_envelope(rows):
+    """Batches beyond PREDICT_MAX_ROWS stream through the kernel's row
+    tiling (a (rows, vocab) grid) instead of falling back — the former
+    B=4096 compile-rejection envelope is now an internal loop. Cross-ROW-
+    BLOCK independence is pinned by exact agreement with the reference on
+    every row."""
+    from mpi_pytorch_tpu.ops.fused_head_ce import (
+        PREDICT_MAX_ROWS,
+        _predict_row_block,
+        head_predict,
+        head_predict_reference,
+    )
+
+    assert rows > PREDICT_MAX_ROWS
+    assert _predict_row_block(rows) == PREDICT_MAX_ROWS  # tiled, not fallback
+    rng = np.random.default_rng(2)
+    feats = jnp.asarray(rng.normal(size=(rows, 32)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(32, 600)) * 0.05, jnp.float32)
+    b = jnp.asarray(rng.normal(size=(600,)) * 0.1, jnp.float32)
+    labels = np.asarray(rng.integers(0, 600, size=(rows,)), np.int32)
+    labels[5] = -1
+    labels[rows - 1] = -1  # padding in the LAST row block
+    loss, preds = head_predict(feats, w, b, jnp.asarray(labels), interpret=True)
+    rl, rp = head_predict_reference(feats, w, b, jnp.asarray(labels))
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(rl), rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(preds), np.asarray(rp))
+    assert float(loss[5]) == 0.0 and float(loss[rows - 1]) == 0.0
+
+
+def test_head_predict_keeps_f32_compute():
+    """An f32-compute model must NOT be silently downcast: with f32
+    features the kernel matmuls in f32 and matches the f32 reference to
+    f32 tolerance (the bf16 cast is gated on the feature dtype)."""
+    from mpi_pytorch_tpu.ops.fused_head_ce import head_predict, head_predict_reference
+
+    rng = np.random.default_rng(3)
+    # NOT bf16-grid-aligned: a silent bf16 downcast would show up as
+    # rounding well above the assertion tolerance.
+    feats = jnp.asarray(rng.normal(size=(B, D)) * (1 + 1e-4), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(D, V)) * 0.05, jnp.float32)
+    b = jnp.asarray(rng.normal(size=(V,)) * 0.1, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, V, size=(B,)), np.int32)
+    loss, preds = head_predict(feats, w, b, labels, interpret=True)
+    rl, rp = head_predict_reference(feats, w, b, labels)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(rl), rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(preds), np.asarray(rp))
+
+
+def test_head_predict_shard_map_multi_device():
+    """dp_mesh partitions the kernel call over the 8-device data axis:
+    per-row losses and predictions equal the single-call/reference output
+    exactly (each device streams its own row shard; W/b replicated)."""
     from jax.sharding import Mesh
 
-    from mpi_pytorch_tpu.evaluate import _make_predict_step
+    from mpi_pytorch_tpu.ops.fused_head_ce import head_predict, head_predict_reference
+
+    n = len(jax.devices())
+    assert n == 8  # conftest virtual-CPU mesh
+    mesh = Mesh(np.array(jax.devices()).reshape(n, 1), ("data", "model"))
+    rng = np.random.default_rng(4)
+    rows = 16 * n
+    feats = jnp.asarray(rng.normal(size=(rows, 32)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(32, 600)) * 0.05, jnp.float32)
+    b = jnp.asarray(rng.normal(size=(600,)) * 0.1, jnp.float32)
+    labels = np.asarray(rng.integers(0, 600, size=(rows,)), np.int32)
+    labels[0] = -1
+    loss, preds = head_predict(
+        feats, w, b, jnp.asarray(labels), interpret=True, dp_mesh=mesh
+    )
+    rl, rp = head_predict_reference(feats, w, b, jnp.asarray(labels))
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(rl), rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(preds), np.asarray(rp))
+
+
+@pytest.mark.parametrize("n_data", [1, 8])
+def test_fused_head_predict_step_matches_plain(tmp_path, monkeypatch, n_data):
+    """The eval driver's fused-head predict step returns the same metrics
+    and predictions as the plain logits-materializing step, through a real
+    zoo model — with the REAL kernel (Pallas interpreter) on BOTH mesh
+    shapes. n_data=8 drives the shard_map-partitioned multi-data-axis path
+    (formerly a silent fallback to the plain step; now each device runs
+    the kernel on its own row shard)."""
+    from jax.sharding import Mesh
+
+    from mpi_pytorch_tpu.evaluate import _make_predict_step, _make_predict_step_impl
     from mpi_pytorch_tpu.models import create_model_bundle
     from mpi_pytorch_tpu.train.state import TrainState
 
@@ -139,18 +215,22 @@ def test_fused_head_predict_step_matches_plain(tmp_path, n_data):
     labels = np.asarray([3, 5, -1, 9, 0, 1, -1, 7], np.int32)
     batch = (jnp.asarray(images), jnp.asarray(labels))
 
-    plain = _make_predict_step(mesh, jnp.float32)
-    fused = _make_predict_step(mesh, jnp.float32, fused_head=True)
-    if n_data > 1:
-        # The multi-data-axis gate must return the PLAIN step itself (the
-        # lru-cached object), not a fused build at the global batch — on
-        # CPU both produce equal outputs either way, so object identity is
-        # the only signal that the gate actually fired.
-        assert fused is plain
-    else:
+    # The interpret gate is read at TRACE time, and the step builder is
+    # lru-cached on (mesh, dtype, fused) — clear so this env takes effect
+    # and does not leak into other tests' builds.
+    monkeypatch.setenv("MPT_HEAD_INTERPRET", "1")
+    _make_predict_step_impl.cache_clear()
+    try:
+        plain = _make_predict_step(mesh, jnp.float32)
+        fused = _make_predict_step(mesh, jnp.float32, fused_head=True)
+        # No more multi-axis fallback: the fused build is its own step on
+        # EVERY mesh shape (the n_data>1 case shard_maps the kernel).
         assert fused is not plain
-    m1, p1 = plain(state, batch)
-    m2, p2 = fused(state, batch)
+        m1, p1 = plain(state, batch)
+        m2, p2 = fused(state, batch)
+    finally:
+        monkeypatch.delenv("MPT_HEAD_INTERPRET")
+        _make_predict_step_impl.cache_clear()
     np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
     for k in ("loss", "correct", "count"):
         np.testing.assert_allclose(

@@ -66,6 +66,147 @@ def test_bf16_storage_roundtrip(rng):
     )
 
 
+def _bf16_pool_reference(y, a, b):
+    """XLA reference for the MPT_STEM_BF16_POOL lever: pooling over the
+    bf16-ROUNDED post-relu activations. Rounding is monotone (a ≥ b ⇒
+    bf16(a) ≥ bf16(b)), so the window winner and reduce_window's row-major
+    first-match tie semantics transfer exactly — value AND gradient
+    routing are pinned tightly against this, not loosely against f32.
+
+    The rounding is STRAIGHT-THROUGH (stop_gradient) to mirror the kernel
+    exactly: bf16 values pick the winner, but the backward routes the
+    FULL-PRECISION f32 cotangent — a plain .astype chain would instead
+    bf16-round the cotangent sums at positions winning several windows."""
+    from jax import lax
+
+    from mpi_pytorch_tpu.ops.fused_stem import nn_max_pool_f32
+
+    z = jax.nn.relu(y.astype(jnp.float32) * a + b)
+    z = z + lax.stop_gradient(
+        z.astype(jnp.bfloat16).astype(jnp.float32) - z
+    )
+    return nn_max_pool_f32(z).astype(y.dtype)
+
+
+_LEVERS = [
+    # (env, value, reference): the §4d byte-bound lever gates. bf16
+    # pooling is pinned against the bf16-rounded reference (see above);
+    # the other three are exact re-tilings pinned against the f32 one.
+    ("MPT_STEM_BF16_POOL", "1", _bf16_pool_reference),
+    ("MPT_STEM_LANES", "256", _reference_impl),
+    ("MPT_STEM_IDX_INT8", "1", _reference_impl),
+    ("MPT_STEM_C_BLOCK", "16", _reference_impl),
+]
+
+
+@pytest.mark.parametrize("env,val,reference", _LEVERS)
+@pytest.mark.parametrize("tie_heavy", [False, True])
+def test_levers_match_reference(rng, monkeypatch, env, val, reference, tie_heavy):
+    """Each §4d byte-bound lever (docs/RESULTS.md) preserves its reference
+    semantics — values AND all three gradients — through the real kernel
+    code path. The lever config is read from the env at trace time, so the
+    monkeypatched env drives the actual gated kernel variant. B=256 so the
+    256-lane lever genuinely widens the batch block."""
+    monkeypatch.setenv(env, val)
+    y = rng.standard_normal((256, 8, 8, C)).astype(np.float32)
+    if tie_heavy:
+        y = np.round(y * 2) / 2
+    y = jnp.asarray(y)
+    # Power-of-two scales make y·a EXACT, so a+b is the affine's only f32
+    # rounding and FMA ≡ mul+add — otherwise the kernel's and the XLA
+    # reference's 1-ulp f32 contraction differences land on bf16 rounding
+    # boundaries and the bf16-pool comparison sees spurious bf16-ulp flips.
+    a = jnp.asarray(2.0 ** rng.integers(-1, 2, C).astype(np.float32))
+    b = jnp.asarray(
+        (rng.standard_normal(C).astype(np.float32) * 0.1)
+        .astype(jnp.bfloat16)
+        .astype(np.float32)
+    )
+    got = stem_affine_relu_pool(y, a, b, interpret=True)
+    want = reference(y, a, b)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    # Cotangent on the bf16 grid: the bf16 reference's VJP rounds the
+    # cotangent through its cast (the kernel back-propagates full f32), so
+    # a bf16-exact cotangent makes the comparison rounding-free.
+    co = (
+        jnp.asarray(rng.standard_normal((256, 4, 4, C)), jnp.float32)
+        .astype(jnp.bfloat16)
+        .astype(jnp.float32)
+    )
+
+    def loss(fn):
+        return lambda y, a, b: jnp.sum(fn(y, a, b) * co)
+
+    g = jax.grad(
+        loss(lambda y, a, b: stem_affine_relu_pool(y, a, b, interpret=True)),
+        argnums=(0, 1, 2),
+    )(y, a, b)
+    r = jax.grad(loss(reference), argnums=(0, 1, 2))(y, a, b)
+    for u, v in zip(g, r):
+        np.testing.assert_allclose(u, v, rtol=1e-5, atol=1e-4)
+
+
+def test_idx_int8_lever_changes_residual_dtype(rng, monkeypatch):
+    """The int8-argmax lever must actually store int8 (the HBM-traffic
+    halving is the point) — pinned on the fwd-with-idx output directly."""
+    from mpi_pytorch_tpu.ops.fused_stem import _fwd_impl
+
+    y, a, b = _inputs(rng)
+    yt = jnp.transpose(y, (1, 2, 3, 0))
+    _, idx = _fwd_impl(
+        yt, a, b, want_idx=True, interpret=True
+    )
+    assert idx.dtype == jnp.bfloat16  # default storage
+    monkeypatch.setenv("MPT_STEM_IDX_INT8", "1")
+    _, idx8 = _fwd_impl(yt, a, b, want_idx=True, interpret=True)
+    assert idx8.dtype == jnp.int8
+    np.testing.assert_array_equal(
+        np.asarray(idx, np.int32), np.asarray(idx8, np.int32)
+    )
+
+
+def test_shard_map_multi_device_matches_single_call(rng):
+    """dp_mesh partitions the kernel over the 8-device data axis: values
+    and all three gradients equal the reference (the da/db cotangents are
+    psum-reduced across shards by shard_map's transpose)."""
+    from jax.sharding import Mesh
+
+    n = len(jax.devices())
+    assert n == 8  # conftest virtual-CPU mesh
+    mesh = Mesh(np.array(jax.devices()).reshape(n, 1), ("data", "model"))
+    y = jnp.asarray(rng.standard_normal((2 * n, H, W, C)), jnp.float32)
+    a = jnp.asarray((0.5 + rng.random(C)).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal(C).astype(np.float32) * 0.1)
+    got = stem_affine_relu_pool(y, a, b, interpret=True, dp_mesh=mesh)
+    want = _reference_impl(y, a, b)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    co = jnp.asarray(rng.standard_normal((2 * n, H // 2, W // 2, C)), jnp.float32)
+
+    def loss(fn):
+        return lambda y, a, b: jnp.sum(fn(y, a, b) * co)
+
+    g = jax.grad(
+        loss(lambda y, a, b: stem_affine_relu_pool(
+            y, a, b, interpret=True, dp_mesh=mesh
+        )),
+        argnums=(0, 1, 2),
+    )(y, a, b)
+    r = jax.grad(loss(_reference_impl), argnums=(0, 1, 2))(y, a, b)
+    np.testing.assert_allclose(g[0], r[0], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(g[1], r[1], rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(g[2], r[2], rtol=1e-5, atol=1e-4)
+
+    # An indivisible batch must take the XLA path (never replicate the
+    # Mosaic call), still producing reference values.
+    y_odd = y[: 2 * n - 1]
+    got_odd = stem_affine_relu_pool(y_odd, a, b, interpret=True, dp_mesh=mesh)
+    np.testing.assert_allclose(
+        got_odd, _reference_impl(y_odd, a, b), rtol=1e-6, atol=1e-6
+    )
+
+
 def test_shape_guards(rng):
     y, a, b = _inputs(rng)
     with pytest.raises(ValueError):
@@ -122,6 +263,50 @@ def test_fused_stem_training_matches_unfused(rng, monkeypatch, tmp_path):
     # correct-but-not-bit-identical op orderings (measured: 1e-6 after
     # epoch 1, 1e-3 after epoch 2) — gradient EXACTNESS is pinned tightly
     # in test_gradients_match_reference; this test pins the integration.
+    np.testing.assert_allclose(
+        fused.epoch_losses[:1], plain.epoch_losses[:1], rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        fused.epoch_losses, plain.epoch_losses, rtol=1e-2, atol=1e-2
+    )
+
+
+def test_spmd_fused_stem_training_matches_unfused(rng, monkeypatch, tmp_path):
+    """The multi-chip recipe, pinned (VERDICT r5 #3): ``--spmd-mode`` +
+    ``--fused-stem`` on the 8-device CPU mesh, REAL kernel code path
+    (Pallas interpreter), epoch losses ≡ the unfused spmd run. In spmd
+    mode the step itself is a shard_map handing the kernel PER-SHARD
+    batches (the trainer passes no dp_mesh), so this drives exactly the
+    partitioned regime the kernel sees on a multi-chip pod.
+
+    Batch 64 → 8 images per shard: at per-shard batch 2 the folded affine's
+    float rounding near relu boundaries, amplified by noisy 2-image local-BN
+    variances, drifts the trajectories ~1e-2 (measured; same equivalence
+    class the auto-mode test tolerates at later epochs) — 8/shard is both
+    the realistic regime and numerically tight."""
+    import os
+
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.train.trainer import train
+
+    def cfg(fused, sub):
+        c = Config(
+            model_name="resnet18", num_classes=200, batch_size=64,
+            num_epochs=2, debug=True, debug_sample_size=128,
+            synthetic_data=True, compute_dtype="float32",
+            width=32, height=32, fused_stem=fused, spmd_mode=True,
+            validate=False, loader_workers=2, log_every_steps=0,
+            metrics_file="",
+            checkpoint_dir=os.path.join(str(tmp_path), sub),
+            log_file=os.path.join(str(tmp_path), sub + ".log"),
+        )
+        c.validate_config()
+        return c
+
+    monkeypatch.setenv("MPT_STEM_INTERPRET", "1")
+    fused = train(cfg(True, "sf"))
+    monkeypatch.delenv("MPT_STEM_INTERPRET")
+    plain = train(cfg(False, "sp"))
     np.testing.assert_allclose(
         fused.epoch_losses[:1], plain.epoch_losses[:1], rtol=2e-4, atol=2e-4
     )

@@ -8,9 +8,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
 from mpi_pytorch_tpu.config import MeshConfig
+from mpi_pytorch_tpu.parallel.compat import shard_map
 from mpi_pytorch_tpu.models import create_model_bundle
 from mpi_pytorch_tpu.parallel import collectives, create_mesh, param_specs, shard_batch
 from mpi_pytorch_tpu.train.state import TrainState, make_optimizer

@@ -111,11 +111,16 @@ def bench_head(batch: int, d: int, steps: int, warmup: int):
         return jnp.sum(loss) + jnp.sum(preds)
 
     out = []
-    from mpi_pytorch_tpu.ops.fused_head_ce import PREDICT_MAX_ROWS
+    from mpi_pytorch_tpu.ops.fused_head_ce import PREDICT_MAX_ROWS, _predict_row_block
 
+    # Row tiling (round 6): beyond PREDICT_MAX_ROWS the kernel streams
+    # ≤1024-row blocks through a (rows, vocab) grid instead of falling
+    # back — so B=4096 is now a real fused measurement, labeled with its
+    # row-block size.
+    rb = _predict_row_block(batch)
     fused_label = (
         "fused" if batch <= PREDICT_MAX_ROWS
-        else "fused(>envelope: xla fallback)"
+        else (f"fused(row-tiled rb={rb})" if rb else "fused(untileable: xla fallback)")
     )
     for label, fn in (("xla", xla_head), (fused_label, fused_head)):
         add = jax.jit(lambda acc, v: acc + v)
