@@ -152,9 +152,13 @@ class Config:
     # (ops/ring_attention.py, ops/ulysses.py). vit models only.
     sp_strategy: str = "none"
     # Dense-attention implementation for the vit_* family when sp_strategy
-    # is "none": "full" (vanilla, materializes [B,H,S,S] scores) or "flash"
-    # (Pallas block-tiled online-softmax kernel on TPU, identical-math
-    # fallback on other backends — ops/flash_attention.py).
+    # is "none": "full" (vanilla, materializes [B,H,S,S] scores), "flash"
+    # (Pallas block-tiled online-softmax kernel for long sequences —
+    # ops/flash_attention.py), or "fused-small" (Pallas tiny-S kernel:
+    # scores+softmax+AV in one VMEM pass per (batch·head) group, the
+    # S≤128 regime where flash's block machinery loses —
+    # ops/fused_attention_small.py). All TPU-only with an identical-math
+    # fallback on other backends.
     attn_impl: str = "full"
     # Fuse the q/k/v projections into one [D, 3·H·Dh] matmul (vit family;
     # same param tree, exactly the same math — models/vit.py qkv_fused).
@@ -185,10 +189,13 @@ class Config:
     # (4,4,12,64) kernel; pretrained 7×7 weights load through the exact
     # transform (models/resnet.py s2d_stem_kernel). Requires even image size.
     stem_s2d: bool = False
-    # Fused stem for the resnet family (registry.FUSED_STEM_MODELS):
-    # bn1+relu+maxpool(3,2,1) as one Pallas kernel pair (ops/fused_stem.py) —
-    # the conv1 activation never round-trips HBM between BN and the pool, and
-    # the pool backward is an index gather instead of select-and-scatter
+    # Fused stem for the identical-7×7-stem family (registry.
+    # FUSED_STEM_MODELS: resnet18/34 — the measured winners — plus
+    # densenet121, whose torchvision stem features.conv0..pool0 is the same
+    # geometry; capability-enabled, A/B staged — docs/RESULTS.md §4):
+    # BN+relu+maxpool(3,2,1) as one Pallas kernel pair (ops/fused_stem.py) —
+    # the stem-conv activation never round-trips HBM between BN and the pool,
+    # and the pool backward is an index gather instead of select-and-scatter
     # (docs/RESULTS.md §4d). Same variable tree as the unfused stem, so
     # checkpoints interchange. TPU only (XLA composition elsewhere); requires
     # even post-conv spatial dims (any even image size) and local BN.
@@ -343,24 +350,25 @@ class Config:
             raise ValueError(
                 f"sp_strategy must be none|ring|ulysses, got {self.sp_strategy!r}"
             )
-        if self.attn_impl not in ("full", "flash"):
+        if self.attn_impl not in ("full", "flash", "fused-small"):
             raise ValueError(
-                f"attn_impl must be full|flash, got {self.attn_impl!r}"
+                f"attn_impl must be full|flash|fused-small, got {self.attn_impl!r}"
             )
-        if self.attn_impl == "flash":
+        if self.attn_impl != "full":
             from mpi_pytorch_tpu.models.registry import SP_MODELS
 
             if self.model_name not in SP_MODELS:
                 raise ValueError(
-                    f"attn_impl='flash' applies only to the attention family "
-                    f"({', '.join(SP_MODELS)}); {self.model_name!r} has no "
-                    "attention"
+                    f"attn_impl={self.attn_impl!r} applies only to the "
+                    f"attention family ({', '.join(SP_MODELS)}); "
+                    f"{self.model_name!r} has no attention"
                 )
             if self.sp_strategy != "none":
                 raise ValueError(
-                    "attn_impl='flash' is the single-device dense-attention "
-                    "path; the SP strategies (--sp-strategy) already compute "
-                    "attention blockwise across chips — choose one"
+                    f"attn_impl={self.attn_impl!r} is the dense-attention "
+                    "path (data-parallel over chips); the SP strategies "
+                    "(--sp-strategy) already compute attention blockwise "
+                    "across chips — choose one"
                 )
         if self.optimizer not in ("adam", "sgd", "adamw"):
             raise ValueError(f"optimizer must be adam|sgd|adamw, got {self.optimizer!r}")

@@ -15,7 +15,12 @@ from typing import Any, Sequence
 import jax.numpy as jnp
 from flax import linen as nn
 
-from mpi_pytorch_tpu.models.common import batch_norm, global_avg_pool, max_pool
+from mpi_pytorch_tpu.models.common import (
+    FusedStemBNReluPool,
+    batch_norm,
+    global_avg_pool,
+    max_pool,
+)
 
 
 class DenseLayer(nn.Module):
@@ -78,6 +83,19 @@ class DenseNet(nn.Module):
     # backward); per-layer recompute caps that at one layer's activations.
     # Param tree paths are unchanged (lifted transforms preserve scopes).
     remat_blocks: bool = False
+    # Fuse norm0+relu+maxpool(3,2,1) into the ops/fused_stem.py Pallas
+    # kernel pair — densenet's torchvision stem (features.conv0..pool0) is
+    # geometrically IDENTICAL to the resnet stem the kernel was built for
+    # (7×7/s2/p3 conv, C=64, BN, relu, 3×3/s2/p1 pool). FusedStemBNReluPool
+    # mirrors flax BatchNorm's variable tree, so checkpoints interchange
+    # with the unfused stem. Ships flag-gated pending the chip A/B: the
+    # stem tail is only ≈3% of densenet's roofline bound (docs/RESULTS.md
+    # §4 — vs ≈17% for resnet18), so unlike the resnet family it is NOT
+    # the zoo-bench default.
+    fused_stem: bool = False
+    # Multi-chip fused stem: the mesh whose leading (data) axis the Mosaic
+    # call is shard_map-partitioned over (ops/fused_stem.py, Multi-chip).
+    dp_mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -85,11 +103,19 @@ class DenseNet(nn.Module):
             self.num_init_features, (7, 7), strides=(2, 2), padding=3, use_bias=False,
             dtype=self.dtype, param_dtype=self.param_dtype, name="conv0",
         )(x)
-        x = batch_norm("norm0", dtype=self.dtype, axis_name=self.bn_axis_name)(
-            x, use_running_average=not train
-        )
-        x = nn.relu(x)
-        x = max_pool(x, 3, 2, padding=1)
+        if self.fused_stem:
+            if self.bn_axis_name is not None:
+                raise ValueError("fused_stem does not support sync-BN (bn_axis_name)")
+            x = FusedStemBNReluPool(
+                dtype=self.dtype, param_dtype=self.param_dtype,
+                dp_mesh=self.dp_mesh, name="norm0",
+            )(x, use_running_average=not train)
+        else:
+            x = batch_norm("norm0", dtype=self.dtype, axis_name=self.bn_axis_name)(
+                x, use_running_average=not train
+            )
+            x = nn.relu(x)
+            x = max_pool(x, 3, 2, padding=1)
 
         layer_cls = (
             nn.remat(DenseLayer, static_argnums=(2,))  # (self, x, train)

@@ -103,21 +103,33 @@ def supports_remat_blocks(model_name: str) -> bool:
 S2D_MODELS = ("resnet18", "resnet34")
 
 # Architectures whose factories accept fused_stem (the bn1+relu+maxpool
-# Pallas kernel pair, ops/fused_stem.py — same 7×7-stem family; the fused
-# module mirrors flax BatchNorm's variable tree so checkpoints interchange).
-FUSED_STEM_MODELS = ("resnet18", "resnet34")
+# Pallas kernel pair, ops/fused_stem.py — the identical 7×7/s2/p3 + BN +
+# relu + 3×3/s2/p1-pool stem family; the fused module mirrors flax
+# BatchNorm's variable tree so checkpoints interchange). densenet121's
+# torchvision stem (features.conv0..pool0) is geometrically the same stem,
+# so the kernel applies — see MEASURED_FUSED_STEM_MODELS for why its bench
+# default differs.
+FUSED_STEM_MODELS = ("resnet18", "resnet34", "densenet121")
+
+# The subset whose fused stem is a MEASURED chip win (docs/RESULTS.md §4d:
+# resnet18 24.7k → 26.1k img/s). densenet121 is capability-enabled but
+# default-off: its stem tail is only ≈3% of its roofline bound and the
+# step already runs at 1.11× bound (docs/RESULTS.md §4), so it ships
+# behind --fused-stem until its own A/B row lands — the fused-head
+# discipline (measure first, default only wins).
+MEASURED_FUSED_STEM_MODELS = ("resnet18", "resnet34")
 
 
 def fused_stem_default(model_name: str) -> bool:
-    """The benchmark harnesses' shared gate: fused stem ON for the 7x7-stem
-    family on TPU unless MPT_FUSED_STEM=0 (the A/B escape hatch). The
-    trainer/eval CLIs stay explicit via ``--fused-stem``."""
+    """The benchmark harnesses' shared gate: fused stem ON for the
+    measured-win members on TPU unless MPT_FUSED_STEM=0 (the A/B escape
+    hatch). The trainer/eval CLIs stay explicit via ``--fused-stem``."""
     import jax
 
     from mpi_pytorch_tpu.utils.env import env_flag
 
     return (
-        model_name in FUSED_STEM_MODELS
+        model_name in MEASURED_FUSED_STEM_MODELS
         and env_flag("MPT_FUSED_STEM", default=True)
         and jax.devices()[0].platform == "tpu"
     )
@@ -160,6 +172,11 @@ def initialize_model(
                 "attention"
             )
         kw["attn_impl"] = attn_impl
+        if attn_impl == "fused-small" and dp_mesh is not None:
+            # Multi-chip: the attention module shard_maps its Mosaic call
+            # over this mesh's data axis (ops/fused_attention_small.py,
+            # Multi-chip) — the same contract as the fused stem below.
+            kw["dp_mesh"] = dp_mesh
     if qkv_fused:
         if model_name not in SP_MODELS:
             raise ValueError(

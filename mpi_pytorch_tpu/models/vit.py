@@ -66,9 +66,10 @@ class _ProjParams(nn.Module):
 
 class MultiHeadAttention(nn.Module):
     """MHA whose core attention is pluggable: ``sp_strategy`` of ``none``
-    (single-device attention — vanilla ``full`` or the Pallas ``flash``
-    kernel, ``attn_impl``), ``ring``, or ``ulysses`` (both SP strategies
-    shard the sequence over ``sp_mesh``'s first axis)."""
+    (single-device attention — vanilla ``full``, the Pallas ``flash``
+    kernel, or the Pallas ``fused-small`` tiny-S kernel, ``attn_impl``),
+    ``ring``, or ``ulysses`` (both SP strategies shard the sequence over
+    ``sp_mesh``'s first axis)."""
 
     num_heads: int
     dtype: Dtype = jnp.float32
@@ -77,8 +78,17 @@ class MultiHeadAttention(nn.Module):
     sp_mesh: Any = None
     # "full" materializes [B,H,S,S] scores; "flash" streams k/v blocks
     # through VMEM with an online softmax (ops/flash_attention.py — Pallas
-    # on TPU, identical-math fallback elsewhere). Same function either way.
+    # on TPU, identical-math fallback elsewhere); "fused-small" computes
+    # scores+softmax+AV in one VMEM pass per (batch·head) group — the
+    # tiny-S (S≤128) regime where flash's block machinery loses
+    # (ops/fused_attention_small.py). Same function all three ways.
     attn_impl: str = "full"
+    # Multi-chip fused-small attention: mesh whose leading (data) axis the
+    # Mosaic call shard_maps over (ops/fused_attention_small.py,
+    # Multi-chip). None = single call (single chip, or an spmd-mode step
+    # whose shard_map already hands the kernel per-shard batches). Only
+    # consulted by attn_impl='fused-small'.
+    dp_mesh: Any = None
     # One [D, 3·H·Dh] projection matmul instead of three [D, H·Dh] ones:
     # x is read once, one MXU dispatch, same param tree (docs/RESULTS.md
     # §4 vit_s16 row). Identical math — the concatenated matmul computes
@@ -88,6 +98,9 @@ class MultiHeadAttention(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         from mpi_pytorch_tpu.ops.flash_attention import flash_attention
+        from mpi_pytorch_tpu.ops.fused_attention_small import (
+            fused_attention_small,
+        )
         from mpi_pytorch_tpu.ops.ring_attention import (
             full_attention,
             ring_self_attention,
@@ -123,6 +136,8 @@ class MultiHeadAttention(nn.Module):
         if self.sp_strategy == "none":
             if self.attn_impl == "flash":
                 out = flash_attention(q, k, v)
+            elif self.attn_impl == "fused-small":
+                out = fused_attention_small(q, k, v, dp_mesh=self.dp_mesh)
             elif self.attn_impl == "full":
                 out = full_attention(q, k, v)
             else:
@@ -226,6 +241,7 @@ class EncoderBlock(nn.Module):
     sp_strategy: str = "none"
     sp_mesh: Any = None
     attn_impl: str = "full"
+    dp_mesh: Any = None  # fused-small attention's shard_map mesh (see MHA)
     qkv_fused: bool = False
     num_experts: int = 0
     moe_k: int = 2
@@ -242,7 +258,7 @@ class EncoderBlock(nn.Module):
             num_heads=self.num_heads, dtype=self.dtype,
             param_dtype=self.param_dtype, sp_strategy=self.sp_strategy,
             sp_mesh=self.sp_mesh, attn_impl=self.attn_impl,
-            qkv_fused=self.qkv_fused, name="attn",
+            dp_mesh=self.dp_mesh, qkv_fused=self.qkv_fused, name="attn",
         )(ln("ln1")(x))
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -286,6 +302,7 @@ class VisionTransformer(nn.Module):
     sp_strategy: str = "none"
     sp_mesh: Any = None
     attn_impl: str = "full"
+    dp_mesh: Any = None  # fused-small attention's shard_map mesh (see MHA)
     qkv_fused: bool = False
     # MoE: every `moe_every`-th block (0-indexed blocks moe_every-1,
     # 2·moe_every-1, ...; =2 → the odd blocks) swaps its dense MLP for a
@@ -329,7 +346,7 @@ class VisionTransformer(nn.Module):
                 dropout=self.dropout, dtype=self.dtype,
                 param_dtype=self.param_dtype, sp_strategy=self.sp_strategy,
                 sp_mesh=self.sp_mesh, attn_impl=self.attn_impl,
-                qkv_fused=self.qkv_fused,
+                dp_mesh=self.dp_mesh, qkv_fused=self.qkv_fused,
                 num_experts=self.num_experts if is_moe else 0,
                 moe_k=self.moe_k, moe_capacity=self.moe_capacity,
                 moe_group_size=self.moe_group_size,

@@ -503,7 +503,14 @@ def place_state_on_mesh(
 def make_spmd_train_step(mesh, compute_dtype=jnp.bfloat16, remat: bool = False) -> Callable:
     """Reference-parity DP step: shard_map over ``data``; local BN stats;
     explicit ``avg_grads`` pmean — the literal TPU translation of one
-    training iteration of ``mpiexec -n N python -m mpi4py main.py``."""
+    training iteration of ``mpiexec -n N python -m mpi4py main.py``.
+
+    The self-partitioning Mosaic kernels (``ops/fused_stem.py``,
+    ``ops/fused_head_ce.py``, ``ops/fused_attention_small.py``) compose
+    with this step without special-casing: their wrappers detect the
+    already-bound ``data`` axis (``compat.axis_is_manual``) and run the
+    per-shard kernel call directly instead of nesting a second shard_map
+    over the same axis."""
     data_axis = mesh.axis_names[0]
 
     def per_shard(state: TrainState, batch):

@@ -80,6 +80,46 @@ def test_flash_bf16_inputs():
     )
 
 
+@pytest.mark.parametrize("s", [64, 50])
+def test_flash_tiny_s_values_and_grads(s):
+    """Tiny-S pins at the vit_s16 geometry (S=64 / padded S=50, Dh=64):
+    the flash kernel is the measured baseline the fused tiny-S kernel
+    (ops/fused_attention_small.py) is A/B'd against, so its own parity at
+    these shapes is pinned here — values AND all three grads vs full
+    attention, through the real kernel path (interpret mode)."""
+    rng = np.random.default_rng(20 + s)
+    mk = lambda: jnp.asarray(rng.standard_normal((2, s, 2, 64)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    got = flash_attention(q, k, v, interpret=True)
+    want = full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def grads(fn):
+        f = lambda q_, k_, v_: jnp.sum(fn(q_, k_, v_) ** 2)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    for a, b in zip(grads(lambda *x: flash_attention(*x, interpret=True)),
+                    grads(full_attention)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5)
+        assert np.isfinite(np.asarray(a)).all()
+
+
+def test_flash_tiny_s_bf16():
+    """bf16 at S=64/Dh=64 — the production dtype of the tiny-S regime."""
+    rng = np.random.default_rng(30)
+    mk = lambda: jnp.asarray(rng.standard_normal((2, 64, 2, 64)), jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    got = flash_attention(q, k, v, interpret=True)
+    want = full_attention(q, k, v)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
 def test_flash_cpu_fallback_is_full_attention():
     """interpret=None off-TPU must route to full_attention (identical
     output, no Pallas involved) — the production CPU/GPU gating."""

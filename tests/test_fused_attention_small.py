@@ -1,0 +1,300 @@
+"""Fused tiny-S attention (Pallas, interpret mode on CPU) vs the plain
+``full_attention`` reference — values, grads, bf16, padded sequences, the
+bh-grouping lever, the multi-chip shard_map path, and the spmd (bound-axis)
+path. The kernel computes the SAME function as full attention, so every
+check is an exact-to-tolerance comparison (docs/RESULTS.md §4: the staged
+vit_s16 candidate)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mpi_pytorch_tpu.ops.fused_attention_small import (
+    _bh_block,
+    fused_attention_small,
+)
+from mpi_pytorch_tpu.ops.ring_attention import full_attention
+
+B, S, H, D = 2, 64, 2, 64  # the vit_s16 attention geometry (S=64, Dh=64)
+
+
+def _qkv(seed, b=B, s=S, d=D, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.standard_normal((b, s, H, d)), dtype)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("s", [64, 65, 50, 128])
+def test_values_match_full_attention(s):
+    """S=64 (the vit_s16 regime), odd S=65 (class-token variant — padded
+    rows + a different bh-grouping), padded S=50, and the envelope edge
+    S=128."""
+    q, k, v = _qkv(0, s=s)
+    got = fused_attention_small(q, k, v, interpret=True)
+    want = full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("s", [64, 50])
+def test_grads_match_full_attention(s):
+    q, k, v = _qkv(1, s=s)
+
+    def grads(fn):
+        f = lambda q_, k_, v_: jnp.sum(fn(q_, k_, v_).astype(jnp.float32) ** 2)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    g_fused = grads(lambda *a: fused_attention_small(*a, interpret=True))
+    g_full = grads(full_attention)
+    for a, b in zip(g_fused, g_full):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5)
+        assert np.isfinite(np.asarray(a)).all()
+
+
+def test_causal_matches_full_attention():
+    q, k, v = _qkv(2)
+    got = fused_attention_small(q, k, v, causal=True, interpret=True)
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_values_and_grads():
+    q, k, v = _qkv(3, dtype=jnp.bfloat16)
+    got = fused_attention_small(q, k, v, interpret=True)
+    want = full_attention(q, k, v)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=2e-2, atol=2e-2,  # bf16 quantization on in/out
+    )
+
+    def grads(fn):
+        f = lambda q_: jnp.sum(fn(q_, k, v).astype(jnp.float32) ** 2)
+        return jax.grad(f)(q)
+
+    g_fused = grads(lambda *a: fused_attention_small(*a, interpret=True))
+    g_full = grads(full_attention)
+    np.testing.assert_allclose(
+        np.asarray(g_fused, np.float32), np.asarray(g_full, np.float32),
+        rtol=5e-2, atol=5e-1,
+    )
+
+
+@pytest.mark.parametrize("g", [1, 2, 4])
+def test_bh_block_lever_is_exact(g):
+    """The bh-grouping lever re-tiles the grid; the masked off-diagonal
+    blocks must contribute exactly nothing (values AND grads)."""
+    q, k, v = _qkv(4)
+    got = fused_attention_small(q, k, v, bh_block=g, interpret=True)
+    want = full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    g_fused = jax.grad(
+        lambda q_: jnp.sum(
+            fused_attention_small(q_, k, v, bh_block=g, interpret=True) ** 2
+        )
+    )(q)
+    g_full = jax.grad(lambda q_: jnp.sum(full_attention(q_, k, v) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g_fused), np.asarray(g_full),
+                               rtol=5e-5, atol=5e-5)
+
+
+def test_bh_block_env_gate(monkeypatch):
+    """MPT_ATTN_BH_BLOCK overrides the default; non-divisors are reduced."""
+    assert _bh_block(12, 64) == 2
+    assert _bh_block(12, 128) == 1
+    assert _bh_block(12, 56) == 2
+    monkeypatch.setenv("MPT_ATTN_BH_BLOCK", "4")
+    assert _bh_block(12, 64) == 4
+    assert _bh_block(9, 64) == 3  # 4 does not divide 9 → reduced
+    # the explicit kwarg beats the env gate
+    assert _bh_block(12, 64, override=6) == 6
+    # VMEM envelope: G·S_pad capped at 512, so an aggressive override
+    # degrades to a buildable grouping instead of a compile failure
+    assert _bh_block(12288, 64, override=64) == 8
+    assert _bh_block(12288, 128, override=64) == 4
+    q, k, v = _qkv(5)
+    got = fused_attention_small(q, k, v, interpret=True)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(full_attention(q, k, v)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_cpu_fallback_and_envelope():
+    """interpret=None off-TPU routes to full_attention exactly; so does a
+    sequence outside the tiny-S envelope (S > 128) even with interpret."""
+    q, k, v = _qkv(6)
+    np.testing.assert_array_equal(
+        np.asarray(fused_attention_small(q, k, v)),
+        np.asarray(full_attention(q, k, v)),
+    )
+    q, k, v = _qkv(6, s=196)  # vit at 224px — flash/full own this regime
+    np.testing.assert_array_equal(
+        np.asarray(fused_attention_small(q, k, v, interpret=True)),
+        np.asarray(full_attention(q, k, v)),
+    )
+
+
+def test_vit_fused_small_matches_full_through_model(monkeypatch):
+    """A whole ViT forward with attn_impl='fused-small' — routed through the
+    REAL Pallas kernel via MPT_ATTN_INTERPRET — equals attn_impl='full' on
+    the same params: the trainer flag changes execution, never the
+    function."""
+    from mpi_pytorch_tpu.models.vit import VisionTransformer
+
+    kw = dict(num_classes=7, patch_size=4, hidden=16, depth=2, num_heads=2,
+              mlp_dim=32, dtype=jnp.float32, param_dtype=jnp.float32)
+    full = VisionTransformer(**kw)
+    fused = VisionTransformer(attn_impl="fused-small", **kw)
+    x = jnp.asarray(
+        np.random.default_rng(7).standard_normal((2, 16, 16, 3)), jnp.float32
+    )
+    variables = full.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+
+    monkeypatch.setenv("MPT_ATTN_INTERPRET", "1")
+    got = fused.apply(variables, x, train=False)
+    want = full.apply(variables, x, train=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()).reshape(-1, 1), ("data", "model"))
+
+
+@pytest.mark.parametrize("dtype,s", [
+    (jnp.float32, 64), (jnp.float32, 50),
+    (jnp.bfloat16, 64), (jnp.bfloat16, 50),
+])
+def test_shard_map_multi_device_matches_single_call(monkeypatch, dtype, s):
+    """dp_mesh with an 8-device data axis: the wrapper shard_maps the kernel
+    call; values AND all three grads must equal the single-call path — for
+    f32 and bf16, at S=64 and padded S (the acceptance shapes)."""
+    monkeypatch.setenv("MPT_ATTN_INTERPRET", "1")
+    mesh = _mesh()
+    n = mesh.shape["data"]
+    q, k, v = _qkv(8, b=2 * n, s=s, dtype=dtype)
+    vtol = dict(rtol=2e-5, atol=2e-5) if dtype == jnp.float32 else dict(
+        rtol=2e-2, atol=2e-2)
+    gtol = dict(rtol=5e-5, atol=5e-5) if dtype == jnp.float32 else dict(
+        rtol=5e-2, atol=5e-1)
+
+    got = fused_attention_small(q, k, v, dp_mesh=mesh)
+    assert got.dtype == dtype
+    want = fused_attention_small(q, k, v, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got, np.float32),
+                                  np.asarray(want, np.float32))
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(full_attention(q, k, v), np.float32),
+                               **vtol)
+
+    def grads(fn):
+        f = lambda q_, k_, v_: jnp.sum(fn(q_, k_, v_).astype(jnp.float32) ** 2)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    g_sharded = grads(lambda *a: fused_attention_small(*a, dp_mesh=mesh))
+    g_full = grads(full_attention)
+    for a, b in zip(g_sharded, g_full):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), **gtol)
+
+
+def test_indivisible_batch_falls_back(monkeypatch):
+    """A batch that does not tile the data axis must take the XLA path
+    (exactly full attention), not replicate the Mosaic call."""
+    monkeypatch.setenv("MPT_ATTN_INTERPRET", "1")
+    mesh = _mesh()
+    q, k, v = _qkv(9, b=mesh.shape["data"] + 1)
+    got = fused_attention_small(q, k, v, dp_mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(full_attention(q, k, v)))
+
+
+def test_spmd_bound_axis_runs_per_shard_call(monkeypatch):
+    """Inside a shard_map over the data axis (the spmd-mode step), the
+    wrapper must detect the bound axis and run the per-shard call directly
+    — no nested shard_map — and still match full attention."""
+    from mpi_pytorch_tpu.parallel.compat import shard_map
+
+    monkeypatch.setenv("MPT_ATTN_INTERPRET", "1")
+    mesh = _mesh()
+    n = mesh.shape["data"]
+    q, k, v = _qkv(10, b=2 * n)
+
+    inner = functools.partial(fused_attention_small, dp_mesh=mesh)
+    got = shard_map(
+        lambda q_, k_, v_: inner(q_, k_, v_),
+        mesh=mesh,
+        in_specs=(P("data"), P("data"), P("data")),
+        out_specs=P("data"),
+        check_vma=False,
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(full_attention(q, k, v)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_spmd_training_step_with_fused_small(monkeypatch):
+    """One spmd-mode (explicit-collective shard_map) training step over a
+    ViT with attn_impl='fused-small' and the mesh threaded — the trainer's
+    --spmd-mode --attn-impl fused-small recipe, real kernel code path."""
+    from mpi_pytorch_tpu.models.vit import VisionTransformer
+    from mpi_pytorch_tpu.parallel.mesh import shard_batch
+    from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
+    from mpi_pytorch_tpu.train.step import (
+        make_spmd_train_step,
+        make_train_step,
+        place_state_on_mesh,
+    )
+
+    monkeypatch.setenv("MPT_ATTN_INTERPRET", "1")
+    mesh = _mesh()
+    n = mesh.shape["data"]
+    model = VisionTransformer(
+        num_classes=5, patch_size=8, hidden=16, depth=1, num_heads=2,
+        mlp_dim=32, attn_impl="fused-small", dp_mesh=mesh,
+    )
+    rng = np.random.default_rng(11)
+    images = rng.standard_normal((2 * n, 16, 16, 3)).astype(np.float32)
+    labels = rng.integers(0, 5, size=(2 * n,)).astype(np.int32)
+    def one_step(step_factory):
+        # Fresh init per leg: the donated step deletes buffers that
+        # place_state_on_mesh may alias with the init arrays.
+        variables = model.init(
+            {"params": jax.random.PRNGKey(0)}, jnp.asarray(images[:2]),
+            train=False,
+        )
+        state = place_state_on_mesh(
+            TrainState.create(
+                apply_fn=model.apply, variables=variables,
+                tx=make_optimizer(1e-3), rng=jax.random.PRNGKey(1),
+            ),
+            mesh,
+        )
+        _, metrics = step_factory(state, shard_batch((images, labels), mesh))
+        return float(metrics["loss"])
+
+    spmd_loss = one_step(make_spmd_train_step(mesh, jnp.float32))
+    auto_loss = one_step(make_train_step(jnp.float32))
+    # Same model, same batch: the spmd (bound-axis direct call) and auto
+    # (self-shard_mapping) paths compute the same step loss.
+    assert np.isfinite(spmd_loss) and np.isfinite(auto_loss)
+    np.testing.assert_allclose(spmd_loss, auto_loss, rtol=1e-5, atol=1e-5)
+
+
+def test_attn_impl_config_validation():
+    from mpi_pytorch_tpu.config import parse_config
+
+    ok = parse_config(["--model-name", "vit_s16", "--attn-impl", "fused-small"])
+    assert ok.attn_impl == "fused-small"
+    with pytest.raises(ValueError, match="no\\s+attention|has no"):
+        parse_config(["--attn-impl", "fused-small"])  # default resnet18
+    with pytest.raises(ValueError, match="choose one"):
+        parse_config(["--model-name", "vit_s16", "--attn-impl", "fused-small",
+                      "--sp-strategy", "ring"])

@@ -374,3 +374,75 @@ def test_module_matches_unfused_stem(rng):
         lambda x, z: np.testing.assert_allclose(x, z, rtol=1e-4, atol=1e-4),
         gu, gf,
     )
+
+
+def test_densenet_fused_stem_matches_unfused(rng, monkeypatch):
+    """densenet121's stem (features.conv0..pool0) is geometrically the
+    resnet stem, so the fused kernel applies (verdict r5 #7): a whole
+    DenseNet forward with fused_stem=True — real kernel code path via the
+    interpreter — equals the unfused model on the SAME variables (the
+    variable trees are identical, so checkpoints interchange), and the
+    param gradients agree."""
+    from mpi_pytorch_tpu.models.densenet import DenseNet
+
+    kw = dict(block_config=(1, 1), num_classes=5, growth_rate=8,
+              num_init_features=64)
+    unfused = DenseNet(**kw)
+    fused = DenseNet(fused_stem=True, **kw)
+    x = jnp.asarray(rng.standard_normal((2, 32, 32, 3)), jnp.float32)
+
+    monkeypatch.setenv("MPT_STEM_INTERPRET", "1")
+    vu = unfused.init({"params": jax.random.PRNGKey(0)}, x, train=True)
+    vf = fused.init({"params": jax.random.PRNGKey(0)}, x, train=True)
+    assert jax.tree.structure(vu) == jax.tree.structure(vf)
+
+    ou, su = unfused.apply(vu, x, train=True, mutable=["batch_stats"])
+    of, sf = fused.apply(vu, x, train=True, mutable=["batch_stats"])
+    np.testing.assert_allclose(ou, of, rtol=1e-5, atol=1e-5)
+    jax.tree.map(
+        lambda p, q: np.testing.assert_allclose(p, q, rtol=1e-5, atol=1e-6),
+        su["batch_stats"], sf["batch_stats"],
+    )
+
+    def tloss(m):
+        def f(params):
+            out, _ = m.apply(
+                {"params": params, "batch_stats": vu["batch_stats"]},
+                x, train=True, mutable=["batch_stats"],
+            )
+            return jnp.sum(out * out)
+        return f
+
+    gu = jax.grad(tloss(unfused))(vu["params"])
+    gf = jax.grad(tloss(fused))(vu["params"])
+    jax.tree.map(
+        lambda p, q: np.testing.assert_allclose(p, q, rtol=1e-4, atol=1e-4),
+        gu, gf,
+    )
+
+
+def test_densenet_fused_stem_registry_and_default():
+    """densenet121 is fused-stem CAPABLE (--fused-stem builds it) but NOT a
+    bench default until its chip A/B lands (docs/RESULTS.md §4: stem tail
+    ≈3% of its roofline bound — the fused-head discipline)."""
+    from mpi_pytorch_tpu.models.registry import (
+        FUSED_STEM_MODELS,
+        MEASURED_FUSED_STEM_MODELS,
+        initialize_model,
+    )
+
+    assert "densenet121" in FUSED_STEM_MODELS
+    assert "densenet121" not in MEASURED_FUSED_STEM_MODELS
+    model, _ = initialize_model("densenet121", 5, fused_stem=True)
+    assert model.fused_stem
+    # fused_stem_default is platform-gated (TPU); on the CPU test mesh it
+    # must be False for every model regardless of the measured tuple.
+    from mpi_pytorch_tpu.models.registry import fused_stem_default
+
+    assert not fused_stem_default("densenet121")
+    assert not fused_stem_default("resnet18")
+
+    from mpi_pytorch_tpu.config import parse_config
+
+    cfg = parse_config(["--model-name", "densenet121", "--fused-stem", "1"])
+    assert cfg.fused_stem

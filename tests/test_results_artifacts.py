@@ -1,0 +1,76 @@
+"""Tier-1 wrapper for the artifact-discipline linter (VERDICT r5 #9):
+claim/artifact drift in docs/RESULTS.md fails CI, not a reviewer pass.
+
+The linter itself is ``tools/check_results_artifacts.py``; its contract
+(perf-claim regex → committed artifact citation or explicit
+staged/pending marker, section-granular) is unit-pinned here so a future
+edit cannot silently neuter it."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_results_artifacts as lint  # noqa: E402
+
+
+def test_committed_results_md_passes():
+    """THE acceptance gate: every perf claim in the committed RESULTS.md
+    maps to a committed machine-readable artifact or is explicitly marked
+    staged/pending/rejected."""
+    violations = lint.check(os.path.join(REPO, "docs", "RESULTS.md"))
+    assert violations == [], "\n".join(violations)
+
+
+def test_unbacked_claim_is_flagged(tmp_path):
+    doc = tmp_path / "r.md"
+    doc.write_text("## headline\n\nwe now reach 99 999 img/s at 99% MFU\n")
+    violations = lint.check(str(doc))
+    assert len(violations) == 1
+    assert "headline" in violations[0]
+
+
+def test_artifact_citation_passes(tmp_path):
+    doc = tmp_path / "r.md"
+    # bench_latest.json is a committed artifact (docs/bench_latest.json).
+    doc.write_text("## headline\n\n24 147 img/s (`bench_latest.json`)\n")
+    assert lint.check(str(doc)) == []
+
+
+def test_missing_artifact_citation_is_flagged(tmp_path):
+    doc = tmp_path / "r.md"
+    doc.write_text("## headline\n\n24 147 img/s (`no_such_artifact.json`)\n")
+    violations = lint.check(str(doc))
+    assert len(violations) == 1
+    assert "no_such_artifact.json" in violations[0]
+
+
+def test_staged_marker_passes(tmp_path):
+    doc = tmp_path / "r.md"
+    doc.write_text(
+        "## lever\n\nmodeled 2.0 ms vs 4.25 ms — measured cell staged, "
+        "pending the next chip window\n"
+    )
+    assert lint.check(str(doc)) == []
+
+
+def test_prose_without_numbers_needs_nothing(tmp_path):
+    doc = tmp_path / "r.md"
+    doc.write_text("## design notes\n\nlayout is the whole game.\n")
+    assert lint.check(str(doc)) == []
+
+
+@pytest.mark.parametrize("line,claims", [
+    ("26 113 img/s", True),
+    ("43.2% MFU", True),
+    ("the step takes 85.3 ms", True),
+    ("78.86 TFLOP/s per chip", True),
+    ("819.0 GB/s peak", True),
+    ("touches 12 files", False),
+    ("round 5 delivered", False),
+])
+def test_perf_claim_regex(line, claims):
+    assert bool(lint.PERF_CLAIM.search(line)) == claims

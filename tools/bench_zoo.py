@@ -84,10 +84,11 @@ def build_state_and_batch(
         model_name, NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=image,
         dtype=jnp.bfloat16, param_dtype=jnp.float32, remat_blocks=remat_blocks,
         attn_impl=attn_impl, stem_s2d=stem_s2d, fused_stem=fused_stem,
-        # Multi-chip: the stem kernel shard_maps itself over the data axis
-        # (ops/fused_stem.py, Multi-chip) instead of degrading to an
-        # activation all-gather around a replicated Mosaic call.
-        dp_mesh=mesh if fused_stem else None,
+        # Multi-chip: the fused kernels (stem, fused-small attention)
+        # shard_map themselves over the data axis (ops/fused_stem.py /
+        # ops/fused_attention_small.py, Multi-chip) instead of degrading to
+        # an activation all-gather around a replicated Mosaic call.
+        dp_mesh=mesh if (fused_stem or attn_impl == "fused-small") else None,
         qkv_fused=qkv_fused,
     )
     state = TrainState.create(
@@ -214,8 +215,11 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
-    ap.add_argument("--attn-impl", default="full", choices=["full", "flash"],
-                    help="vit family only: dense-attention implementation")
+    ap.add_argument("--attn-impl", default="full",
+                    choices=["full", "flash", "fused-small"],
+                    help="vit family only: dense-attention implementation "
+                    "(fused-small = the tiny-S Pallas kernel, "
+                    "ops/fused_attention_small.py — the vit_s16 A/B row)")
     ap.add_argument("--models", default=",".join(ZOO), help="comma-separated subset")
     ap.add_argument("--qkv-fused", action="store_true",
                     help="fuse q/k/v projections into one matmul (vit family)")

@@ -1,0 +1,116 @@
+"""Lint docs/RESULTS.md: every numeric perf claim must cite a committed
+machine-readable artifact — or be explicitly marked staged/pending/rejected.
+
+Why (VERDICT r5 #9 / weak #1-2): the round-5 headline lived only in prose
+(no raw A/B JSON, ``docs/bench_latest.json`` stale two rounds), and a
+corrupt 242.4%-MFU row shipped un-annotated. The repo's brand is
+measurement honesty; this linter makes claim→artifact drift a CI failure
+instead of a reviewer catch (``tests/test_results_artifacts.py`` is the
+tier-1 wrapper).
+
+Contract (deliberately section-granular — prose moves, headings don't):
+
+- The doc is split into sections at markdown headings (``#``..``####``).
+- A section CLAIMS perf when any line matches a perf-number pattern
+  (img/s, ms, MFU %, TFLOP/s, GB/s — the units this repo measures in).
+- A claiming section PASSES when it contains at least one citation of a
+  committed machine-readable artifact: a backtick-quoted token ending in
+  .json/.jsonl/.log/.txt/.csv that resolves to an existing file (tried
+  as-given from the repo root, then under docs/, then at the root), OR an
+  explicit status marker (``staged``, ``pending``, ``rejected``,
+  ``withdrawn``, ``stale``, ``not driver-confirmed``) telling the reader
+  the number is not artifact-backed yet — the staleness-ledger idiom.
+- Anything else fails with the section heading and the offending lines.
+
+Run: ``python tools/check_results_artifacts.py [--file docs/RESULTS.md]``
+Exit 0 = every claim maps; 1 = violations (printed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The units this repo states measurements in (docs/RESULTS.md §§1-5).
+PERF_CLAIM = re.compile(
+    r"\d[\d\s,.]*\s*(img/s|images?/sec|ms\b|%?\s*MFU|MFU\b|TFLOP|GB/s)",
+    re.IGNORECASE,
+)
+
+# Backtick-quoted machine-readable artifact path.
+ARTIFACT_CITE = re.compile(r"`([^`\s]+\.(?:json|jsonl|log|txt|csv))`")
+
+# The explicit not-yet-measured / no-longer-claimed markers (the staleness
+# ledger idiom: a number may ship unbacked ONLY when the prose says so).
+STATUS_MARKER = re.compile(
+    r"staged|pending|rejected|withdrawn|stale|not driver-confirmed",
+    re.IGNORECASE,
+)
+
+HEADING = re.compile(r"^#{1,4}\s")
+
+
+def artifact_exists(path: str) -> bool:
+    for cand in (path, os.path.join("docs", path), os.path.basename(path)):
+        if os.path.isfile(os.path.join(REPO, cand)):
+            return True
+    return False
+
+
+def split_sections(text: str) -> list[tuple[str, list[str]]]:
+    sections: list[tuple[str, list[str]]] = [("(preamble)", [])]
+    for line in text.splitlines():
+        if HEADING.match(line):
+            sections.append((line.strip(), []))
+        else:
+            sections[-1][1].append(line)
+    return sections
+
+
+def check(path: str) -> list[str]:
+    with open(path) as f:
+        text = f.read()
+    violations = []
+    for heading, lines in split_sections(text):
+        body = "\n".join(lines)
+        claims = [ln for ln in lines if PERF_CLAIM.search(ln)]
+        if not claims:
+            continue
+        cites = [m for m in ARTIFACT_CITE.findall(heading + "\n" + body)]
+        live = [c for c in cites if artifact_exists(c)]
+        dead = [c for c in cites if not artifact_exists(c)]
+        if live or STATUS_MARKER.search(body):
+            if dead:
+                violations.append(
+                    f"{heading}: cites missing artifact(s): {', '.join(sorted(set(dead)))}"
+                )
+            continue
+        sample = "; ".join(c.strip()[:80] for c in claims[:3])
+        violations.append(
+            f"{heading}: {len(claims)} perf-claim line(s) with no committed "
+            f"artifact citation and no staged/pending marker — e.g. {sample}"
+        )
+    return violations
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--file", default=os.path.join(REPO, "docs", "RESULTS.md"))
+    args = ap.parse_args()
+    violations = check(args.file)
+    if violations:
+        print(f"{len(violations)} violation(s) in {args.file}:")
+        for v in violations:
+            print(" -", v)
+        return 1
+    print(f"ok: every perf-claiming section of {args.file} cites a committed "
+          "artifact or carries an explicit staged/pending marker")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
