@@ -173,10 +173,16 @@ def main() -> None:
 
     from mpi_pytorch_tpu.config import Config
     from mpi_pytorch_tpu.models import create_model_bundle
+    from mpi_pytorch_tpu.obs import Tracer
     from mpi_pytorch_tpu.parallel.mesh import create_mesh, shard_batch
     from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
     from mpi_pytorch_tpu.train.step import make_train_step, place_state_on_mesh
     from mpi_pytorch_tpu.utils.hardware import peak_bf16_tflops, step_flops
+
+    # MPT_TRACE_FILE=path → host-side Chrome-trace spans for the bench's
+    # phases (compile/warmup/measure — obs/trace.py), so a slow bench run
+    # through the relay is attributable without re-running under a profiler.
+    tracer = Tracer(os.environ.get("MPT_TRACE_FILE", ""))
 
     n_chips = jax.device_count()
     batch = BATCH_PER_CHIP * n_chips
@@ -224,20 +230,28 @@ def main() -> None:
         options = {"xla_tpu_scoped_vmem_limit_kib": 65536}
     else:
         options = {}
-    compiled = step.lower(state, device_batch).compile(
-        compiler_options=options or None
-    )
-    flops_per_step = step_flops(compiled)
+    # finally-close: a wedged/aborted bench is exactly the run whose trace
+    # is needed to see which phase it died in.
+    try:
+        with tracer.span("compile"):
+            compiled = step.lower(state, device_batch).compile(
+                compiler_options=options or None
+            )
+        flops_per_step = step_flops(compiled)
 
-    for _ in range(WARMUP_STEPS):
-        state, metrics = compiled(state, device_batch)
-    jax.block_until_ready(state.params)
+        with tracer.span("warmup", args={"steps": WARMUP_STEPS}):
+            for _ in range(WARMUP_STEPS):
+                state, metrics = compiled(state, device_batch)
+            jax.block_until_ready(state.params)
 
-    t0 = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
-        state, metrics = compiled(state, device_batch)
-    jax.block_until_ready(state.params)
-    dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with tracer.span("measure", args={"steps": MEASURE_STEPS}):
+            for _ in range(MEASURE_STEPS):
+                state, metrics = compiled(state, device_batch)
+            jax.block_until_ready(state.params)
+        dt = time.perf_counter() - t0
+    finally:
+        tracer.close()
 
     ips = MEASURE_STEPS * batch / dt
     # cost_analysis() FLOPs are PER-DEVICE under SPMD partitioning, so this

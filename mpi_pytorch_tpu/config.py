@@ -165,7 +165,12 @@ class Config:
     qkv_fused: bool = False
     # Predictions pass: stream the head weights through VMEM computing
     # loss+argmax online instead of materializing [B, num_classes] logits
-    # (ops/fused_head_ce.head_predict; TPU only, XLA path elsewhere).
+    # (ops/fused_head_ce.head_predict; TPU only, XLA path elsewhere). The
+    # kernel matmuls in the FEATURE dtype: bf16 compute gets the VMEM-stream
+    # bandwidth win, while an f32-compute model keeps exact f32 head
+    # semantics — no silent bf16 downcast of the argmax (advisor r5).
+    # Applies to the predictions pass (--predictions-file); a silent
+    # fallback to the plain step logs a one-time warning (evaluate.py).
     fused_head_eval: bool = False
     # Expert parallelism for MoE models (vit_moe_s16): shard the experts
     # over all devices on an ("expert", "_") mesh; tokens travel by
@@ -277,6 +282,28 @@ class Config:
     metrics_file: str = "metrics.jsonl"  # structured JSONL metrics; "" disables
     profile_dir: str = ""  # non-empty → jax.profiler traces written here
     log_every_steps: int = 10
+    # Host-side trace spans (obs/trace.py): non-empty → Chrome-trace-event
+    # JSON written here at run end (one file per process on multi-host),
+    # loadable in chrome://tracing / Perfetto. Spans (ingest/step/checkpoint/
+    # validate/…) also enter jax.profiler.TraceAnnotation, so they line up
+    # with an XLA trace captured via --profile-dir (docs/OBSERVABILITY.md).
+    trace_file: str = ""
+    # Per-step health records (kind="step" in metrics_file): data-wait vs
+    # device-step ms, loss, global grad norm, live HBM bytes, recompile
+    # counter (obs/health.py). Costs ONE host sync per step — telemetry
+    # mode, not benchmark mode; default off.
+    step_metrics: bool = False
+    # NaN/Inf-loss sentinel (obs/health.py): a non-finite loss writes a
+    # kind="anomaly" diagnostic record and aborts cleanly instead of
+    # training on garbage. Checked per step when step_metrics is on, per
+    # epoch always (the epoch loss is a host float anyway — free).
+    nan_sentinel: bool = True
+    # Multi-host heartbeat (obs/heartbeat.py): every N steps all processes
+    # exchange mean step time (parallel/collectives.host_allgather) and the
+    # metrics stream gains kind="heartbeat" records with per-host rows;
+    # hosts slower than straggler_threshold x median are flagged. 0 = off.
+    heartbeat_every_steps: int = 0
+    straggler_threshold: float = 1.5
     # Sanitizer (SURVEY §5 race-detection row): XLA collectives are
     # deterministic by construction, so the debug surface that remains is
     # numerics — this flag turns every NaN-producing op into an immediate
@@ -391,6 +418,16 @@ class Config:
             )
         if self.warmup_steps < 0:
             raise ValueError(f"warmup_steps must be >= 0, got {self.warmup_steps}")
+        if self.heartbeat_every_steps < 0:
+            raise ValueError(
+                f"heartbeat_every_steps must be >= 0 (0 disables), "
+                f"got {self.heartbeat_every_steps}"
+            )
+        if self.straggler_threshold <= 1.0:
+            raise ValueError(
+                "straggler_threshold is a multiple of the median step time "
+                f"and must be > 1.0, got {self.straggler_threshold}"
+            )
         if self.remat == "blocks":
             from mpi_pytorch_tpu.models.registry import (
                 REMAT_BLOCKS_MODELS,

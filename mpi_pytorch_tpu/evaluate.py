@@ -38,10 +38,27 @@ from mpi_pytorch_tpu import checkpoint as ckpt
 from mpi_pytorch_tpu.config import Config, parse_config
 from mpi_pytorch_tpu.data import load_manifests
 from mpi_pytorch_tpu.models import create_model_bundle
+from mpi_pytorch_tpu.obs import Tracer
 from mpi_pytorch_tpu.parallel.mesh import create_mesh, flat_mesh
 from mpi_pytorch_tpu.train.state import TrainState
 from mpi_pytorch_tpu.train.trainer import evaluate_manifest
-from mpi_pytorch_tpu.utils.logging import MetricsWriter, init_logger
+from mpi_pytorch_tpu.utils.logging import MetricsWriter, init_logger, run_logger
+
+# One warning per (process, reason): --fused-head-eval silently degrading to
+# the plain step was an advisor r5 finding — the user could not tell the
+# flag did nothing. Kept module-level so repeated evaluate() calls in one
+# process (tests, notebooks) don't spam.
+_fused_head_warned: set[str] = set()
+
+
+def _warn_fused_head_fallback(reason: str) -> None:
+    if reason in _fused_head_warned:
+        return
+    _fused_head_warned.add(reason)
+    run_logger().warning(
+        "--fused-head-eval requested but falling back to the plain XLA "
+        "predict step: %s", reason,
+    )
 
 
 @dataclass
@@ -109,44 +126,66 @@ def evaluate(cfg: Config) -> EvalSummary:
     maybe_initialize_distributed()
     apply_runtime_flags(cfg)
     logger = init_logger("MPT_EVAL", cfg.eval_log_file)
-    manifests = load_manifests(cfg)
-    mesh, bundle, state, test_manifest = build_inference(cfg, manifests=manifests)
+    tracer = Tracer(cfg.trace_file)
+    # finally-close: a failed evaluation (bad checkpoint, OOM, relay wedge)
+    # is exactly the run whose trace is needed — the buffered spans must
+    # reach disk on the failure path too.
+    try:
+        with tracer.span("build"):
+            manifests = load_manifests(cfg)
+            mesh, bundle, state, test_manifest = build_inference(cfg, manifests=manifests)
 
-    latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
-    if cfg.use_best:
-        # Best-validation checkpoint (train --track-best), not merely the
-        # newest — the reference's intended is_best machinery (helpers.py:4-7).
-        marker = ckpt.best_marker(cfg.checkpoint_dir)
-        if marker is None:
-            raise FileNotFoundError(
-                f"use_best=True but no best.json in {cfg.checkpoint_dir} "
-                "(train with --track-best true --validate true)"
+        latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
+        if cfg.use_best:
+            # Best-validation checkpoint (train --track-best), not merely the
+            # newest — the reference's intended is_best machinery (helpers.py:4-7).
+            marker = ckpt.best_marker(cfg.checkpoint_dir)
+            if marker is None:
+                raise FileNotFoundError(
+                    f"use_best=True but no best.json in {cfg.checkpoint_dir} "
+                    "(train with --track-best true --validate true)"
+                )
+            latest = os.path.join(cfg.checkpoint_dir, marker["checkpoint"])
+            logger.info(
+                "best checkpoint: epoch %d, val acc %.4f", marker["epoch"], marker["accuracy"]
             )
-        latest = os.path.join(cfg.checkpoint_dir, marker["checkpoint"])
-        logger.info(
-            "best checkpoint: epoch %d, val acc %.4f", marker["epoch"], marker["accuracy"]
-        )
-    if latest:
-        # ≙ predictor ranks loading the trained checkpoint
-        # (evaluation_pipeline.py:142-144); params/batch_stats only.
-        state, epoch, loss = ckpt.load_for_eval(latest, state)
-        logger.info("loaded checkpoint %s (epoch %d)", latest, epoch)
-    else:
-        logger.info("no checkpoint in %s — evaluating fresh init", cfg.checkpoint_dir)
+        if latest:
+            # ≙ predictor ranks loading the trained checkpoint
+            # (evaluation_pipeline.py:142-144); params/batch_stats only.
+            with tracer.span("checkpoint_load"):
+                state, epoch, loss = ckpt.load_for_eval(latest, state)
+            logger.info("loaded checkpoint %s (epoch %d)", latest, epoch)
+        else:
+            logger.info("no checkpoint in %s — evaluating fresh init", cfg.checkpoint_dir)
 
-    from mpi_pytorch_tpu.train.step import place_state_on_mesh
+        from mpi_pytorch_tpu.train.step import place_state_on_mesh
 
-    state = place_state_on_mesh(state, mesh)
+        state = place_state_on_mesh(state, mesh)
 
-    t0 = time.perf_counter()
-    if cfg.predictions_file:
-        # One pass produces both the metrics and the submission CSV.
-        acc, mean_loss = evaluate_with_predictions(
-            cfg, state, mesh, manifests[0], test_manifest, logger
-        )
-    else:
-        acc, mean_loss = evaluate_manifest(cfg, state, mesh, test_manifest)
-    wall = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        if cfg.predictions_file:
+            # One pass produces both the metrics and the submission CSV.
+            with tracer.span("eval", args={"pass": "predictions"}):
+                acc, mean_loss = evaluate_with_predictions(
+                    cfg, state, mesh, manifests[0], test_manifest, logger
+                )
+        else:
+            if cfg.fused_head_eval:
+                # The metrics-only pass runs the shared eval step — the fused
+                # head lives in the predictions step. Surface it instead of
+                # letting the flag silently do nothing (advisor r5).
+                _warn_fused_head_fallback(
+                    "metrics-only evaluation uses the shared eval step; the "
+                    "fused head applies to the predictions pass "
+                    "(add --predictions-file)"
+                )
+            with tracer.span("eval", args={"pass": "metrics"}):
+                acc, mean_loss = evaluate_manifest(cfg, state, mesh, test_manifest)
+        wall = time.perf_counter() - t0
+    finally:
+        trace_out = tracer.close()
+        if trace_out:
+            logger.info("host trace spans written to %s (chrome://tracing)", trace_out)
     n = len(test_manifest)
     # ≙ rank-0 final accuracy log (evaluation_pipeline.py:198-199)
     logger.info("Accuracy of the network: %.4f (%d images, %.2f s)", acc, n, wall)
@@ -326,11 +365,21 @@ def evaluate_with_predictions(
     loader = make_eval_loader(cfg, test_manifest)  # this host's shard
     local_n = len(loader.manifest)
     compute_dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[cfg.compute_dtype]
+    from mpi_pytorch_tpu.utils.env import env_flag
     from mpi_pytorch_tpu.utils.hardware import tpu_backend
 
-    predict = _make_predict_step(
-        mesh, compute_dtype, fused_head=cfg.fused_head_eval and tpu_backend()
+    # MPT_HEAD_INTERPRET=1 drives the real kernel through the Pallas
+    # interpreter on CPU (the driver-level test path), so it passes the gate.
+    fused_head = cfg.fused_head_eval and (
+        tpu_backend() or env_flag("MPT_HEAD_INTERPRET")
     )
+    if cfg.fused_head_eval and not fused_head:
+        _warn_fused_head_fallback(
+            "backend is not TPU (the Mosaic kernel has no CPU/GPU build); "
+            "metrics are identical, but the [B, num_classes] logits are "
+            "materialized"
+        )
+    predict = _make_predict_step(mesh, compute_dtype, fused_head=fused_head)
     preds: list = []
     loss_sum = correct = count = 0.0
     n_steps = global_step_count(len(test_manifest), host_batch, drop_remainder=False)

@@ -122,8 +122,10 @@ MEASURED_FUSED_STEM_MODELS = ("resnet18", "resnet34")
 
 def fused_stem_default(model_name: str) -> bool:
     """The benchmark harnesses' shared gate: fused stem ON for the
-    measured-win members on TPU unless MPT_FUSED_STEM=0 (the A/B escape
-    hatch). The trainer/eval CLIs stay explicit via ``--fused-stem``."""
+    measured-win members on TPU unless MPT_FUSED_STEM is set falsy — any
+    case of '0'/'false'/'no'/'off' (``utils/env.py`` is the one definition;
+    advisor r5: 'False'/'no' used to silently mean ON). The trainer/eval
+    CLIs stay explicit via ``--fused-stem``."""
     import jax
 
     from mpi_pytorch_tpu.utils.env import env_flag

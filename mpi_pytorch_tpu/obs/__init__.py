@@ -1,0 +1,33 @@
+"""Run-telemetry subsystem (SURVEY §5 observability, beyond the per-epoch
+JSONL the trainer already had): host-side trace spans in Chrome trace-event
+format (``trace.py``), per-step health metrics + the non-finite-loss
+sentinel (``health.py``), the multi-host step-time heartbeat with straggler
+flagging (``heartbeat.py``), and the metrics-record schema shared by the
+drivers, ``tools/report_run.py``, and the artifacts linter (``schema.py``).
+
+Everything here is host-side and backend-agnostic: importing this package
+never initializes jax (the tools import the schema without a device), and
+the tracer/health hooks are inert unless the corresponding config knob is
+set — telemetry is opt-in per run, except the NaN sentinel, which defaults
+on (training on a NaN'd loss is never the right outcome).
+"""
+
+from mpi_pytorch_tpu.obs.health import (
+    NonFiniteLossError,
+    StepHealth,
+    device_bytes_in_use,
+)
+from mpi_pytorch_tpu.obs.heartbeat import Heartbeat, flag_stragglers
+from mpi_pytorch_tpu.obs.schema import validate_jsonl, validate_record
+from mpi_pytorch_tpu.obs.trace import Tracer
+
+__all__ = [
+    "Heartbeat",
+    "NonFiniteLossError",
+    "StepHealth",
+    "Tracer",
+    "device_bytes_in_use",
+    "flag_stragglers",
+    "validate_jsonl",
+    "validate_record",
+]

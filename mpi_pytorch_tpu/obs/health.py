@@ -1,0 +1,195 @@
+"""Per-step training-health metrics + the non-finite-loss sentinel (obs
+tentpole part 2).
+
+The per-epoch record answers "how fast was the epoch"; these records answer
+"what is the step doing RIGHT NOW": data-wait vs device-compute ms, loss,
+global gradient norm, live HBM bytes, and a recompile counter — the per-phase
+instrumentation that turns "it's slow" into an actionable bottleneck
+(Awan et al., arXiv:1810.11112, and SURVEY §5).
+
+Costs are explicit: per-step records require one host sync per step (the
+loss must be read back), so ``step_metrics`` defaults off and benchmarks
+leave it off. The NaN/Inf sentinel defaults ON — it piggybacks on values
+the trainer already reads (the epoch loss; the per-step loss only when step
+telemetry is on), and training on a NaN'd loss is never the right outcome:
+it writes a ``kind="anomaly"`` diagnostic record and aborts cleanly
+(``NonFiniteLossError``) instead of burning an epoch on garbage.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Mapping
+
+# Backend compiles observed process-wide since the listener was installed.
+# jax.monitoring has no unregister, so ONE module-level listener increments
+# this global forever and StepHealth instances read deltas against their
+# epoch baseline — repeated train() calls in one process can't stack hooks.
+_compile_count = 0
+_listener_installed = False
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _ensure_compile_listener() -> None:
+    global _listener_installed
+    if _listener_installed:
+        return
+    import jax
+
+    def _on_event(name: str, _secs: float, **_kw) -> None:
+        global _compile_count
+        if name == _COMPILE_EVENT:
+            _compile_count += 1
+
+    jax.monitoring.register_event_duration_secs_listener(_on_event)
+    _listener_installed = True
+
+
+def compile_count() -> int:
+    """Backend compiles observed so far (0 until the listener is armed)."""
+    return _compile_count
+
+
+def device_bytes_in_use() -> int | None:
+    """Live HBM bytes on this process's first device, or None where the
+    backend has no ``memory_stats`` (CPU) — the record carries null rather
+    than a confident fake zero."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        if not stats:
+            return None
+        return int(stats.get("bytes_in_use"))
+    except Exception:
+        return None
+
+
+class NonFiniteLossError(RuntimeError):
+    """Raised by the sentinel AFTER the diagnostic record is written."""
+
+
+class StepHealth:
+    """Per-step health records (``kind="step"``) + the non-finite sentinel.
+
+    ``on_step`` is a no-op unless ``step_metrics`` is on — the default train
+    loop keeps its async dispatch; ``check_epoch`` runs regardless (the
+    epoch loss is already a host float there, so the sentinel is free)."""
+
+    def __init__(
+        self,
+        metrics,
+        *,
+        step_metrics: bool = False,
+        nan_sentinel: bool = True,
+        tracer=None,
+    ):
+        self.metrics = metrics
+        self.enabled = bool(step_metrics)
+        self.nan_sentinel = bool(nan_sentinel)
+        self.tracer = tracer
+        self._baseline = 0
+        if self.enabled:
+            _ensure_compile_listener()
+            self._baseline = _compile_count
+
+    def start_epoch(self) -> None:
+        """Re-arm the recompile counter: compiles BETWEEN epochs (first-call
+        validation/eval jits) are expected, so each epoch's records count
+        compiles since the epoch began — any nonzero value mid-epoch is the
+        silent-recompile smell this field exists to surface."""
+        if self.enabled:
+            self._baseline = _compile_count
+
+    def on_step(
+        self,
+        epoch: int,
+        step: int,
+        m: Mapping[str, Any],
+        data_wait_s: float | None = None,
+        step_s: float | None = None,
+    ) -> None:
+        if not self.enabled:
+            return
+        loss = float(m["loss"])
+        grad_norm = float(m["grad_norm"]) if "grad_norm" in m else None
+        self.metrics.write(
+            {
+                "kind": "step",
+                "epoch": epoch,
+                "step": step,
+                "loss": loss,
+                "grad_norm": grad_norm,
+                "data_wait_ms": None if data_wait_s is None else round(data_wait_s * 1e3, 3),
+                "step_ms": None if step_s is None else round(step_s * 1e3, 3),
+                "recompiles": _compile_count - self._baseline,
+                "hbm_bytes": device_bytes_in_use(),
+            }
+        )
+        self._sentinel(epoch, step, loss, grad_norm)
+
+    def on_scan_epoch(self, epoch: int, m: Mapping[str, Any]) -> None:
+        """Per-step records for the scan-epoch mode, post-hoc from the
+        ``[n_steps]`` metric arrays (the scan ran entirely on device, so
+        there is no per-step host timing to report — those fields are
+        null; loss/grad-norm/recompiles are real)."""
+        if not self.enabled:
+            return
+        import numpy as np
+
+        loss_v = np.asarray(m["loss"], np.float64)
+        norm_v = (
+            np.asarray(m["grad_norm"], np.float64) if "grad_norm" in m else None
+        )
+        for step in range(loss_v.shape[0]):
+            self.metrics.write(
+                {
+                    "kind": "step",
+                    "epoch": epoch,
+                    "step": step,
+                    "loss": float(loss_v[step]),
+                    "grad_norm": None if norm_v is None else float(norm_v[step]),
+                    "data_wait_ms": None,
+                    "step_ms": None,
+                    "recompiles": _compile_count - self._baseline,
+                    "hbm_bytes": device_bytes_in_use(),
+                }
+            )
+            self._sentinel(
+                epoch, step, float(loss_v[step]),
+                None if norm_v is None else float(norm_v[step]),
+            )
+
+    def check_epoch(self, epoch: int, loss: float) -> None:
+        """Epoch-granularity sentinel — the check every run gets for free."""
+        self._sentinel(epoch, None, float(loss), None)
+
+    def _sentinel(
+        self, epoch: int, step: int | None, loss: float, grad_norm: float | None
+    ) -> None:
+        if not self.nan_sentinel or math.isfinite(loss):
+            return
+        record = {
+            "kind": "anomaly",
+            "reason": "nonfinite_loss",
+            "epoch": epoch,
+            "step": step,
+            "loss": loss,
+            "grad_norm": grad_norm,
+        }
+        self.metrics.write(record)
+        if self.tracer is not None:
+            self.tracer.instant("nonfinite_loss", args={"epoch": epoch, "step": step})
+        from mpi_pytorch_tpu.utils.logging import run_logger
+
+        where = f"epoch {epoch}" + ("" if step is None else f" step {step}")
+        run_logger().error(
+            "non-finite loss (%s) at %s — aborting instead of training on "
+            "garbage (diagnostic kind='anomaly' record written; disable via "
+            "--nan-sentinel false)", loss, where,
+        )
+        raise NonFiniteLossError(
+            f"non-finite loss {loss} at {where}; see the kind='anomaly' "
+            "metrics record for diagnostics"
+        )

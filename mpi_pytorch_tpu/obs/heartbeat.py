@@ -1,0 +1,114 @@
+"""Multi-host step-time heartbeat with straggler flagging (obs tentpole
+part 3).
+
+On a pod, every step is a collective: ONE slow host sets the pace for all
+of them, and from process 0's per-epoch numbers a straggler is invisible —
+the epoch is just "slow". The heartbeat makes it visible: every N steps all
+processes exchange their mean step time over the collectives path
+(``parallel/collectives.host_allgather`` — tiny f32 rows, not tensors), and
+process 0's metrics stream gains a ``kind="heartbeat"`` record with the
+per-host rows plus the indices of any host slower than
+``straggler_threshold × median``.
+
+The exchange is itself a collective, so it must run at the SAME step on
+every process — the trainer guarantees that (``global_step_count`` syncs the
+loop), and the heartbeat only counts steps, never decides per-host.
+Single-process runs degrade gracefully: one row, never a straggler.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def flag_stragglers(per_host_ms, threshold: float) -> list[int]:
+    """Indices (= process ids) of hosts slower than ``threshold × median``.
+    Pure so the policy is unit-testable with a faked slow host; a non-finite
+    or zero median flags nothing (no baseline to be slow against)."""
+    a = np.asarray(per_host_ms, np.float64)
+    if a.size < 2:
+        return []
+    med = float(np.median(a))
+    if not np.isfinite(med) or med <= 0:
+        return []
+    return [int(i) for i in np.nonzero(a > threshold * med)[0]]
+
+
+class Heartbeat:
+    """Periodic per-host step-time aggregation into the metrics stream."""
+
+    def __init__(
+        self,
+        metrics,
+        *,
+        every_steps: int = 0,
+        threshold: float = 1.5,
+        batch_images: int = 0,
+        tracer=None,
+        gather=None,
+    ):
+        self.metrics = metrics
+        self.every = int(every_steps)
+        self.enabled = self.every > 0
+        self.threshold = float(threshold)
+        self.batch_images = int(batch_images)
+        self.tracer = tracer
+        if gather is None:
+            from mpi_pytorch_tpu.parallel.collectives import host_allgather
+
+            gather = host_allgather
+        self._gather = gather
+        self._window: list[float] = []
+
+    def start_epoch(self) -> None:
+        """Drop samples left over when an epoch's step count is not a
+        multiple of ``every`` (or a preemption broke the loop early) — a
+        beat must never average step times across epoch boundaries, where
+        compile/warmup skew from the previous epoch's tail would pollute
+        the per-host rows every process feeds the straggler median."""
+        self._window.clear()
+
+    def on_step(self, epoch: int, step: int, step_s: float) -> None:
+        """Accumulate this step's wall time; every ``every`` steps, exchange
+        and record. All processes call this at every step (the exchange is
+        a collective), and every process computes the same flags — only
+        process 0's MetricsWriter actually writes."""
+        if not self.enabled:
+            return
+        self._window.append(step_s)
+        if (step + 1) % self.every != 0:
+            return
+        local_ms = 1e3 * sum(self._window) / len(self._window)
+        self._window.clear()
+        per_host = np.asarray(self._gather(np.asarray([local_ms], np.float32)))
+        per_host_ms = [round(float(v), 3) for v in per_host[:, 0]]
+        stragglers = flag_stragglers(per_host_ms, self.threshold)
+        record = {
+            "kind": "heartbeat",
+            "epoch": epoch,
+            "step": step,
+            "step_ms": per_host_ms,
+            "median_step_ms": round(float(np.median(per_host_ms)), 3),
+            "stragglers": stragglers,
+            "threshold": self.threshold,
+        }
+        if self.batch_images:
+            # Steps are collective, so the GLOBAL pace is set by the slowest
+            # host — that is the throughput the run actually achieves.
+            record["images_per_sec"] = round(
+                self.batch_images / (max(per_host_ms) / 1e3), 1
+            )
+        self.metrics.write(record)
+        if self.tracer is not None:
+            self.tracer.instant(
+                "heartbeat", args={"step": step, "stragglers": stragglers}
+            )
+        if stragglers:
+            from mpi_pytorch_tpu.utils.logging import run_logger
+
+            run_logger().warning(
+                "straggler host(s) %s: step time %s ms vs median %.1f ms "
+                "(threshold %.2fx) at epoch %d step %d",
+                stragglers, [per_host_ms[i] for i in stragglers],
+                float(np.median(per_host_ms)), self.threshold, epoch, step,
+            )

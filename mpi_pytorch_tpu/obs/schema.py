@@ -1,0 +1,123 @@
+"""THE metrics-record schema — one definition, three consumers.
+
+``MetricsWriter`` streams are consumed by ``tools/report_run.py`` (render),
+``tools/check_results_artifacts.py`` (CI lint over the committed
+``docs/*_metrics.jsonl`` artifacts), and ad-hoc analysis; all three validate
+through here so the record shapes cannot drift between writer and readers.
+
+Deliberately dependency-free (no jax, no numpy): the tools import this
+module without initializing a backend.
+
+Record kinds (every record also carries ``ts``, the epoch-seconds stamp
+``MetricsWriter`` adds, and ``kind``):
+
+| kind      | required                                            | optional |
+|-----------|-----------------------------------------------------|----------|
+| epoch     | epoch, loss, time_s, images_per_sec                 | tflops, mfu_pct |
+| val       | epoch, accuracy, loss                               |          |
+| eval      | accuracy, loss, images, time_s                      |          |
+| step      | epoch, step, loss                                   | grad_norm, data_wait_ms, step_ms, recompiles, hbm_bytes |
+| heartbeat | epoch, step, step_ms, median_step_ms, stragglers, threshold | images_per_sec |
+| anomaly   | reason, epoch                                       | step, loss, grad_norm |
+
+Optional fields may be ``null`` (unknown on this backend — e.g. HBM bytes
+on CPU, per-step host timing in scan-epoch mode); required fields may not.
+Unknown EXTRA keys are allowed (forward compatibility); unknown KINDS are
+not (a typo'd kind is exactly the malformed record this schema exists to
+catch).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Mapping
+
+_NUM = (int, float)
+_INT = (int,)
+
+# kind -> {field: allowed types}. bool is an int subclass in Python; it is
+# never a valid metrics value, so the checker rejects it explicitly.
+REQUIRED: dict[str, dict[str, tuple]] = {
+    "epoch": {
+        "epoch": _INT, "loss": _NUM, "time_s": _NUM, "images_per_sec": _NUM,
+    },
+    "val": {"epoch": _INT, "accuracy": _NUM, "loss": _NUM},
+    "eval": {"accuracy": _NUM, "loss": _NUM, "images": _INT, "time_s": _NUM},
+    "step": {"epoch": _INT, "step": _INT, "loss": _NUM},
+    "heartbeat": {
+        "epoch": _INT, "step": _INT, "step_ms": (list,),
+        "median_step_ms": _NUM, "stragglers": (list,), "threshold": _NUM,
+    },
+    "anomaly": {"reason": (str,), "epoch": _INT},
+}
+
+OPTIONAL: dict[str, dict[str, tuple]] = {
+    "epoch": {"tflops": _NUM, "mfu_pct": _NUM},
+    "val": {},
+    "eval": {},
+    "step": {
+        "grad_norm": _NUM, "data_wait_ms": _NUM, "step_ms": _NUM,
+        "recompiles": _INT, "hbm_bytes": _INT,
+    },
+    "heartbeat": {"images_per_sec": _NUM},
+    "anomaly": {"step": _INT, "loss": _NUM, "grad_norm": _NUM},
+}
+
+
+def _type_ok(value: Any, types: tuple) -> bool:
+    return isinstance(value, types) and not isinstance(value, bool)
+
+
+def validate_record(rec: Any) -> list[str]:
+    """Problems with one parsed record ([] = valid)."""
+    if not isinstance(rec, Mapping):
+        return [f"record is {type(rec).__name__}, not an object"]
+    problems = []
+    kind = rec.get("kind")
+    if not isinstance(kind, str) or kind not in REQUIRED:
+        return [f"unknown kind {kind!r} (expected one of {sorted(REQUIRED)})"]
+    if not _type_ok(rec.get("ts"), _NUM):
+        problems.append("missing/non-numeric 'ts'")
+    for field, types in REQUIRED[kind].items():
+        if field not in rec:
+            problems.append(f"{kind}: missing required field {field!r}")
+        elif not _type_ok(rec[field], types):
+            problems.append(
+                f"{kind}: field {field!r} has type "
+                f"{type(rec[field]).__name__}, expected "
+                f"{'/'.join(t.__name__ for t in types)}"
+            )
+    for field, types in OPTIONAL[kind].items():
+        if field in rec and rec[field] is not None and not _type_ok(rec[field], types):
+            problems.append(
+                f"{kind}: optional field {field!r} has type "
+                f"{type(rec[field]).__name__}, expected "
+                f"{'/'.join(t.__name__ for t in types)} or null"
+            )
+    return problems
+
+
+def validate_jsonl(path: str) -> list[str]:
+    """Problems across a metrics JSONL file, tagged ``line N:`` ([] = valid)."""
+    problems = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                problems.append(f"line {lineno}: not JSON ({e})")
+                continue
+            problems.extend(f"line {lineno}: {p}" for p in validate_record(rec))
+    return problems
+
+
+def load_records(path: str) -> list[dict]:
+    """Parse a metrics JSONL (no validation — pair with ``validate_jsonl``)."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                records.append(json.loads(line))
+    return records

@@ -1,0 +1,163 @@
+"""Host-side trace spans in Chrome trace-event format (obs tentpole part 1).
+
+The repo already had two timing surfaces: per-epoch wall-clock (≙ the
+reference's ``MPI.Wtime`` pairs, ``main.py:145,158``) and the XLA device
+trace (``--profile-dir``). Neither shows WHERE host time goes inside a
+step — decode wait vs dispatch vs checkpoint stall. ``Tracer`` fills that
+gap: the drivers wrap their phases in ``span("ingest")`` / ``span("step")``
+/ ``span("checkpoint")`` / …, and the run writes one Chrome-trace JSON per
+process, loadable in ``chrome://tracing`` or Perfetto.
+
+Each span also enters ``jax.profiler.TraceAnnotation(name)``, so when an
+XLA trace is being captured at the same time (``--profile-dir``) the host
+spans appear on the profiler's host timeline with the SAME names — the
+overlay recipe in ``docs/OBSERVABILITY.md``.
+
+Disabled (empty path) the tracer is inert: ``span`` yields immediately and
+``close`` writes nothing, so the hot loop pays nothing for the capability.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Mapping
+
+
+def _trace_annotation(name: str):
+    """A ``jax.profiler.TraceAnnotation`` for ``name``, or None when jax (or
+    its profiler) is unavailable — the tracer itself never requires jax."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return None
+
+
+def trace_path(path: str, process: int, process_count: int) -> str:
+    """Per-process trace file: the given path verbatim for a single-process
+    run, ``name.pN.json``-style otherwise (every process writes its own
+    events; merge by concatenating ``traceEvents`` — pids differ)."""
+    if process_count <= 1:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}.p{process}{ext or '.json'}"
+
+
+class Tracer:
+    """Chrome-trace-event span recorder. Thread-safe (the async checkpointer
+    and loader threads may span concurrently); events buffer in memory and
+    ``close()`` writes one valid JSON object — the trace of an aborted run
+    is whatever ``close()`` was reached with (the drivers close on their
+    failure paths too)."""
+
+    def __init__(self, path: str | None, clock=time.perf_counter):
+        self.path = path or None
+        self._clock = clock
+        self._t0 = clock()
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._pid: int | None = None
+        self._closed = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.path is not None and not self._closed
+
+    def _process_index(self) -> int:
+        if self._pid is None:
+            from mpi_pytorch_tpu.utils.logging import process_index
+
+            self._pid = process_index()
+        return self._pid
+
+    def _now_us(self) -> float:
+        return (self._clock() - self._t0) * 1e6
+
+    def begin(self, name: str, cat: str = "host"):
+        """Open a span manually — for regions that span control-flow a
+        ``with`` block can't wrap cleanly (the trainer's compile branches).
+        Returns a token for ``end``; None when disabled."""
+        if not self.enabled:
+            return None
+        ann = _trace_annotation(name)
+        if ann is not None:
+            ann.__enter__()
+        return (name, cat, self._now_us(), ann)
+
+    def end(self, token, args: Mapping[str, Any] | None = None) -> None:
+        if token is None:
+            return
+        name, cat, ts, ann = token
+        # Balance the TraceAnnotation even when the tracer was closed
+        # mid-span (failure-path flush) — the event is dropped, the
+        # profiler's host annotation stack must not be.
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        if not self.enabled:
+            return
+        event = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",  # complete event: ts+dur; nesting renders from overlap
+            "ts": round(ts, 3),  # Chrome trace timestamps are microseconds
+            "dur": round(self._now_us() - ts, 3),
+            "pid": self._process_index(),
+            "tid": threading.get_ident() % 2**31,
+        }
+        if args:
+            event["args"] = dict(args)
+        with self._lock:
+            self._events.append(event)
+
+    @contextmanager
+    def span(self, name: str, cat: str = "host", args: Mapping[str, Any] | None = None):
+        """``with tracer.span("ingest"): ...`` — the primary API."""
+        token = self.begin(name, cat)
+        try:
+            yield
+        finally:
+            self.end(token, args)
+
+    def instant(self, name: str, args: Mapping[str, Any] | None = None) -> None:
+        """A zero-duration marker (anomalies, heartbeats) on the timeline."""
+        if not self.enabled:
+            return
+        event = {
+            "name": name,
+            "cat": "marker",
+            "ph": "i",
+            "s": "p",  # process-scoped marker line
+            "ts": round(self._now_us(), 3),
+            "pid": self._process_index(),
+            "tid": threading.get_ident() % 2**31,
+        }
+        if args:
+            event["args"] = dict(args)
+        with self._lock:
+            self._events.append(event)
+
+    def close(self) -> str | None:
+        """Write the trace JSON (idempotent); returns the written path."""
+        if self.path is None or self._closed:
+            return None
+        self._closed = True
+        try:
+            import jax
+
+            procs, pid = jax.process_count(), jax.process_index()
+        except Exception:
+            procs, pid = 1, 0
+        out = trace_path(self.path, pid, procs)
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        with self._lock, open(out, "w") as f:
+            json.dump(
+                {"traceEvents": self._events, "displayTimeUnit": "ms"},
+                f,
+                separators=(",", ":"),
+            )
+        return out

@@ -317,6 +317,25 @@ def _predict_kernel(
         pred_ref[...] = arg_ref[...]
 
 
+# One warning per (process, reason): a TPU caller asking for the fused
+# predictions kernel but landing on the XLA reference must be told
+# (advisor r5 — the gates here used to be silent). The non-TPU branch stays
+# quiet: CPU is the reference path by design, not a degradation.
+_predict_fallback_warned: set[str] = set()
+
+
+def _warn_predict_fallback(reason: str) -> None:
+    if reason in _predict_fallback_warned:
+        return
+    _predict_fallback_warned.add(reason)
+    from mpi_pytorch_tpu.utils.logging import run_logger
+
+    run_logger().warning(
+        "head_predict falling back to the XLA reference (logits "
+        "materialized): %s", reason,
+    )
+
+
 def head_predict_reference(feats, w, b, labels):
     """Plain-XLA reference/fallback: explicit logits, CE + argmax."""
     logits = (feats.astype(jnp.float32) @ w.astype(jnp.float32)) + b.astype(jnp.float32)
@@ -414,9 +433,16 @@ def head_predict(
             n_data = dp_mesh.shape[dp_mesh.axis_names[0]]
     rows = feats.shape[0]
     if rows % n_data:
+        _warn_predict_fallback(
+            f"batch rows {rows} not divisible by the data axis ({n_data})"
+        )
         return head_predict_reference(feats, w, b, labels)
     block_r = _predict_row_block(rows // n_data)
     if block_r is None:
+        _warn_predict_fallback(
+            f"no power-of-two row tiling divides {rows // n_data} per-shard "
+            f"rows within the {PREDICT_MAX_ROWS}-row VMEM envelope"
+        )
         return head_predict_reference(feats, w, b, labels)
     labels = labels.astype(jnp.int32)
     # Compute dtype = the feature dtype: bf16 halves W's VMEM stream (the

@@ -72,3 +72,24 @@ def sync_params(params: Any, axis: str = "data", root: int = 0) -> Any:
     replication is maintained by the compiler; kept for SPMD-explicit code
     and for repairing divergence after per-shard mutation."""
     return broadcast_from(params, axis=axis, root=root)
+
+
+def host_allgather(values) -> "Any":
+    """HOST-side allgather of a small per-process f32 vector: ``[k]`` on each
+    process → ``[process_count, k]`` on every process, row p = process p's
+    contribution (≙ ``comm.allgather`` — the one reference collective with no
+    in-step equivalent here, because auto-partitioned jit never needs it).
+
+    This is the telemetry exchange path (``obs/heartbeat.py``): step-time /
+    throughput rows, a few floats per host, NOT tensors — the device hop is
+    one tiny collective over the same ICI/DCN fabric as the gradient
+    all-reduce. Every process must call it at the same point (it is a
+    collective); single-process is the identity with a leading axis."""
+    import numpy as np
+
+    vals = np.atleast_1d(np.asarray(values, np.float32))
+    if jax.process_count() == 1:
+        return vals[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(vals))

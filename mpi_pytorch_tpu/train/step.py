@@ -133,11 +133,17 @@ def make_train_step(
     Memoized so repeated ``train()`` calls in one process (resume, tests)
     reuse the same jitted function and its XLA compilation cache."""
 
-    def compute_metrics(loss, logits, labels):
+    def compute_metrics(loss, logits, labels, grads):
+        # grad_norm: the global (all-parameter) L2 norm — the training-health
+        # signal the obs layer records per step (obs/health.py). A scalar
+        # reduction XLA fuses into the backward; negligible next to the
+        # matmuls, and present in every step flavor so telemetry can't
+        # depend on which mode a run uses.
         return {
             "loss": loss,
             "correct": accuracy_count(logits, labels),
             "count": valid_count(labels),
+            "grad_norm": optax.global_norm(grads).astype(jnp.float32),
         }
 
     if accum_steps <= 1:
@@ -151,7 +157,7 @@ def make_train_step(
                 state, images, labels, rng, remat=remat
             )
             new_state = _apply_updates(state, grads, new_bs)
-            return new_state, compute_metrics(loss, logits, labels)
+            return new_state, compute_metrics(loss, logits, labels, grads)
 
         return train_step
 
@@ -237,7 +243,14 @@ def make_train_step(
             lambda g: g / denom.astype(g.dtype), grad_sum
         )
         new_state = _apply_updates(state, grads, new_bs)
-        metrics = {"loss": loss_sum / denom, "correct": correct, "count": count}
+        metrics = {
+            "loss": loss_sum / denom,
+            "correct": correct,
+            "count": count,
+            # Norm of the ACCUMULATED (count-weighted mean) gradient — the
+            # same quantity the unsplit step reports.
+            "grad_norm": optax.global_norm(grads).astype(jnp.float32),
+        }
         return new_state, metrics
 
     return accum_train_step
@@ -328,6 +341,7 @@ def _cached_batch_step(
         "loss": loss,
         "correct": accuracy_count(logits, labels),
         "count": valid_count(labels),
+        "grad_norm": optax.global_norm(grads).astype(jnp.float32),
     }
     return new_state, metrics
 
@@ -545,6 +559,9 @@ def make_spmd_train_step(mesh, compute_dtype=jnp.bfloat16, remat: bool = False) 
             / jnp.maximum(global_count.astype(loss.dtype), 1),
             "correct": lax.psum(accuracy_count(logits, labels), data_axis),
             "count": global_count,
+            # grads were just pmean'd: every shard computes the identical
+            # global-gradient norm, so no further collective is needed.
+            "grad_norm": optax.global_norm(grads).astype(jnp.float32),
         }
         return new_state, metrics
 

@@ -32,6 +32,7 @@ from mpi_pytorch_tpu import checkpoint as ckpt
 from mpi_pytorch_tpu.config import Config
 from mpi_pytorch_tpu.data import DataLoader, load_manifests
 from mpi_pytorch_tpu.models import create_model_bundle
+from mpi_pytorch_tpu.obs import Heartbeat, StepHealth, Tracer
 from mpi_pytorch_tpu.parallel.mesh import create_mesh, flat_mesh, shard_batch
 from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
 from mpi_pytorch_tpu.train.step import (
@@ -530,7 +531,54 @@ def train(cfg: Config) -> TrainSummary:
     apply_runtime_flags(cfg)
     logger = init_logger("MPT", cfg.log_file)
     metrics = MetricsWriter(cfg.metrics_file)
-    mesh, bundle, state, (train_manifest, test_manifest, loader) = build_training(cfg)
+    # Run telemetry (obs/): host-side trace spans, per-step health records,
+    # the NaN sentinel, and the multi-host straggler heartbeat. All inert
+    # unless their knobs are set (the sentinel's epoch check is free).
+    tracer = Tracer(cfg.trace_file)
+    health = StepHealth(
+        metrics, step_metrics=cfg.step_metrics, nan_sentinel=cfg.nan_sentinel,
+        tracer=tracer,
+    )
+    heartbeat = Heartbeat(
+        metrics, every_steps=cfg.heartbeat_every_steps,
+        threshold=cfg.straggler_threshold, batch_images=cfg.batch_size,
+        tracer=tracer,
+    )
+    if heartbeat.enabled and cfg.device_cache and cfg.scan_epoch:
+        # The scan runs the whole epoch on device — there are no per-step
+        # host returns to beat on. Surface it instead of silently recording
+        # nothing (the fused-head-eval lesson, advisor r5).
+        run_logger().warning(
+            "heartbeat_every_steps=%d has no effect with scan_epoch=True "
+            "(the epoch is one device-side scan; no per-step host "
+            "boundaries to exchange step times at)",
+            cfg.heartbeat_every_steps,
+        )
+        heartbeat.enabled = False
+    # Per-step telemetry must observe step COMPLETION, not dispatch: block
+    # on the step's metrics before timestamping (documented cost of
+    # step_metrics/heartbeat; the default loop stays fully async).
+    telemetry_sync = health.enabled or heartbeat.enabled
+    try:
+        return _train_impl(
+            cfg, logger, metrics, tracer, health, heartbeat, telemetry_sync
+        )
+    except BaseException:
+        # A failure anywhere — including build/cache/compile, BEFORE the
+        # epoch loop's own handler exists — must still flush the buffered
+        # spans: the aborted run is exactly the one whose trace is needed.
+        try:
+            tracer.close()
+        except BaseException as terr:
+            logger.warning("trace write also failed: %s", terr)
+        raise
+
+
+def _train_impl(
+    cfg: Config, logger, metrics, tracer, health, heartbeat, telemetry_sync
+) -> TrainSummary:
+    with tracer.span("build"):
+        mesh, bundle, state, (train_manifest, test_manifest, loader) = build_training(cfg)
     logger.info(
         "world: %d process(es), %d device(s), mesh %s",
         jax.process_count(), jax.device_count(), dict(mesh.shape),
@@ -559,6 +607,12 @@ def train(cfg: Config) -> TrainSummary:
     # whole run, and the executable's cost analysis gives exact FLOPs/step for
     # MFU logging (SURVEY §5 — the reference has only wall-clock timers).
     n_steps = global_step_count(len(train_manifest), host_batch, cfg.drop_remainder)
+    # begin/end token rather than a with-block: the compile region below
+    # branches four ways and re-indenting it buys nothing. Opened AFTER the
+    # cache build in the device-cache branch — a span that swallowed the
+    # dataset decode would misattribute ingest time to XLA, the exact
+    # confusion the tracer exists to prevent.
+    _compile_span = None
     dataset = labels_all = None
     val_loader = None  # built lazily, then reused so its host cache persists
     # Cached-mode index batches are GLOBAL (every host draws the identical
@@ -572,13 +626,15 @@ def train(cfg: Config) -> TrainSummary:
         n_steps = (
             n_cache // cache_batch if cfg.drop_remainder else -(-n_cache // cache_batch)
         )
-        dataset, labels_all = build_device_cache(cfg, train_manifest, loader, mesh)
+        with tracer.span("cache_build"):
+            dataset, labels_all = build_device_cache(cfg, train_manifest, loader, mesh)
         n_data = mesh.shape[cfg.mesh.data_axis]
         logger.info(
             "device cache: %d images, rows sharded over %d device(s) "
             "(%.1f MB/device %s)",
             n_cache, n_data, dataset.nbytes / n_data / 1e6, dataset.dtype,
         )
+        _compile_span = tracer.begin("compile")
         # The per-step program is the FLOPs reference either way; the scan
         # mode reuses the Lowered (cost analysis needs no backend compile)
         # because XLA counts a scan body once regardless of trip count.
@@ -604,6 +660,7 @@ def train(cfg: Config) -> TrainSummary:
                 compiler_options=cfg.parsed_compiler_options()
             )
     else:
+        _compile_span = tracer.begin("compile")
         step_fn = (
             make_spmd_train_step(mesh, _dtype(cfg.compute_dtype), remat=(cfg.remat == "full"))
             if cfg.spmd_mode
@@ -650,7 +707,18 @@ def train(cfg: Config) -> TrainSummary:
             flops_per_step = cand if cand > 0 else est
     else:
         flops_per_step = hw.step_flops(compiled_step)
+    tracer.end(_compile_span)
     peak = hw.peak_bf16_tflops(jax.devices()[0])
+    if heartbeat.enabled and heartbeat.every > n_steps:
+        # Beats never span epoch boundaries (the window resets per epoch),
+        # so an interval longer than the epoch would silently never fire —
+        # the same silent-degrade class as the scan_epoch case above.
+        run_logger().warning(
+            "heartbeat_every_steps=%d exceeds the %d step(s) per epoch — no "
+            "heartbeat will ever fire (beats never span epoch boundaries); "
+            "lower it to at most the per-epoch step count",
+            heartbeat.every, n_steps,
+        )
 
     summary = TrainSummary()
     checkpointer = ckpt.AsyncCheckpointer()
@@ -697,6 +765,8 @@ def train(cfg: Config) -> TrainSummary:
                 )
                 break
             t0 = time.perf_counter()  # ≙ MPI.Wtime() (main.py:145)
+            health.start_epoch()  # re-arm the recompile counter per epoch
+            heartbeat.start_epoch()  # beats never span epoch boundaries
             losses, counts = [], []
             loss_v = count_v = None  # [steps] device arrays, set below
             if cfg.device_cache and cfg.scan_epoch:
@@ -710,8 +780,15 @@ def train(cfg: Config) -> TrainSummary:
                 if idx_steps:  # zero-step epochs (tiny shard + drop_remainder) no-op
                     idx_all = np.stack([i for i, _ in idx_steps])
                     valid_all = np.stack([v for _, v in idx_steps])
-                    state, m = compiled_step(state, dataset, labels_all, idx_all, valid_all)
+                    with tracer.span("step", args={"epoch": epoch, "mode": "scan"}):
+                        state, m = compiled_step(state, dataset, labels_all, idx_all, valid_all)
+                        if telemetry_sync:
+                            jax.block_until_ready(m["loss"])
                     loss_v, count_v = m["loss"], m["count"]
+                    # Per-step records post-hoc from the [n_steps] arrays
+                    # (host timing is null — the scan never returns to the
+                    # host between steps); sentinel checks every step.
+                    health.on_scan_epoch(epoch, m)
                     if cfg.log_every_steps:
                         for step_i in range(
                             cfg.log_every_steps - 1, int(loss_v.shape[0]), cfg.log_every_steps
@@ -743,7 +820,20 @@ def train(cfg: Config) -> TrainSummary:
                     )
                 )
             stopped_mid_epoch = False
-            for step_i, args in enumerate(step_args):
+            step_iter = iter(step_args)
+            step_i = -1
+            while True:
+                # Ingest span = time the consumer WAITS for the next batch:
+                # decode + H2D dispatch not yet hidden by prefetch — the
+                # host-side half of the data-wait vs device-compute split
+                # the per-step records carry.
+                t_ingest = time.perf_counter()
+                with tracer.span("ingest"):
+                    args = next(step_iter, None)
+                if args is None:
+                    break
+                data_wait_s = time.perf_counter() - t_ingest
+                step_i += 1
                 # Single-process: stop promptly at a step boundary, dropping
                 # the partial epoch (its updates stay in `state` but aren't
                 # reported or saved as a completed epoch). Multi-host stops
@@ -752,9 +842,16 @@ def train(cfg: Config) -> TrainSummary:
                 if guard.triggered and jax.process_count() == 1:
                     stopped_mid_epoch = True
                     break
-                state, m = compiled_step(state, *args)
+                t_step = time.perf_counter()
+                with tracer.span("step", args={"epoch": epoch, "step": step_i}):
+                    state, m = compiled_step(state, *args)
+                    if telemetry_sync:
+                        jax.block_until_ready(m["loss"])
+                step_s = time.perf_counter() - t_step
                 losses.append(m["loss"])
                 counts.append(m["count"])
+                health.on_step(epoch, step_i, m, data_wait_s, step_s)
+                heartbeat.on_step(epoch, step_i, step_s)
                 if cfg.log_every_steps and (step_i + 1) % cfg.log_every_steps == 0:
                     logger.info(
                         "epoch %d step %d loss %.4f", epoch, step_i + 1, float(m["loss"])
@@ -806,6 +903,10 @@ def train(cfg: Config) -> TrainSummary:
                 {"kind": "epoch", "epoch": epoch, "loss": epoch_loss, "time_s": dt,
                  "images_per_sec": ips, "tflops": tflops, "mfu_pct": mfu}
             )
+            if steps_run and n_valid:
+                # Free epoch-granularity sentinel (the loss is already a
+                # host float); zero-valid-row epochs are legitimately NaN.
+                health.check_epoch(epoch, epoch_loss)
             summary.epoch_times.append(dt)
             summary.epoch_losses.append(epoch_loss)
             summary.epochs_run += 1
@@ -817,11 +918,12 @@ def train(cfg: Config) -> TrainSummary:
                 # relay). ≙ rank-0 save (main.py:162-171), without stopping the
                 # world.
                 ckpt_t0 = time.perf_counter()
-                path = checkpointer.save(
-                    cfg.checkpoint_dir, epoch=epoch, state=state, loss=epoch_loss,
-                    keep=cfg.keep_checkpoints,
-                    moments_bf16=cfg.ckpt_bf16_moments,
-                )
+                with tracer.span("checkpoint", args={"epoch": epoch}):
+                    path = checkpointer.save(
+                        cfg.checkpoint_dir, epoch=epoch, state=state, loss=epoch_loss,
+                        keep=cfg.keep_checkpoints,
+                        moments_bf16=cfg.ckpt_bf16_moments,
+                    )
                 last_saved_epoch = epoch
                 if path:
                     summary.checkpoint_path = path
@@ -831,34 +933,40 @@ def train(cfg: Config) -> TrainSummary:
                     )
 
             if cfg.validate:
-                # Reference quirk preserved behind a flag: validation runs over the
-                # TRAIN manifest (main.py:104-112; SURVEY §3); val_on_train=False
-                # gives the honest test-split validation.
-                val_manifest = train_manifest if cfg.val_on_train else test_manifest
-                if cfg.device_cache and cfg.val_on_train:
-                    # The cached train set IS the val set (main.py:104-112
-                    # semantics): validate straight out of HBM.
-                    acc, vloss = evaluate_cached(cfg, state, mesh, dataset, labels_all)
-                else:
-                    if val_loader is None:
-                        val_loader = make_eval_loader(
-                            cfg, val_manifest, host_cache=cfg.host_cache
+                _val_span = tracer.begin("validate")
+                try:
+                    # Reference quirk preserved behind a flag: validation runs over the
+                    # TRAIN manifest (main.py:104-112; SURVEY §3); val_on_train=False
+                    # gives the honest test-split validation.
+                    val_manifest = train_manifest if cfg.val_on_train else test_manifest
+                    if cfg.device_cache and cfg.val_on_train:
+                        # The cached train set IS the val set (main.py:104-112
+                        # semantics): validate straight out of HBM.
+                        acc, vloss = evaluate_cached(cfg, state, mesh, dataset, labels_all)
+                    else:
+                        if val_loader is None:
+                            val_loader = make_eval_loader(
+                                cfg, val_manifest, host_cache=cfg.host_cache
+                            )
+                        if (
+                            cfg.host_cache
+                            and cfg.val_on_train
+                            and not val_loader._cache_complete
+                        ):
+                            # Same shard, same decode params: share the train
+                            # loader's cache instead of decoding a second copy.
+                            # Join the train loader's background backfill first —
+                            # it finishes in bounded time, and adopting beats
+                            # starting a duplicate full-shard decode.
+                            loader.wait_cache_complete()
+                            val_loader.adopt_cache(loader)
+                        acc, vloss = evaluate_manifest(
+                            cfg, state, mesh, val_manifest, loader=val_loader
                         )
-                    if (
-                        cfg.host_cache
-                        and cfg.val_on_train
-                        and not val_loader._cache_complete
-                    ):
-                        # Same shard, same decode params: share the train
-                        # loader's cache instead of decoding a second copy.
-                        # Join the train loader's background backfill first —
-                        # it finishes in bounded time, and adopting beats
-                        # starting a duplicate full-shard decode.
-                        loader.wait_cache_complete()
-                        val_loader.adopt_cache(loader)
-                    acc, vloss = evaluate_manifest(
-                        cfg, state, mesh, val_manifest, loader=val_loader
-                    )
+                finally:
+                    # finally: a crashed validation must still appear in the
+                    # flushed trace as the span the run died in.
+                    tracer.end(_val_span, args={"epoch": epoch})
                 summary.val_accuracy = acc
                 logger.info("Accuracy of the network: %.4f (val_on_train=%s)", acc, cfg.val_on_train)
                 metrics.write({"kind": "val", "epoch": epoch, "accuracy": acc, "loss": vloss})
@@ -901,7 +1009,8 @@ def train(cfg: Config) -> TrainSummary:
       except BaseException:
         # Drain the in-flight write on the failure path too, but never let a
         # secondary writer error replace the primary exception the user
-        # needs to see.
+        # needs to see. (The trace flush on failure lives in train()'s
+        # outer handler, which also covers build/compile-time crashes.)
         try:
             checkpointer.wait()
         except BaseException as werr:
@@ -942,6 +1051,9 @@ def train(cfg: Config) -> TrainSummary:
     wall = time.perf_counter() - train_t0
     summary.final_loss = epoch_loss
     summary.images_per_sec = total_images / wall if wall > 0 else 0.0
+    trace_out = tracer.close()
+    if trace_out:
+        logger.info("host trace spans written to %s (chrome://tracing)", trace_out)
     metrics.close()
     return summary
 

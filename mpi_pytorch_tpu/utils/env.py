@@ -1,13 +1,17 @@
 """Boolean ``MPT_*`` env-knob parsing — ONE definition of truthiness.
 
 Every boolean knob in the framework reads through here so the convention
-(case-insensitive; '', '0', 'false' mean off, anything else means on)
-cannot drift between call sites.
+(case-insensitive; '', '0', 'false', 'no', 'off' mean off, anything else
+means on — the same falsy set the CLI's ``--flag`` parser accepts,
+``config._str2bool``) cannot drift between call sites. Advisor r5: 'no'
+used to silently mean ON because only ''/'0'/'false' were recognized.
 """
 
 from __future__ import annotations
 
 import os
+
+FALSY = ("", "0", "false", "no", "off")
 
 
 def env_flag(name: str, default: bool = False) -> bool:
@@ -15,4 +19,4 @@ def env_flag(name: str, default: bool = False) -> bool:
     raw = os.environ.get(name)
     if raw is None:
         return default
-    return raw.lower() not in ("", "0", "false")
+    return raw.lower() not in FALSY

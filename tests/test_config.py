@@ -170,3 +170,20 @@ def test_parsed_compiler_options_coercion():
         "name": "text",
     }
     assert parse_config([]).parsed_compiler_options() is None
+
+
+def test_env_flag_falsy_spellings(monkeypatch):
+    """ONE definition of env truthiness (utils/env.py): any case of
+    ''/'0'/'false'/'no'/'off' disables — advisor r5 found 'False'/'no'
+    silently enabling MPT_FUSED_STEM in the bench harnesses."""
+    from mpi_pytorch_tpu.utils.env import env_flag
+
+    for val in ("", "0", "false", "False", "FALSE", "no", "No", "off", "OFF"):
+        monkeypatch.setenv("MPT_TEST_FLAG", val)
+        assert env_flag("MPT_TEST_FLAG", default=True) is False, repr(val)
+    for val in ("1", "true", "True", "yes", "on"):
+        monkeypatch.setenv("MPT_TEST_FLAG", val)
+        assert env_flag("MPT_TEST_FLAG", default=False) is True, repr(val)
+    monkeypatch.delenv("MPT_TEST_FLAG")
+    assert env_flag("MPT_TEST_FLAG", default=True) is True
+    assert env_flag("MPT_TEST_FLAG", default=False) is False

@@ -269,3 +269,66 @@ def test_fused_head_predict_step_falls_back_for_conv_head(tmp_path):
     np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
     for k in ("loss", "correct", "count"):
         np.testing.assert_allclose(float(m1[k]), float(m2[k]), rtol=1e-5, atol=1e-5)
+
+
+def test_fused_head_predict_step_rejects_intermediate_head_dense():
+    """A future zoo model with an INTERMEDIATE Dense named 'head' (more
+    layers after it) must fail loudly at trace time — the interceptor's
+    captured features would not be the logits' features, and without the
+    shape assert the step would silently compute metrics from the wrong
+    layer (advisor r5)."""
+    from flax import linen as nn
+    from jax.sharding import Mesh
+
+    import optax
+
+    from mpi_pytorch_tpu.evaluate import _make_predict_step
+    from mpi_pytorch_tpu.train.state import TrainState
+
+    class MidHead(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.Dense(16, name="head")(x)  # fires the interceptor filter
+            return nn.Dense(12, name="out")(x)  # ...but is NOT the output
+
+    model = MidHead()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)))
+    state = TrainState.create(
+        apply_fn=model.apply, variables=variables,
+        tx=optax.identity(), rng=jax.random.PRNGKey(1),
+    )
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("data", "model"))
+    images = np.zeros((4, 8, 8, 3), np.float32)
+    labels = np.asarray([1, 2, -1, 3], np.int32)
+
+    fused = _make_predict_step(mesh, jnp.float32, fused_head=True)
+    with pytest.raises(AssertionError, match="does not match the model output"):
+        fused(state, (jnp.asarray(images), jnp.asarray(labels)))
+
+
+def test_fused_head_fallback_warns_once_on_run_logger():
+    """The silent-degrade advisor finding: when a gate forces
+    --fused-head-eval back to the plain step, a warning must land on the
+    rank-tagged run logger (the one with real handlers), exactly once per
+    reason per process."""
+    import logging
+
+    from mpi_pytorch_tpu import evaluate as ev
+    from mpi_pytorch_tpu.utils.logging import run_logger
+
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    logger = run_logger()
+    logger.addHandler(handler)
+    try:
+        ev._fused_head_warned.discard("test-reason")
+        ev._warn_fused_head_fallback("test-reason")
+        ev._warn_fused_head_fallback("test-reason")  # deduped
+        assert len(records) == 1
+        msg = records[0].getMessage()
+        assert "fused-head-eval" in msg and "test-reason" in msg
+    finally:
+        logger.removeHandler(handler)
+        ev._fused_head_warned.discard("test-reason")

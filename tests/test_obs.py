@@ -1,0 +1,451 @@
+"""Run-telemetry subsystem (mpi_pytorch_tpu/obs/): span tracer output
+format and nesting, per-step health record schema, the NaN-sentinel abort
+path, straggler flagging with a faked slow host, the report tool against
+both a live dryrun and the committed artifacts, and the grad-norm metric
+every train-step flavor now carries."""
+
+import json
+import math
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_pytorch_tpu.obs import (
+    Heartbeat,
+    NonFiniteLossError,
+    StepHealth,
+    Tracer,
+    flag_stragglers,
+)
+from mpi_pytorch_tpu.obs.schema import validate_jsonl, validate_record
+from mpi_pytorch_tpu.utils.logging import MetricsWriter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import report_run  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_span_nesting_and_chrome_format(tmp_path):
+    """Spans emit Chrome 'X' (complete) events whose ts/dur nest correctly,
+    args round-trip, and close() writes one valid JSON object."""
+    path = str(tmp_path / "trace.json")
+    tracer = Tracer(path)
+    with tracer.span("outer"):
+        with tracer.span("inner", args={"step": 3}):
+            pass
+    tracer.instant("marker", args={"why": "test"})
+    out = tracer.close()
+    assert out == path
+
+    data = json.load(open(path))
+    events = {e["name"]: e for e in data["traceEvents"]}
+    assert set(events) == {"outer", "inner", "marker"}
+    outer, inner = events["outer"], events["inner"]
+    assert outer["ph"] == inner["ph"] == "X"
+    assert events["marker"]["ph"] == "i"
+    # inner completes first (events append at span END), and sits inside
+    # outer's [ts, ts+dur) window — the property Chrome renders as nesting.
+    assert inner["ts"] >= outer["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-6
+    assert inner["args"] == {"step": 3}
+    assert outer["pid"] == 0  # single-process test env
+
+
+def test_tracer_disabled_is_inert(tmp_path):
+    tracer = Tracer("")
+    with tracer.span("anything"):
+        pass
+    assert tracer.close() is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_tracer_close_idempotent_and_creates_dirs(tmp_path):
+    path = str(tmp_path / "deep" / "dir" / "t.json")
+    tracer = Tracer(path)
+    with tracer.span("s"):
+        pass
+    assert tracer.close() == path
+    assert tracer.close() is None  # second close: no rewrite
+    assert json.load(open(path))["traceEvents"]
+
+
+def test_trace_path_per_process_suffix():
+    from mpi_pytorch_tpu.obs.trace import trace_path
+
+    assert trace_path("run.json", 0, 1) == "run.json"
+    assert trace_path("run.json", 2, 4) == "run.p2.json"
+    assert trace_path("run", 1, 2) == "run.p1.json"
+
+
+# ---------------------------------------------------------------------------
+# per-step health records + NaN sentinel
+# ---------------------------------------------------------------------------
+
+
+def test_step_health_record_matches_schema(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    writer = MetricsWriter(path)
+    health = StepHealth(writer, step_metrics=True)
+    health.start_epoch()
+    health.on_step(0, 0, {"loss": 1.5, "grad_norm": 2.25}, 0.012, 0.345)
+    writer.close()
+
+    assert validate_jsonl(path) == []
+    (rec,) = [json.loads(line) for line in open(path)]
+    assert rec["kind"] == "step"
+    assert rec["loss"] == 1.5 and rec["grad_norm"] == 2.25
+    assert rec["data_wait_ms"] == 12.0 and rec["step_ms"] == 345.0
+    assert isinstance(rec["recompiles"], int)
+    assert rec["hbm_bytes"] is None  # CPU test env has no memory_stats
+
+
+def test_step_health_disabled_never_syncs(tmp_path):
+    """With step_metrics off, on_step must not touch the metrics values at
+    all (reading them would force a per-step device sync in real runs)."""
+    writer = MetricsWriter(str(tmp_path / "m.jsonl"))
+
+    class Exploding:
+        def __getitem__(self, key):  # pragma: no cover - must not be hit
+            raise AssertionError("on_step read a metric while disabled")
+
+        def __contains__(self, key):
+            raise AssertionError("on_step probed a metric while disabled")
+
+    health = StepHealth(writer, step_metrics=False)
+    health.on_step(0, 0, Exploding(), 0.0, 0.0)  # must be a silent no-op
+    writer.close()
+
+
+def test_nan_sentinel_writes_diagnostic_and_aborts(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    writer = MetricsWriter(path)
+    health = StepHealth(writer, step_metrics=True)
+    with pytest.raises(NonFiniteLossError, match="epoch 1 step 4"):
+        health.on_step(1, 4, {"loss": float("nan"), "grad_norm": 7.0}, 0.0, 0.1)
+    writer.close()
+
+    records = [json.loads(line) for line in open(path)]
+    # The poisoned step record lands first, then the diagnostic.
+    assert [r["kind"] for r in records] == ["step", "anomaly"]
+    anomaly = records[-1]
+    assert anomaly["reason"] == "nonfinite_loss"
+    assert (anomaly["epoch"], anomaly["step"]) == (1, 4)
+    assert math.isnan(anomaly["loss"]) and anomaly["grad_norm"] == 7.0
+    assert validate_jsonl(path) == []
+
+
+def test_nan_sentinel_epoch_check_and_opt_out(tmp_path):
+    writer = MetricsWriter(str(tmp_path / "m.jsonl"))
+    health = StepHealth(writer, step_metrics=False)  # default run shape
+    health.check_epoch(2, 1.25)  # finite: fine
+    with pytest.raises(NonFiniteLossError):
+        health.check_epoch(2, float("inf"))
+    writer.close()
+
+    off = StepHealth(MetricsWriter(None), step_metrics=False, nan_sentinel=False)
+    off.check_epoch(0, float("nan"))  # explicitly disabled: keep going
+
+
+def test_scan_epoch_records_and_sentinel(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    writer = MetricsWriter(path)
+    health = StepHealth(writer, step_metrics=True)
+    m = {"loss": np.asarray([1.0, 2.0]), "grad_norm": np.asarray([3.0, 4.0])}
+    health.on_scan_epoch(0, m)
+    poisoned = {"loss": np.asarray([1.0, float("nan")])}
+    with pytest.raises(NonFiniteLossError):
+        health.on_scan_epoch(1, poisoned)
+    writer.close()
+
+    records = [json.loads(line) for line in open(path)]
+    steps = [r for r in records if r["kind"] == "step"]
+    # 2 clean + 2 poisoned-epoch records (the NaN step IS recorded), 1 anomaly.
+    assert len(steps) == 4 and records[-1]["kind"] == "anomaly"
+    assert steps[0]["step_ms"] is None  # scan mode: no per-step host timing
+    assert steps[1]["grad_norm"] == 4.0
+    assert validate_jsonl(path) == []
+
+
+# ---------------------------------------------------------------------------
+# heartbeat / straggler flagging
+# ---------------------------------------------------------------------------
+
+
+def test_flag_stragglers_policy():
+    assert flag_stragglers([100.0, 101.0, 99.0, 400.0], 1.5) == [3]
+    assert flag_stragglers([100.0, 100.0, 100.0, 100.0], 1.5) == []
+    assert flag_stragglers([100.0], 1.5) == []  # one host: no baseline
+    # Two slow hosts don't hide each other (median, not mean).
+    assert flag_stragglers([100.0, 104.0, 98.0, 101.0, 300.0, 280.0], 1.5) == [4, 5]
+
+
+def test_heartbeat_flags_faked_slow_host(tmp_path):
+    """A 4-host heartbeat with one faked 4x-slower process: the record
+    carries per-host rows, the straggler index, and the schema holds."""
+    path = str(tmp_path / "m.jsonl")
+    writer = MetricsWriter(path)
+    calls = []
+
+    def fake_gather(local):  # process 3 is wedged on a slow disk
+        calls.append(np.asarray(local))
+        return np.asarray([[100.0], [102.0], [98.0], [400.0]], np.float32)
+
+    hb = Heartbeat(
+        writer, every_steps=2, threshold=1.5, batch_images=128,
+        gather=fake_gather,
+    )
+    hb.on_step(0, 0, 0.1)
+    assert calls == []  # not at the beat boundary yet
+    hb.on_step(0, 1, 0.1)
+    assert len(calls) == 1
+    np.testing.assert_allclose(calls[0], [100.0])  # local mean, ms
+    writer.close()
+
+    (rec,) = [json.loads(line) for line in open(path)]
+    assert rec["kind"] == "heartbeat"
+    assert rec["step_ms"] == [100.0, 102.0, 98.0, 400.0]
+    assert rec["stragglers"] == [3]
+    assert rec["median_step_ms"] == 101.0
+    # Steps are collective: the slowest host sets the global pace.
+    assert rec["images_per_sec"] == pytest.approx(128 / 0.4, rel=1e-6)
+    assert validate_record(rec) == []
+
+
+def test_heartbeat_uniform_hosts_flag_nothing(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    writer = MetricsWriter(path)
+    hb = Heartbeat(
+        writer, every_steps=1, threshold=1.5,
+        gather=lambda v: np.asarray([[100.0], [101.0]], np.float32),
+    )
+    hb.on_step(0, 0, 0.1)
+    writer.close()
+    (rec,) = [json.loads(line) for line in open(path)]
+    assert rec["stragglers"] == []
+
+
+def test_host_allgather_single_process_identity():
+    from mpi_pytorch_tpu.parallel.collectives import host_allgather
+
+    out = host_allgather(np.asarray([1.5, 2.5], np.float32))
+    assert out.shape == (1, 2)
+    np.testing.assert_allclose(out[0], [1.5, 2.5])
+
+
+# ---------------------------------------------------------------------------
+# grad-norm metric in the train steps
+# ---------------------------------------------------------------------------
+
+
+def test_train_step_metrics_include_global_grad_norm():
+    """Every step flavor now reports the global gradient L2 norm — checked
+    here on the streaming auto step against an explicit value_and_grad."""
+    import flax.linen as nn
+    import optax
+
+    from mpi_pytorch_tpu.config import MeshConfig
+    from mpi_pytorch_tpu.ops.losses import classification_loss
+    from mpi_pytorch_tpu.parallel.mesh import create_mesh, shard_batch
+    from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
+    from mpi_pytorch_tpu.train.step import make_train_step, place_state_on_mesh
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            return nn.Dense(11)(nn.relu(nn.Dense(16)(x)))
+
+    model = MLP()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)))
+    state = TrainState.create(
+        apply_fn=model.apply, variables=variables, tx=make_optimizer(1e-3),
+        rng=jax.random.PRNGKey(1),
+    )
+    mesh = create_mesh(MeshConfig())
+    state = place_state_on_mesh(state, mesh)
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((16, 8, 8, 3)).astype(np.float32)
+    labels = rng.integers(0, 11, size=(16,)).astype(np.int32)
+
+    params_before = jax.device_get(state.params)  # the step donates `state`
+    step = make_train_step(jnp.float32)
+    _, m = step(state, shard_batch((images, labels), mesh))
+    got = float(m["grad_norm"])
+    assert math.isfinite(got) and got > 0
+
+    def loss_fn(params):
+        return classification_loss(
+            model.apply({"params": params}, jnp.asarray(images), train=False),
+            jnp.asarray(labels),
+        )
+
+    grads = jax.grad(loss_fn)(params_before)
+    np.testing.assert_allclose(got, float(optax.global_norm(grads)), rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: telemetry-enabled dryrun + the report tool
+# ---------------------------------------------------------------------------
+
+
+def _telemetry_cfg(tmpdir, **kw):
+    from mpi_pytorch_tpu.config import Config
+
+    cfg = Config()
+    cfg.debug = True
+    cfg.debug_sample_size = 48
+    cfg.train_csv = os.path.join(REPO, "data", "train_sample.csv")
+    cfg.test_csv = os.path.join(REPO, "data", "test_sample.csv")
+    cfg.synthetic_data = True
+    cfg.model_name = "resnet18"
+    cfg.num_classes = 200
+    cfg.batch_size = 16
+    cfg.width = cfg.height = 16
+    cfg.num_epochs = 2
+    cfg.compute_dtype = "float32"
+    cfg.checkpoint_dir = os.path.join(tmpdir, "ckpt")
+    cfg.log_file = os.path.join(tmpdir, "training.log")
+    cfg.metrics_file = os.path.join(tmpdir, "metrics.jsonl")
+    cfg.trace_file = os.path.join(tmpdir, "trace.json")
+    cfg.validate = False
+    cfg.loader_workers = 2
+    cfg.log_every_steps = 0
+    cfg.step_metrics = True
+    cfg.heartbeat_every_steps = 2
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    cfg.validate_config()
+    return cfg
+
+
+def test_dryrun_telemetry_end_to_end(tmp_path, capsys):
+    """THE acceptance path: a CPU dryrun with telemetry on produces a valid
+    Chrome-trace JSON plus per-step records (data-wait, grad-norm,
+    recompile count) that report_run.py accepts."""
+    from mpi_pytorch_tpu.train.trainer import train
+
+    cfg = _telemetry_cfg(str(tmp_path))
+    summary = train(cfg)
+    assert summary.epochs_run == 2
+
+    # Chrome trace: valid JSON, the documented span names, nested step spans.
+    trace = json.load(open(cfg.trace_file))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"build", "compile", "ingest", "step", "checkpoint"} <= names
+    assert all("ts" in e and "pid" in e for e in trace["traceEvents"])
+
+    # Metrics stream: schema-clean; step records carry the health fields.
+    assert validate_jsonl(cfg.metrics_file) == []
+    records = [json.loads(line) for line in open(cfg.metrics_file)]
+    kinds = {r["kind"] for r in records}
+    assert {"epoch", "step", "heartbeat"} <= kinds
+    steps = [r for r in records if r["kind"] == "step"]
+    # 48 sampled images -> 38-image train split -> 2 steps/epoch x 2 epochs.
+    assert len(steps) == 4
+    for rec in steps:
+        assert math.isfinite(rec["loss"]) and rec["grad_norm"] > 0
+        assert rec["data_wait_ms"] >= 0 and rec["step_ms"] > 0
+        assert rec["recompiles"] == 0  # AOT step: no silent recompiles
+    beats = [r for r in records if r["kind"] == "heartbeat"]
+    assert beats and all(b["stragglers"] == [] for b in beats)
+
+    # The report tool renders it (exit 0) with the phase breakdown.
+    assert report_run.main([cfg.metrics_file]) == 0
+    out = capsys.readouterr().out
+    assert "data-wait" in out and "grad norm" in out and "heartbeats" in out
+
+
+def test_poisoned_loss_aborts_cleanly(tmp_path):
+    """THE sentinel acceptance: a diverging run (lr=1e38 NaNs the loss
+    within two steps) aborts with NonFiniteLossError, writes the anomaly
+    diagnostic, and still flushes the trace on the failure path."""
+    from mpi_pytorch_tpu.train.trainer import train
+
+    cfg = _telemetry_cfg(str(tmp_path), learning_rate=1e38, num_epochs=3)
+    with pytest.raises(NonFiniteLossError):
+        train(cfg)
+
+    records = [json.loads(line) for line in open(cfg.metrics_file)]
+    anomalies = [r for r in records if r["kind"] == "anomaly"]
+    assert len(anomalies) == 1
+    assert anomalies[0]["reason"] == "nonfinite_loss"
+    assert not math.isfinite(anomalies[0]["loss"])
+    assert validate_jsonl(cfg.metrics_file) == []
+    # Failure path still writes the trace the diagnostics need.
+    assert {"build", "step"} <= {
+        e["name"] for e in json.load(open(cfg.trace_file))["traceEvents"]
+    }
+
+
+def test_report_run_renders_committed_artifact(capsys):
+    """Acceptance: the committed chip artifact renders into a summary."""
+    path = os.path.join(REPO, "docs", "chip_train_metrics.jsonl")
+    assert report_run.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "epochs:" in out and "throughput" in out
+    assert "MFU" in out
+
+
+def test_report_run_json_mode(capsys):
+    path = os.path.join(REPO, "docs", "decode_metrics.jsonl")
+    assert report_run.main([path, "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["kinds"] == {"epoch": 10, "eval": 1, "val": 10}
+    assert summary["val"]["best_accuracy"] == 1.0
+
+
+def test_report_run_rejects_malformed(tmp_path, capsys):
+    bad = tmp_path / "bad_metrics.jsonl"
+    bad.write_text(
+        '{"ts": 1.0, "kind": "epoch", "epoch": 0}\n'  # missing required fields
+        '{"ts": 1.0, "kind": "bogus"}\n'  # unknown kind
+        "not json\n"
+    )
+    assert report_run.main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "schema violation" in out and "bogus" in out
+
+
+def test_schema_rejects_wrong_types():
+    assert validate_record(
+        {"ts": 1.0, "kind": "epoch", "epoch": "zero", "loss": 1.0,
+         "time_s": 1.0, "images_per_sec": 1.0}
+    ) != []
+    assert validate_record({"kind": "val", "epoch": 0, "accuracy": 0.5,
+                            "loss": 1.0}) != []  # missing ts
+    assert validate_record(
+        {"ts": 1.0, "kind": "step", "epoch": 0, "step": 0, "loss": 1.0,
+         "grad_norm": None, "hbm_bytes": None}
+    ) == []  # optional fields may be null
+
+
+def test_heartbeat_window_resets_at_epoch_boundary(tmp_path):
+    """Leftover step samples (n_steps % every != 0) must not leak into the
+    next epoch's first beat — beats never average across epoch boundaries."""
+    locals_sent = []
+
+    def gather(v):
+        locals_sent.append(round(float(np.asarray(v)[0]), 3))
+        return np.asarray(v, np.float32)[None]
+
+    writer = MetricsWriter(str(tmp_path / "m.jsonl"))
+    hb = Heartbeat(writer, every_steps=2, gather=gather)
+    hb.start_epoch()
+    hb.on_step(0, 0, 1.0)
+    hb.on_step(0, 1, 1.0)      # beat: mean 1000 ms
+    hb.on_step(0, 2, 9.0)      # tail sample, no beat — must be dropped
+    hb.start_epoch()
+    hb.on_step(1, 0, 0.1)
+    hb.on_step(1, 1, 0.1)      # beat: mean 100 ms, NOT polluted by the 9 s tail
+    writer.close()
+    assert locals_sent == [1000.0, 100.0]

@@ -74,3 +74,33 @@ def test_prose_without_numbers_needs_nothing(tmp_path):
 ])
 def test_perf_claim_regex(line, claims):
     assert bool(lint.PERF_CLAIM.search(line)) == claims
+
+
+def test_committed_metrics_artifacts_pass_schema():
+    """Tier-1 gate for the obs record schema: every committed
+    docs/*_metrics.jsonl must parse record-by-record (a truncated write or
+    hand-edited record fails here, not at render time)."""
+    assert lint.check_metrics_artifacts() == []
+
+
+def test_malformed_metrics_artifact_is_flagged(tmp_path):
+    bad = tmp_path / "bad_metrics.jsonl"
+    bad.write_text(
+        '{"ts": 1.0, "kind": "epoch", "epoch": 0}\n'   # missing required fields
+        '{"ts": 1.0, "kind": "bogus"}\n'               # unknown kind
+        "not json\n"                                   # truncated/garbage line
+    )
+    violations = lint.check_metrics_artifacts(str(tmp_path))
+    assert len(violations) >= 3
+    assert any("bogus" in v for v in violations)
+    assert any("not JSON" in v for v in violations)
+
+
+def test_clean_metrics_artifact_passes(tmp_path):
+    good = tmp_path / "ok_metrics.jsonl"
+    good.write_text(
+        '{"ts": 1.0, "kind": "epoch", "epoch": 0, "loss": 2.5, '
+        '"time_s": 1.0, "images_per_sec": 10.0, "tflops": null, '
+        '"mfu_pct": null}\n'
+    )
+    assert lint.check_metrics_artifacts(str(tmp_path)) == []

@@ -1,4 +1,7 @@
-"""Lint docs/RESULTS.md: every numeric perf claim must cite a committed
+"""Lint docs/RESULTS.md (claims → artifacts) AND the committed metrics
+artifacts themselves (``docs/*_metrics.jsonl`` → the obs record schema).
+
+Claims lint: every numeric perf claim must cite a committed
 machine-readable artifact — or be explicitly marked staged/pending/rejected.
 
 Why (VERDICT r5 #9 / weak #1-2): the round-5 headline lived only in prose
@@ -22,18 +25,26 @@ Contract (deliberately section-granular — prose moves, headings don't):
   the number is not artifact-backed yet — the staleness-ledger idiom.
 - Anything else fails with the section heading and the offending lines.
 
+Metrics lint: every committed ``docs/*_metrics.jsonl`` must parse line-by-
+line against the shared record schema (``mpi_pytorch_tpu/obs/schema.py``) —
+a truncated write or a hand-edited record fails tier-1 instead of silently
+rendering wrong in ``tools/report_run.py``.
+
 Run: ``python tools/check_results_artifacts.py [--file docs/RESULTS.md]``
-Exit 0 = every claim maps; 1 = violations (printed).
+Exit 0 = every claim maps and every metrics artifact is schema-clean;
+1 = violations (printed).
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
 import os
 import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 # The units this repo states measurements in (docs/RESULTS.md §§1-5).
 PERF_CLAIM = re.compile(
@@ -97,18 +108,39 @@ def check(path: str) -> list[str]:
     return violations
 
 
+def check_metrics_artifacts(docs_dir: str | None = None) -> list[str]:
+    """Schema violations across every committed ``*_metrics.jsonl`` artifact
+    (the obs record schema is the contract ``report_run.py`` renders by)."""
+    docs_dir = docs_dir or os.path.join(REPO, "docs")
+    from mpi_pytorch_tpu.obs.schema import validate_jsonl
+
+    violations = []
+    for path in sorted(glob.glob(os.path.join(docs_dir, "*_metrics.jsonl"))):
+        rel = os.path.relpath(path, REPO)
+        violations.extend(f"{rel}: {p}" for p in validate_jsonl(path))
+    return violations
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--file", default=os.path.join(REPO, "docs", "RESULTS.md"))
     args = ap.parse_args()
-    violations = check(args.file)
-    if violations:
-        print(f"{len(violations)} violation(s) in {args.file}:")
-        for v in violations:
+    claim_violations = check(args.file)
+    metrics_violations = check_metrics_artifacts()
+    if claim_violations:
+        print(f"{len(claim_violations)} claim violation(s) in {args.file}:")
+        for v in claim_violations:
             print(" -", v)
+    if metrics_violations:
+        print(f"{len(metrics_violations)} metrics-artifact schema "
+              "violation(s) (paths below are the offending files):")
+        for v in metrics_violations:
+            print(" -", v)
+    if claim_violations or metrics_violations:
         return 1
     print(f"ok: every perf-claiming section of {args.file} cites a committed "
-          "artifact or carries an explicit staged/pending marker")
+          "artifact or carries an explicit staged/pending marker, and every "
+          "docs/*_metrics.jsonl record matches the obs schema")
     return 0
 
 

@@ -1,0 +1,306 @@
+"""Render a metrics JSONL into a run report — the obs layer's capstone.
+
+Works on ANY ``MetricsWriter`` stream: a live run's ``metrics.jsonl`` or the
+committed ``docs/*_metrics.jsonl`` artifacts. Sections appear only when the
+run recorded that kind:
+
+- run header (file, records, kinds, wall span);
+- epoch table + throughput/MFU trajectory (first→last, best epoch);
+- step-phase breakdown (data-wait vs device-step ms, wait fraction,
+  grad-norm trajectory, recompiles, non-finite losses);
+- heartbeat summary (beats, hosts, straggler flags per host);
+- validation/eval rows and anomaly records.
+
+Every record is validated against the shared schema
+(``mpi_pytorch_tpu/obs/schema.py``) first: malformed records are listed and
+the exit code is 1 — the same contract the artifacts linter enforces in CI
+(``tools/check_results_artifacts.py``), so a report you can render is a
+stream CI accepts.
+
+Run: ``python tools/report_run.py docs/chip_train_metrics.jsonl [--json]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mpi_pytorch_tpu.obs.schema import load_records, validate_jsonl  # noqa: E402
+
+
+def _fmt(value, nd=2) -> str:
+    """Numbers → fixed decimals; None → '-'; everything else → str."""
+    if value is None:
+        return "-"
+    if isinstance(value, float):
+        if math.isnan(value):
+            return "nan"
+        if math.isinf(value):
+            return "inf"
+        return f"{value:,.{nd}f}"
+    if isinstance(value, int):
+        return f"{value:,}"
+    return str(value)
+
+
+def table(headers: list[str], rows: list[list]) -> str:
+    """Plain-text aligned columns (right-aligned, numbers-first layout)."""
+    cells = [headers] + [[_fmt(c) for c in row] for row in rows]
+    widths = [max(len(r[i]) for r in cells) for i in range(len(headers))]
+    lines = []
+    for i, row in enumerate(cells):
+        lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def _finite(values):
+    return [v for v in values if v is not None and math.isfinite(v)]
+
+
+def _mean(values):
+    vals = _finite(values)
+    return sum(vals) / len(vals) if vals else None
+
+
+def _by_kind(records: list[dict]) -> dict[str, list[dict]]:
+    """THE record grouping — summarize() and render() must slice the stream
+    the same way, so both read it from here."""
+    by_kind: dict[str, list[dict]] = {}
+    for rec in records:
+        by_kind.setdefault(rec.get("kind", "?"), []).append(rec)
+    return by_kind
+
+
+def summarize(records: list[dict]) -> dict:
+    """The machine-readable summary (--json); render() prints it as text."""
+    by_kind = _by_kind(records)
+    summary: dict = {
+        "records": len(records),
+        "kinds": {k: len(v) for k, v in sorted(by_kind.items())},
+    }
+    stamps = _finite([r.get("ts") for r in records])
+    if stamps:
+        summary["wall_span_s"] = round(max(stamps) - min(stamps), 1)
+
+    epochs = by_kind.get("epoch", [])
+    if epochs:
+        ips = [e["images_per_sec"] for e in epochs]
+        best = max(epochs, key=lambda e: e["images_per_sec"])
+        summary["epochs"] = {
+            "count": len(epochs),
+            "first_images_per_sec": round(ips[0], 1),
+            "last_images_per_sec": round(ips[-1], 1),
+            "best_images_per_sec": round(best["images_per_sec"], 1),
+            "best_epoch": best["epoch"],
+            "final_loss": epochs[-1]["loss"],
+            "mean_mfu_pct": _mean([e.get("mfu_pct") for e in epochs]),
+        }
+
+    steps = by_kind.get("step", [])
+    if steps:
+        waits = _finite([s.get("data_wait_ms") for s in steps])
+        durs = _finite([s.get("step_ms") for s in steps])
+        norms = _finite([s.get("grad_norm") for s in steps])
+        stat = {
+            "count": len(steps),
+            "nonfinite_losses": sum(
+                1 for s in steps if not math.isfinite(s["loss"])
+            ),
+            "recompiles_max": max(
+                (s.get("recompiles") or 0 for s in steps), default=0
+            ),
+        }
+        if durs:
+            stat["step_ms"] = {
+                "mean": round(_mean(durs), 3),
+                "max": round(max(durs), 3),
+            }
+        if waits:
+            stat["data_wait_ms"] = {
+                "mean": round(_mean(waits), 3),
+                "max": round(max(waits), 3),
+            }
+            if durs:
+                total = sum(waits) + sum(durs)
+                # Host-visible time split: where a slow run's wall time
+                # actually went — the actionable number (arXiv:1810.11112).
+                stat["wait_fraction_pct"] = round(100.0 * sum(waits) / total, 1)
+        if norms:
+            stat["grad_norm"] = {
+                "first": round(norms[0], 4), "last": round(norms[-1], 4),
+                "max": round(max(norms), 4),
+            }
+        hbm = _finite([s.get("hbm_bytes") for s in steps])
+        if hbm:
+            stat["hbm_peak_mb"] = round(max(hbm) / 1e6, 1)
+        summary["steps"] = stat
+
+    beats = by_kind.get("heartbeat", [])
+    if beats:
+        hosts = max(len(b["step_ms"]) for b in beats)
+        flags: dict[int, int] = {}
+        for b in beats:
+            for pid in b["stragglers"]:
+                flags[pid] = flags.get(pid, 0) + 1
+        summary["heartbeats"] = {
+            "count": len(beats),
+            "hosts": hosts,
+            "beats_with_stragglers": sum(1 for b in beats if b["stragglers"]),
+            "straggler_flags_by_host": {str(k): v for k, v in sorted(flags.items())},
+        }
+
+    vals = by_kind.get("val", [])
+    if vals:
+        best = max(vals, key=lambda v: v["accuracy"])
+        summary["val"] = {
+            "count": len(vals),
+            "best_accuracy": round(best["accuracy"], 4),
+            "best_epoch": best["epoch"],
+            "final_accuracy": round(vals[-1]["accuracy"], 4),
+        }
+    evals = by_kind.get("eval", [])
+    if evals:
+        summary["eval"] = [
+            {"accuracy": round(e["accuracy"], 4), "images": e["images"],
+             "time_s": round(e["time_s"], 2)}
+            for e in evals
+        ]
+    anomalies = by_kind.get("anomaly", [])
+    if anomalies:
+        summary["anomalies"] = [
+            {k: a.get(k) for k in ("reason", "epoch", "step", "loss")}
+            for a in anomalies
+        ]
+    return summary
+
+
+def render(path: str, records: list[dict], summary: dict) -> str:
+    by_kind = _by_kind(records)
+    out = [
+        f"run report: {path}",
+        "  {} record(s): {}".format(
+            summary["records"],
+            ", ".join(f"{k}={n}" for k, n in summary["kinds"].items()),
+        ),
+    ]
+    if "wall_span_s" in summary:
+        out.append(f"  wall span: {summary['wall_span_s']} s")
+
+    epochs = by_kind.get("epoch", [])
+    if epochs:
+        out += ["", "epochs:", table(
+            ["epoch", "loss", "time_s", "img/s", "TFLOP/s", "MFU%"],
+            [[e["epoch"], e["loss"], e["time_s"], e["images_per_sec"],
+              e.get("tflops"), e.get("mfu_pct")] for e in epochs],
+        )]
+        es = summary["epochs"]
+        traj = (
+            f"throughput {es['first_images_per_sec']} → "
+            f"{es['last_images_per_sec']} img/s "
+            f"(best {es['best_images_per_sec']} @ epoch {es['best_epoch']})"
+        )
+        if es["mean_mfu_pct"] is not None:
+            traj += f", mean MFU {es['mean_mfu_pct']:.1f}%"
+        out.append("  " + traj)
+
+    if "steps" in summary:
+        ss = summary["steps"]
+        out += ["", f"steps: {ss['count']} record(s)"]
+        phase_rows = []
+        if "data_wait_ms" in ss:
+            phase_rows.append(["data-wait", ss["data_wait_ms"]["mean"],
+                               ss["data_wait_ms"]["max"]])
+        if "step_ms" in ss:
+            phase_rows.append(["device-step", ss["step_ms"]["mean"],
+                               ss["step_ms"]["max"]])
+        if phase_rows:
+            out.append(table(["phase", "mean_ms", "max_ms"], phase_rows))
+        if "wait_fraction_pct" in ss:
+            out.append(
+                f"  ingest wait = {ss['wait_fraction_pct']}% of host-visible "
+                "step time"
+            )
+        if "grad_norm" in ss:
+            gn = ss["grad_norm"]
+            out.append(
+                f"  grad norm {gn['first']} → {gn['last']} (max {gn['max']})"
+            )
+        if "hbm_peak_mb" in ss:
+            out.append(f"  peak HBM in use: {ss['hbm_peak_mb']} MB")
+        out.append(
+            f"  recompiles (max per record): {ss['recompiles_max']}; "
+            f"non-finite losses: {ss['nonfinite_losses']}"
+        )
+
+    if "heartbeats" in summary:
+        hb = summary["heartbeats"]
+        out += ["", (
+            f"heartbeats: {hb['count']} beat(s) over {hb['hosts']} host(s); "
+            f"{hb['beats_with_stragglers']} beat(s) flagged stragglers"
+        )]
+        if hb["straggler_flags_by_host"]:
+            out.append(table(
+                ["host", "times_flagged"],
+                [[k, v] for k, v in hb["straggler_flags_by_host"].items()],
+            ))
+
+    if "val" in summary:
+        vs = summary["val"]
+        out += ["", (
+            f"validation: best acc {vs['best_accuracy']} @ epoch "
+            f"{vs['best_epoch']}; final {vs['final_accuracy']} "
+            f"({vs['count']} epoch(s))"
+        )]
+    for e in summary.get("eval", []):
+        out.append(
+            f"eval: acc {e['accuracy']} over {e['images']} images "
+            f"in {e['time_s']} s"
+        )
+    for a in summary.get("anomalies", []):
+        out += ["", (
+            f"ANOMALY: {a['reason']} at epoch {a['epoch']}"
+            + ("" if a.get("step") is None else f" step {a['step']}")
+            + f" (loss {a.get('loss')})"
+        )]
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a MetricsWriter JSONL into a run report"
+    )
+    ap.add_argument("metrics", help="path to a metrics JSONL")
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the machine-readable summary instead of the text report",
+    )
+    args = ap.parse_args(argv)
+
+    problems = validate_jsonl(args.metrics)
+    if problems:
+        print(f"{len(problems)} schema violation(s) in {args.metrics}:")
+        for p in problems:
+            print(" -", p)
+        return 1
+    records = load_records(args.metrics)
+    if not records:
+        print(f"{args.metrics}: no records")
+        return 1
+    summary = summarize(records)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render(args.metrics, records, summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
