@@ -62,6 +62,14 @@ except ValueError:
 BACKEND_RETRIES = max(1, BACKEND_RETRIES)
 
 
+# Probe attempts actually made before a failure, recorded by
+# _probe_backend_with_retries so BOTH failure paths (probe exhaustion and
+# the main-init watchdog) report it as a structured field — BENCH_r05's
+# rc=3 row carried only prose, so flake frequency wasn't greppable across
+# BENCH_r* artifacts.
+_probe_attempts_made = 0
+
+
 def _fail_json(error: str) -> None:
     print(
         json.dumps(
@@ -71,6 +79,12 @@ def _fail_json(error: str) -> None:
                 "unit": "images/sec/chip",
                 "vs_baseline": 0.0,
                 "error": error,
+                # Structured retry context for the BENCH_r* failure rows:
+                # how many child probes ran (0 = CPU-pinned or the wedge hit
+                # the main init before any probe) out of how many budgeted.
+                "probe_attempts": _probe_attempts_made,
+                "backend_retries": BACKEND_RETRIES,
+                "backend_timeout_s": BACKEND_TIMEOUT_S,
             },
         ),
         flush=True,
@@ -92,6 +106,7 @@ def _probe_backend_with_retries(deadline: float) -> None:
     import subprocess
     import sys
 
+    global _probe_attempts_made
     platform = (os.environ.get("MPT_PLATFORM")
                 or os.environ.get("JAX_PLATFORMS") or "")
     if platform.split(",")[0].strip().lower() == "cpu":
@@ -104,6 +119,7 @@ def _probe_backend_with_retries(deadline: float) -> None:
         # process's own init under the watchdog.
         if remaining <= per_attempt:
             break
+        _probe_attempts_made = attempt + 1
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices()"],
@@ -170,6 +186,13 @@ def main() -> None:
 
     jax.devices()  # force backend init under the watchdog
     backend_up.set()
+
+    from mpi_pytorch_tpu.config import enable_compilation_cache
+
+    # MPT_COMPILE_CACHE_DIR: persistent compilation cache, so a repeat bench
+    # (same shapes, same options) skips its cold compile entirely — through
+    # the relay that compile IS most of a bench run's wall time.
+    enable_compilation_cache()
 
     from mpi_pytorch_tpu.config import Config
     from mpi_pytorch_tpu.models import create_model_bundle

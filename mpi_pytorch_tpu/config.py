@@ -249,6 +249,27 @@ class Config:
     # overlap the reference's 4-stage MPI pipeline existed to provide.
     prefetch_device_batches: int = 2
 
+    # --- online serving (mpi_pytorch_tpu/serve/) ---
+    # Batch buckets for the dynamic batcher: every coalesced request batch is
+    # padded up to one of these sizes, and ONE predict executable per bucket
+    # is AOT-compiled at server start — steady-state serving never compiles.
+    # More buckets = tighter padding waste but more warmup compiles; sizes
+    # divisible by the data-axis device count shard over the chips, smaller
+    # ones run replicated (docs/SERVING.md, tuning).
+    serve_buckets: str = "1,8,32,128,512"
+    # Deadline (ms) from the OLDEST queued request to a forced flush: the
+    # latency/throughput lever — 0 flushes every request immediately
+    # (lowest latency, worst fill), larger values coalesce fuller batches.
+    serve_max_wait_ms: float = 5.0
+    # Bounded request queue: submissions beyond this depth are REJECTED with
+    # a typed QueueFullError (backpressure — shed load instead of building
+    # an unbounded latency backlog).
+    serve_queue_depth: int = 1024
+    # Top-k class predictions returned per request (k<=5; the plain predict
+    # path computes lax.top_k online). --fused-head-eval streams argmax only,
+    # so the fused server serves k=1 (warned, not silent).
+    serve_topk: int = 5
+
     # --- validation semantics (main.py:104-112 validates on the TRAIN split) ---
     val_on_train: bool = True
 
@@ -309,6 +330,13 @@ class Config:
     # numerics — this flag turns every NaN-producing op into an immediate
     # error with a traceback (jax_debug_nans).
     debug_nans: bool = False
+    # JAX persistent compilation cache directory ("" = off, the jax
+    # default). When set, every AOT/jit compile in train, evaluate, bench,
+    # and serve startup is keyed into this directory, so a REPEAT run (or a
+    # server restart) skips its cold compiles entirely — the env override
+    # MPT_COMPILE_CACHE_DIR reaches the bench entrypoints that do not parse
+    # a Config. Safe to share across processes on one host.
+    compilation_cache_dir: str = ""
     # Extra TPU compiler options for the AOT-compiled step executables, as
     # "key=value key2=value2" (bool/int values coerced; leading "--"
     # tolerated). These are PER-COMPILE PJRT options, not XLA_FLAGS — under
@@ -418,6 +446,26 @@ class Config:
             )
         if self.warmup_steps < 0:
             raise ValueError(f"warmup_steps must be >= 0, got {self.warmup_steps}")
+        self.parsed_serve_buckets()  # raises on a malformed bucket list
+        if not 1 <= self.serve_topk <= 5:
+            raise ValueError(
+                f"serve_topk must be in 1..5, got {self.serve_topk} (the "
+                "serving contract is a handful of candidates, not a ranking "
+                "of all classes)"
+            )
+        if self.serve_topk > self.num_classes:
+            raise ValueError(
+                f"serve_topk={self.serve_topk} exceeds num_classes="
+                f"{self.num_classes}"
+            )
+        if self.serve_max_wait_ms < 0:
+            raise ValueError(
+                f"serve_max_wait_ms must be >= 0, got {self.serve_max_wait_ms}"
+            )
+        if self.serve_queue_depth < 1:
+            raise ValueError(
+                f"serve_queue_depth must be >= 1, got {self.serve_queue_depth}"
+            )
         if self.heartbeat_every_steps < 0:
             raise ValueError(
                 f"heartbeat_every_steps must be >= 0 (0 disables), "
@@ -586,6 +634,26 @@ class Config:
         or None when unset."""
         return parse_compiler_options(self.compiler_options)
 
+    def parsed_serve_buckets(self) -> tuple[int, ...]:
+        """``serve_buckets`` as a sorted deduped tuple of positive ints —
+        the bucket set the server AOT-compiles one executable per entry of.
+        Raises on an empty or non-positive list."""
+        try:
+            buckets = sorted(
+                {int(b) for b in self.serve_buckets.replace(";", ",").split(",") if b.strip()}
+            )
+        except ValueError:
+            raise ValueError(
+                f"serve_buckets must be comma-separated ints, got "
+                f"{self.serve_buckets!r}"
+            ) from None
+        if not buckets or buckets[0] < 1:
+            raise ValueError(
+                f"serve_buckets needs at least one positive size, got "
+                f"{self.serve_buckets!r}"
+            )
+        return tuple(buckets)
+
 
 def parse_compiler_options(text: str) -> dict[str, Any] | None:
     """"k=v k2=v2" (comma- or space-separated; leading "--" tolerated) →
@@ -613,12 +681,48 @@ def parse_compiler_options(text: str) -> dict[str, Any] | None:
 
 def apply_runtime_flags(cfg: Config) -> None:
     """Apply config knobs that live in the JAX runtime rather than in our own
-    code. Called by the train/eval drivers before any compilation."""
+    code. Called by the train/eval drivers (and the serve startup) before
+    any compilation."""
     import jax
 
     # Unconditional so a later run in the same process with the flag off
     # isn't stuck with the previous run's setting.
     jax.config.update("jax_debug_nans", cfg.debug_nans)
+    enable_compilation_cache(cfg.compilation_cache_dir)
+
+
+# Whether enable_compilation_cache has pointed jax at a cache dir in this
+# process — so a later run with the flag OFF can actually turn it off
+# (the same later-run-in-same-process rule as jax_debug_nans above).
+_compilation_cache_applied = False
+
+
+def enable_compilation_cache(cache_dir: str = "") -> None:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (or the
+    ``MPT_COMPILE_CACHE_DIR`` env var when the argument is empty). Both
+    empty = off: the jax default, restored explicitly if a previous run in
+    this process had the cache on.
+
+    The thresholds are zeroed deliberately: this repo's repeat-run pain is
+    many medium compiles (one per serve bucket, per eval shape, per bench
+    leg), each individually below jax's default 1 s / 64 KiB floor — with
+    the defaults a populated cache would still recompile everything."""
+    global _compilation_cache_applied
+    cache_dir = cache_dir or os.environ.get("MPT_COMPILE_CACHE_DIR", "")
+    if not cache_dir:
+        if _compilation_cache_applied:
+            import jax
+
+            # None disables the persistent cache regardless of thresholds.
+            jax.config.update("jax_compilation_cache_dir", None)
+            _compilation_cache_applied = False
+        return
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _compilation_cache_applied = True
 
 
 def _add_dataclass_args(parser: argparse.ArgumentParser, cls: type, prefix: str = "") -> None:

@@ -203,14 +203,32 @@ def evaluate(cfg: Config) -> EvalSummary:
     )
 
 
-def _make_predict_step(mesh, compute_dtype, fused_head: bool = False):
+def _make_predict_step(mesh, compute_dtype, fused_head: bool = False, topk: int = 1):
     # Canonicalize to positional args: lru_cache keys keyword and
     # positional calls separately, which would double-compile the step.
-    return _make_predict_step_impl(mesh, compute_dtype, bool(fused_head))
+    if fused_head and topk > 1:
+        raise ValueError(
+            "the fused head (head_predict) streams argmax only; top-k needs "
+            "the plain predict path (serve forces topk=1 under "
+            "--fused-head-eval, with a warning)"
+        )
+    return _make_predict_step_impl(mesh, compute_dtype, bool(fused_head), int(topk))
+
+
+def _row_sharding(mesh, batch: int):
+    """The argmax/top-k pin: ``P(data)`` when the batch divides the data
+    axis (the eval paths — required for ``_host_rows`` on multi-host),
+    replicated otherwise (the serve buckets smaller than the device count,
+    where a forced uneven shard would buy nothing)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    spec = P(axis) if batch % mesh.shape[axis] == 0 else P()
+    return NamedSharding(mesh, spec)
 
 
 @functools.lru_cache(maxsize=None)
-def _make_predict_step_impl(mesh, compute_dtype, fused_head: bool):
+def _make_predict_step_impl(mesh, compute_dtype, fused_head: bool, topk: int):
     """ONE batched forward yielding both the eval metrics and the per-image
     argmax — predictions and accuracy come from the same pass (the
     reference's predictor ranks compute the per-image argmax and discard it,
@@ -219,7 +237,15 @@ def _make_predict_step_impl(mesh, compute_dtype, fused_head: bool):
     The argmax is PINNED to ``P(data)``: on multi-host the global array
     spans non-addressable devices, and the caller reads back exactly its own
     host's rows from the addressable shards — a compiler-chosen layout
-    (e.g. replicated) would silently hand every host all rows.
+    (e.g. replicated) would silently hand every host all rows. (For batches
+    that don't divide the data axis — the small serve buckets — the pin
+    degrades to replicated, see ``_row_sharding``; the eval paths always
+    divide.)
+
+    ``topk`` (plain path only): > 1 returns [B, k] top-k class indices per
+    row instead of the [B] argmax — the serving contract (a request wants
+    candidates, not just the winner). Column 0 IS the argmax, which the
+    parity test pins against ``head_predict``.
 
     ``fused_head`` (``--fused-head-eval``, TPU): the [B, 64 500] logits
     tensor never reaches HBM — a flax method interceptor captures the
@@ -235,7 +261,6 @@ def _make_predict_step_impl(mesh, compute_dtype, fused_head: bool):
     quantities ``metrics_from_logits`` computes, so accuracy is identical
     up to the bf16-matmul argmax caveat in ``head_predict``'s docstring."""
     from flax import linen as flax_nn
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from mpi_pytorch_tpu.train.step import (
         eval_logits,
@@ -243,17 +268,21 @@ def _make_predict_step_impl(mesh, compute_dtype, fused_head: bool):
         metrics_from_logits,
     )
 
-    row_sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
-
     if not fused_head:
 
         @jax.jit
         def predict(state, batch):
             images, labels = batch
             logits = eval_logits(state, images, compute_dtype)
-            preds = jax.lax.with_sharding_constraint(
-                jnp.argmax(logits, axis=-1).astype(jnp.int32), row_sharding
-            )
+            row_sharding = _row_sharding(mesh, images.shape[0])
+            if topk > 1:
+                # lax.top_k's indices come back best-first, so [:, 0] is
+                # exactly the argmax the k=1 path returns.
+                _, idx = jax.lax.top_k(logits, topk)
+                preds = idx.astype(jnp.int32)
+            else:
+                preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            preds = jax.lax.with_sharding_constraint(preds, row_sharding)
             return metrics_from_logits(logits, labels), preds
 
         return predict
@@ -289,7 +318,8 @@ def _make_predict_step_impl(mesh, compute_dtype, fused_head: bool):
             # logits — take the plain path instead of failing.
             logits = jax.lax.optimization_barrier(out.astype(jnp.float32))
             preds = jax.lax.with_sharding_constraint(
-                jnp.argmax(logits, axis=-1).astype(jnp.int32), row_sharding
+                jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                _row_sharding(mesh, images.shape[0]),
             )
             return metrics_from_logits(logits, labels), preds
         # The interceptor's dummy return must BE the model output — if an
@@ -313,7 +343,9 @@ def _make_predict_step_impl(mesh, compute_dtype, fused_head: bool):
             "correct": jnp.sum((preds == labels) & valid),
             "count": jnp.sum(valid.astype(jnp.int32)),
         }
-        preds = jax.lax.with_sharding_constraint(preds, row_sharding)
+        preds = jax.lax.with_sharding_constraint(
+            preds, _row_sharding(mesh, images.shape[0])
+        )
         return metrics, preds
 
     return predict_fused

@@ -15,7 +15,9 @@ on (training on a NaN'd loss is never the right outcome).
 from mpi_pytorch_tpu.obs.health import (
     NonFiniteLossError,
     StepHealth,
+    compile_count,
     device_bytes_in_use,
+    ensure_compile_listener,
 )
 from mpi_pytorch_tpu.obs.heartbeat import Heartbeat, flag_stragglers
 from mpi_pytorch_tpu.obs.schema import validate_jsonl, validate_record
@@ -26,7 +28,9 @@ __all__ = [
     "NonFiniteLossError",
     "StepHealth",
     "Tracer",
+    "compile_count",
     "device_bytes_in_use",
+    "ensure_compile_listener",
     "flag_stragglers",
     "validate_jsonl",
     "validate_record",

@@ -31,7 +31,11 @@ _listener_installed = False
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 
-def _ensure_compile_listener() -> None:
+def ensure_compile_listener() -> None:
+    """Arm the process-wide backend-compile counter (idempotent). Callers
+    that assert zero steady-state compiles — serving after warmup, tests —
+    arm it first, record ``compile_count()`` as a baseline, and read the
+    delta later; the listener itself is installed at most once."""
     global _listener_installed
     if _listener_installed:
         return
@@ -44,6 +48,10 @@ def _ensure_compile_listener() -> None:
 
     jax.monitoring.register_event_duration_secs_listener(_on_event)
     _listener_installed = True
+
+
+# Backwards-compatible private alias (pre-serve callers).
+_ensure_compile_listener = ensure_compile_listener
 
 
 def compile_count() -> int:

@@ -19,6 +19,13 @@ Record kinds (every record also carries ``ts``, the epoch-seconds stamp
 | step      | epoch, step, loss                                   | grad_norm, data_wait_ms, step_ms, recompiles, hbm_bytes |
 | heartbeat | epoch, step, step_ms, median_step_ms, stragglers, threshold | images_per_sec |
 | anomaly   | reason, epoch                                       | step, loss, grad_norm |
+| serve     | bucket, requests, queue_depth, fill_ratio, queue_wait_ms, device_ms | preprocess_ms, total_ms |
+| serve_bench | mode, buckets, max_wait_ms, requests, p50_ms, p95_ms, p99_ms, images_per_sec | model, offered_rps, rejected, mean_fill_ratio, compiles_after_warmup, chips |
+
+``serve`` is the per-flush record the online inference server writes
+(serve/server.py: one coalesced batch dispatched to a bucket executable);
+``serve_bench`` is a latency/throughput summary row from the load driver
+(tools/bench_serve.py — the committed ``docs/serve_bench.json`` rows).
 
 Optional fields may be ``null`` (unknown on this backend — e.g. HBM bytes
 on CPU, per-step host timing in scan-epoch mode); required fields may not.
@@ -49,6 +56,15 @@ REQUIRED: dict[str, dict[str, tuple]] = {
         "median_step_ms": _NUM, "stragglers": (list,), "threshold": _NUM,
     },
     "anomaly": {"reason": (str,), "epoch": _INT},
+    "serve": {
+        "bucket": _INT, "requests": _INT, "queue_depth": _INT,
+        "fill_ratio": _NUM, "queue_wait_ms": _NUM, "device_ms": _NUM,
+    },
+    "serve_bench": {
+        "mode": (str,), "buckets": (str,), "max_wait_ms": _NUM,
+        "requests": _INT, "p50_ms": _NUM, "p95_ms": _NUM, "p99_ms": _NUM,
+        "images_per_sec": _NUM,
+    },
 }
 
 OPTIONAL: dict[str, dict[str, tuple]] = {
@@ -61,6 +77,11 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
     },
     "heartbeat": {"images_per_sec": _NUM},
     "anomaly": {"step": _INT, "loss": _NUM, "grad_norm": _NUM},
+    "serve": {"preprocess_ms": _NUM, "total_ms": _NUM},
+    "serve_bench": {
+        "model": (str,), "offered_rps": _NUM, "rejected": _INT,
+        "mean_fill_ratio": _NUM, "compiles_after_warmup": _INT, "chips": _INT,
+    },
 }
 
 

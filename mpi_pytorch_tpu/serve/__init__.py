@@ -1,0 +1,40 @@
+"""Online inference serving: shape-bucketed dynamic batching over
+AOT-compiled predict executables — the serving half of the north star.
+
+- ``batcher.py``: bounded request queue, bucket coalescing, deadline
+  flush, typed backpressure, graceful drain (host-only; unit-testable).
+- ``executables.py``: one ``jit(...).lower().compile()`` predict
+  executable per bucket, warmed before traffic; steady state performs
+  ZERO XLA compiles, asserted via the obs backend-compile counter.
+- ``server.py``: the request path — preprocess worker pool, batch loop,
+  double-buffered dispatch/fetch, ``kind="serve"`` telemetry, per-phase
+  tracer spans, per-host replicas on multi-process worlds.
+
+Load-drive it with ``tools/bench_serve.py``; tune it with
+``docs/SERVING.md``.
+"""
+
+from mpi_pytorch_tpu.serve.batcher import (
+    DynamicBatcher,
+    PendingRequest,
+    QueueFullError,
+    ServeError,
+    ServerClosedError,
+    parse_buckets,
+    pick_bucket,
+)
+from mpi_pytorch_tpu.serve.executables import BucketExecutables
+from mpi_pytorch_tpu.serve.server import InferenceServer, local_replica_mesh
+
+__all__ = [
+    "BucketExecutables",
+    "DynamicBatcher",
+    "InferenceServer",
+    "PendingRequest",
+    "QueueFullError",
+    "ServeError",
+    "ServerClosedError",
+    "local_replica_mesh",
+    "parse_buckets",
+    "pick_bucket",
+]

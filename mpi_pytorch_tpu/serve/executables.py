@@ -1,0 +1,153 @@
+"""The AOT predict-executable set: one compiled program per batch bucket.
+
+Serving latency dies two ways on an XLA backend: a fresh batch shape
+triggers a multi-second compile mid-request, or a batch-1 forward wastes
+the MXU. Both are closed off here AT STARTUP: every bucket in the
+configured set is ``jit(...).lower().compile()``d before traffic is
+accepted — the same AOT discipline the trainer applies to its step
+(``train/trainer.py``, ``_state_shardings``) — and ``warmup()`` executes
+each once so first-request latency is a device step, not a compile.
+
+Steady state is then ZERO compiles by construction, and *asserted* rather
+than assumed: the set arms ``obs.health``'s backend-compile listener,
+records a post-warmup baseline, and ``compiles_since_warmup()`` exposes
+the delta — the server's stats carry it, tests pin it at 0, and
+``tools/bench_serve.py`` refuses to report a row that compiled.
+
+Sharding: buckets divisible by the mesh's data axis shard their rows over
+the chips (the batched forward uses the whole replica's devices); smaller
+buckets run replicated (``_row_sharding`` in evaluate.py applies the same
+rule to the output pin). AOT executables do NOT auto-reshard inputs, so
+``place()`` is the one true device-placement path for serve batches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpi_pytorch_tpu.serve.batcher import parse_buckets
+
+
+class BucketExecutables:
+    """Per-bucket AOT-compiled predict executables over a placed state.
+
+    ``fused_head`` follows the evaluate driver's gate (TPU backend or the
+    ``MPT_HEAD_INTERPRET`` test path); the fused kernel streams argmax
+    only, so it forces ``topk=1`` with a logged warning — degraded k is
+    surfaced, never silent (the --fused-head-eval lesson, advisor r5).
+    """
+
+    def __init__(self, cfg, state, mesh, *, logger=None):
+        import jax
+        import jax.numpy as jnp
+
+        from mpi_pytorch_tpu.evaluate import _make_predict_step
+        from mpi_pytorch_tpu.obs import compile_count, ensure_compile_listener
+        from mpi_pytorch_tpu.utils.env import env_flag
+        from mpi_pytorch_tpu.utils.hardware import tpu_backend
+
+        self._mesh = mesh
+        self.buckets = parse_buckets(cfg.parsed_serve_buckets())
+        self.topk = int(cfg.serve_topk)
+        self.fused_head = bool(
+            cfg.fused_head_eval and (tpu_backend() or env_flag("MPT_HEAD_INTERPRET"))
+        )
+        if self.fused_head and self.topk > 1:
+            if logger is not None:
+                logger.warning(
+                    "--fused-head-eval streams argmax only: serving top-1 "
+                    "instead of the requested serve_topk=%d", self.topk,
+                )
+            self.topk = 1
+        compute_dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+            cfg.compute_dtype
+        ]
+        predict = _make_predict_step(
+            mesh, compute_dtype, fused_head=self.fused_head, topk=self.topk
+        )
+
+        # The host batch dtype mirrors the loader contract (data/pipeline):
+        # f32/bf16 batches arrive normalized; uint8 ships raw pixels and
+        # the step normalizes on device (train/step.ingest_images).
+        if cfg.input_dtype == "bfloat16":
+            import ml_dtypes
+
+            self.image_dtype = np.dtype(ml_dtypes.bfloat16)
+        else:
+            self.image_dtype = np.dtype(cfg.input_dtype)
+
+        self._state = state
+        self._compiled = {}
+        self._shardings = {}
+        self._image_hw = h, w = cfg.image_size
+        options = cfg.parsed_compiler_options()
+        for bucket in self.buckets:
+            img_sh, lbl_sh = self._shardings.setdefault(
+                bucket, self._batch_shardings(bucket)
+            )
+            img_aval = jax.ShapeDtypeStruct(
+                (bucket, h, w, 3), self.image_dtype, sharding=img_sh
+            )
+            lbl_aval = jax.ShapeDtypeStruct((bucket,), np.int32, sharding=lbl_sh)
+            self._compiled[bucket] = (
+                jax.jit(predict)
+                .lower(state, (img_aval, lbl_aval))
+                .compile(compiler_options=options)
+            )
+        ensure_compile_listener()
+        self._compile_count = compile_count
+        self._baseline = compile_count()
+        self._warm = False
+
+    def _batch_shardings(self, bucket: int):
+        """(images, labels) shardings for one bucket — ONE divisibility
+        rule with the predict step's output pin (``evaluate._row_sharding``):
+        inputs and outputs must never diverge on when a batch shards."""
+        from mpi_pytorch_tpu.evaluate import _row_sharding
+
+        sh = _row_sharding(self._mesh, bucket)
+        return sh, sh
+
+    def place(self, images: np.ndarray, labels: np.ndarray):
+        """Host batch → device, with the exact shardings the bucket's AOT
+        executable was specialized on (AOT never auto-reshards; populated
+        at compile time, so the hot path is a dict hit).
+        ``device_put`` is async — the H2D copy overlaps whatever the device
+        is computing, the double-buffering half of the serve pipeline."""
+        import jax
+
+        img_sh, lbl_sh = self._shardings[images.shape[0]]
+        return (
+            jax.device_put(images.astype(self.image_dtype, copy=False), img_sh),
+            jax.device_put(labels.astype(np.int32, copy=False), lbl_sh),
+        )
+
+    def __call__(self, bucket: int, device_batch):
+        """Dispatch the bucket's executable (async) → device preds array.
+        Metrics are computed on all-(-1) labels and discarded — the predict
+        step is shared with the eval driver, predictions are what serving
+        reads back."""
+        _, preds = self._compiled[bucket](self._state, device_batch)
+        return preds
+
+    def warmup(self) -> None:
+        """Execute every bucket once on filler data and re-baseline the
+        compile counter: anything after this is a steady-state compile —
+        the defect this class exists to make impossible (and visible)."""
+        import jax
+
+        h, w = self._image_hw
+        for bucket in self.buckets:
+            images = np.zeros((bucket, h, w, 3), self.image_dtype)
+            labels = np.full((bucket,), -1, np.int32)
+            preds = self(bucket, self.place(images, labels))
+            jax.block_until_ready(preds)
+        self._baseline = self._compile_count()
+        self._warm = True
+
+    @property
+    def warm(self) -> bool:
+        return self._warm
+
+    def compiles_since_warmup(self) -> int:
+        return self._compile_count() - self._baseline

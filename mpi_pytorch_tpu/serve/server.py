@@ -1,0 +1,425 @@
+"""The online inference server — the reference's 4-stage MPI pipeline as a
+latency-engineered subsystem on one replica's chips.
+
+The reference streams single images rank→rank (read → resize → normalize →
+predict, ``evaluation_pipeline.py:53-199``); each predictor runs a batch-1
+forward. Here the same four stages exist, overlapped by threads instead of
+MPI ranks, and the predict stage runs AOT-compiled bucket-shaped batches:
+
+| reference stage (rank)      | here                                        |
+|-----------------------------|---------------------------------------------|
+| read_images (rank 0)        | ``submit()`` — the request path             |
+| resize (rank 1) +           | preprocess worker pool (decode → resize →   |
+| normalize (rank 2)          | normalize; ``data/pipeline.py`` math)       |
+| random rank routing (:178)  | dynamic batcher → shape bucket              |
+| predict (ranks ≥3, batch 1) | one AOT executable per bucket, all chips    |
+
+Pipeline overlap (the whole point of the reference's dedicated ranks) is
+had with two threads and an async backend: the BATCH loop coalesces,
+preprocesses, and *dispatches* batch n+1 while the COMPLETION loop blocks
+on batch n's device result — ``device_put``/execute are asynchronous, so
+preprocessing and H2D of the next batch hide under device compute of the
+current one, and only tiny int32 top-k rows come back.
+
+Every flush writes a ``kind="serve"`` metrics record (queue depth, batch
+fill ratio, per-phase latency — rendered by ``tools/report_run.py``) and
+tracer spans per request phase (``serve/preprocess`` / ``serve/dispatch`` /
+``serve/fetch``).
+
+Multi-host: a server replica is a single process driving its own
+addressable devices (≙ the reference's independent predictor ranks). In a
+``jax.distributed`` world, build one server per host over
+``local_replica_mesh()`` — a global mesh would make every flush a
+collective that all hosts must agree on, which is a training-shaped
+contract, not a serving one.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+from mpi_pytorch_tpu.serve.batcher import (
+    DynamicBatcher,
+    PendingRequest,
+    QueueFullError,
+    ServeError,
+    ServerClosedError,
+    pick_bucket,
+)
+from mpi_pytorch_tpu.serve.executables import BucketExecutables
+
+
+def local_replica_mesh():
+    """A ('data', 'model') mesh over THIS process's addressable devices —
+    the per-host server-replica layout for multi-process worlds."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.local_devices()).reshape(-1, 1), ("data", "model"))
+
+
+@dataclass
+class _InFlight:
+    requests: list  # PendingRequest, real rows only (filler stays on device)
+    preds: Any  # device array, [bucket] or [bucket, k]
+    bucket: int
+    queue_wait_ms: float
+    preprocess_ms: float
+    t_dispatch: float
+    t_oldest: float
+
+
+class InferenceServer:
+    """Shape-bucketed dynamic-batching predict server over one replica.
+
+    ``submit(image) -> Future[np.int32 [topk]]`` is the request path;
+    ``image`` is a filesystem path (decoded + resized + normalized on the
+    worker pool), an ``(H, W, 3)`` uint8 array of raw pixels, or an
+    ``(H, W, 3)`` float array that is ALREADY normalized. ``predict_batch``
+    is the synchronous convenience wrapper. ``close()`` drains gracefully.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        *,
+        state=None,
+        mesh=None,
+        load_checkpoint: bool = True,
+        metrics=None,
+    ):
+        import jax
+
+        from mpi_pytorch_tpu.config import apply_runtime_flags
+        from mpi_pytorch_tpu.obs import Tracer
+        from mpi_pytorch_tpu.utils.logging import MetricsWriter, run_logger
+
+        apply_runtime_flags(cfg)
+        self.cfg = cfg
+        self._logger = run_logger()
+        if mesh is None:
+            if jax.process_count() > 1:
+                raise ServeError(
+                    "multi-process serving runs one replica per host: pass "
+                    "mesh=serve.local_replica_mesh() (a global mesh would "
+                    "turn every flush into a pod-wide collective)"
+                )
+            from mpi_pytorch_tpu.parallel.mesh import create_mesh
+
+            mesh = create_mesh(cfg.mesh)
+        if any(
+            d.process_index != jax.process_index() for d in mesh.devices.flat
+        ):
+            raise ServeError(
+                "the serve mesh must be fully addressable by this process "
+                "(use serve.local_replica_mesh() on multi-host)"
+            )
+        self.mesh = mesh
+
+        if state is None:
+            state = self._build_state(cfg, mesh, load_checkpoint)
+        from mpi_pytorch_tpu.train.step import place_state_on_mesh
+
+        state = place_state_on_mesh(state, mesh)
+
+        # metrics=None → the cfg's stream (kind="serve" records); pass an
+        # explicit MetricsWriter to share a stream, or one over "" to mute.
+        self._metrics = metrics or MetricsWriter(cfg.metrics_file)
+        self._owns_metrics = metrics is None
+        self._tracer = Tracer(cfg.trace_file)
+
+        self._exe = BucketExecutables(cfg, state, mesh, logger=self._logger)
+        self.buckets = self._exe.buckets
+        self.topk = self._exe.topk
+        self._exe.warmup()  # zero steady-state compiles from here on
+
+        self._batcher = DynamicBatcher(
+            self.buckets, cfg.serve_max_wait_ms / 1e3, cfg.serve_queue_depth
+        )
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, cfg.loader_workers),
+            thread_name_prefix="serve-prep",
+        )
+        # Depth-2 in-flight queue = double buffering: the batch loop may run
+        # one batch ahead of the completion loop, no further (bounding device
+        # queue growth under burst load).
+        self._inflight: queue.Queue = queue.Queue(maxsize=2)
+        self._abandon = False
+        self._lock = threading.Lock()
+        self._stats = {
+            "served": 0, "failed": 0, "rejected": 0, "batches": 0,
+            "padded_rows": 0,
+            "by_bucket": {b: 0 for b in self.buckets},
+        }
+        self._batch_thread = threading.Thread(
+            target=self._batch_loop, name="serve-batch", daemon=True
+        )
+        self._completion_thread = threading.Thread(
+            target=self._completion_loop, name="serve-fetch", daemon=True
+        )
+        self._batch_thread.start()
+        self._completion_thread.start()
+        self._logger.info(
+            "serve: %d bucket executable(s) %s warm (topk=%d, fused_head=%s, "
+            "max_wait=%.1f ms, queue=%d) — steady state compiles: 0 by "
+            "construction",
+            len(self.buckets), list(self.buckets), self.topk,
+            self._exe.fused_head, cfg.serve_max_wait_ms, cfg.serve_queue_depth,
+        )
+
+    # ------------------------------------------------------------------ build
+
+    @staticmethod
+    def _build_state(cfg, mesh, load_checkpoint: bool):
+        """Model + params (+ checkpoint) — the predictor-rank setup, via the
+        eval driver's ``build_inference`` so serve and evaluate can never
+        disagree about how a model is constructed."""
+        from mpi_pytorch_tpu import checkpoint as ckpt
+        from mpi_pytorch_tpu.evaluate import build_inference
+        from mpi_pytorch_tpu.utils.logging import run_logger
+
+        # manifests=(None, None): serving has no dataset — requests ARE the
+        # data; build_inference only threads manifests through to its caller.
+        _, _, state, _ = build_inference(cfg, mesh=mesh, manifests=(None, None))
+        if not load_checkpoint:
+            return state
+        latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
+        if cfg.use_best:
+            marker = ckpt.best_marker(cfg.checkpoint_dir)
+            if marker is None:
+                raise FileNotFoundError(
+                    f"use_best=True but no best.json in {cfg.checkpoint_dir}"
+                )
+            latest = os.path.join(cfg.checkpoint_dir, marker["checkpoint"])
+        if latest:
+            state, epoch, _ = ckpt.load_for_eval(latest, state)
+            run_logger().info("serve: loaded checkpoint %s (epoch %d)", latest, epoch)
+        else:
+            run_logger().info(
+                "serve: no checkpoint in %s — serving fresh init",
+                cfg.checkpoint_dir,
+            )
+        return state
+
+    # ------------------------------------------------------------ request path
+
+    def submit(self, image) -> Future:
+        """Enqueue one request; the future resolves to the top-k class
+        indices (np.int32, shape [topk]). Raises ``QueueFullError`` under
+        backpressure and ``ServerClosedError`` after ``close()``."""
+        if self._batcher.closed:
+            raise ServerClosedError("server is shut down")
+        fut: Future = Future()
+        try:
+            payload = self._pool.submit(self._preprocess, image)
+        except RuntimeError:  # pool already shut down (close raced us)
+            raise ServerClosedError("server is shut down") from None
+        try:
+            self._batcher.submit(PendingRequest(payload=payload, future=fut))
+        except QueueFullError:
+            with self._lock:
+                self._stats["rejected"] += 1
+            payload.cancel()
+            raise
+        return fut
+
+    def predict_batch(self, images, timeout: float | None = None) -> np.ndarray:
+        """Synchronous convenience: submit all, wait, stack → [n, topk]."""
+        futs = [self.submit(im) for im in images]
+        return np.stack([f.result(timeout=timeout) for f in futs])
+
+    def _preprocess(self, image) -> np.ndarray:
+        """Request payload → one model-ready (H, W, 3) row, per the loader
+        contract (``data/pipeline.py``): f32/bf16 rows are normalized on
+        the host, uint8 rows ship raw pixels (device normalize)."""
+        from mpi_pytorch_tpu.data.pipeline import decode_image, normalize_image
+
+        size = self.cfg.image_size
+        raw = self._exe.image_dtype == np.uint8
+        if isinstance(image, (str, os.PathLike)):
+            if raw:
+                from mpi_pytorch_tpu.data.packed import _decode_uint8
+
+                return _decode_uint8(os.fspath(image), size)
+            if self.cfg.native_decode:
+                # The C++ batched ingest, one-row batch: still wins (GIL
+                # released, libjpeg prescale) and auto-falls back to PIL
+                # when the toolchain is absent — the loader's own policy.
+                from mpi_pytorch_tpu import native
+                from mpi_pytorch_tpu.data.pipeline import _MEAN, _STD
+
+                if native.available():
+                    return native.decode_batch(
+                        [os.fspath(image)], size, _MEAN, _STD,
+                        threads=1,
+                        prescale_margin=self.cfg.decode_prescale,
+                        fallback=lambda p: normalize_image(decode_image(p, size)),
+                    )[0]
+            return normalize_image(decode_image(os.fspath(image), size))
+        img = np.asarray(image)
+        if img.shape != (*size, 3):
+            raise ServeError(
+                f"request image shape {img.shape} != expected {(*size, 3)} "
+                "(pass a path to have the server decode+resize)"
+            )
+        if img.dtype == np.uint8:
+            if raw:
+                return img
+            return normalize_image(img.astype(np.float32) / 255.0)
+        if raw:
+            raise ServeError(
+                "input_dtype='uint8' serving takes raw uint8 pixels or a "
+                f"path, got dtype {img.dtype}"
+            )
+        return img  # float input: contract says already normalized
+
+    # ------------------------------------------------------------- batch loop
+
+    def _batch_loop(self) -> None:
+        from mpi_pytorch_tpu.train.trainer import pad_batch
+
+        while True:
+            flush = self._batcher.next_flush()
+            if flush is None:
+                self._inflight.put(None)  # drain the completion loop too
+                return
+            t_flush = time.monotonic()
+            if self._abandon:
+                self._fail(flush, ServerClosedError("server closed without drain"))
+                continue
+            try:
+                # Resolve the pool's preprocess futures (usually already
+                # done — they started at submit time). A bad request fails
+                # its own future only; the batch goes on without it.
+                rows, good = [], []
+                with self._tracer.span("serve/preprocess", args={"n": len(flush)}):
+                    for req in flush:
+                        try:
+                            rows.append(req.payload.result())
+                            good.append(req)
+                        except BaseException as e:  # noqa: BLE001
+                            self._fail([req], e)
+                if not good:
+                    continue
+                t_prep = time.monotonic()
+                bucket = pick_bucket(len(good), self.buckets)
+                labels = np.full((len(good),), -1, np.int32)
+                images, labels = pad_batch(np.stack(rows), labels, bucket)
+                with self._tracer.span(
+                    "serve/dispatch", args={"bucket": bucket, "requests": len(good)}
+                ):
+                    preds = self._exe(bucket, self._exe.place(images, labels))
+                self._inflight.put(
+                    _InFlight(
+                        requests=good,
+                        preds=preds,
+                        bucket=bucket,
+                        queue_wait_ms=1e3 * (
+                            t_flush - min(r.t_submit for r in good)
+                        ),
+                        preprocess_ms=1e3 * (t_prep - t_flush),
+                        t_dispatch=time.monotonic(),
+                        t_oldest=min(r.t_submit for r in good),
+                    )
+                )
+            except BaseException as e:  # noqa: BLE001 — keep serving
+                self._logger.error("serve batch loop error: %s", e)
+                self._fail(flush, e)
+
+    def _completion_loop(self) -> None:
+        import jax
+
+        while True:
+            item = self._inflight.get()
+            if item is None:
+                return
+            try:
+                with self._tracer.span(
+                    "serve/fetch", args={"bucket": item.bucket}
+                ):
+                    # The ONLY device readback on the serve path: tiny int32
+                    # top-k rows. Blocks until the dispatched forward is
+                    # done — meanwhile the batch loop is already
+                    # preprocessing/dispatching the next flush.
+                    rows = np.asarray(jax.device_get(item.preds))
+                t_done = time.monotonic()
+                rows = rows.reshape(rows.shape[0], -1)  # [bucket] -> [bucket, 1]
+                for i, req in enumerate(item.requests):
+                    req.future.set_result(rows[i].astype(np.int32, copy=False))
+                n = len(item.requests)
+                with self._lock:
+                    self._stats["served"] += n
+                    self._stats["batches"] += 1
+                    self._stats["by_bucket"][item.bucket] += 1
+                    self._stats["padded_rows"] += item.bucket - n
+                self._metrics.write(
+                    {
+                        "kind": "serve",
+                        "bucket": item.bucket,
+                        "requests": n,
+                        "queue_depth": self._batcher.qsize(),
+                        "fill_ratio": round(n / item.bucket, 4),
+                        "queue_wait_ms": round(item.queue_wait_ms, 3),
+                        "preprocess_ms": round(item.preprocess_ms, 3),
+                        "device_ms": round(1e3 * (t_done - item.t_dispatch), 3),
+                        "total_ms": round(1e3 * (t_done - item.t_oldest), 3),
+                    }
+                )
+            except BaseException as e:  # noqa: BLE001 — keep serving
+                self._logger.error("serve completion loop error: %s", e)
+                self._fail(item.requests, e)
+
+    def _fail(self, requests, exc) -> None:
+        with self._lock:
+            self._stats["failed"] += len(requests)
+        for req in requests:
+            if not req.future.done():
+                req.future.set_exception(exc)
+
+    # --------------------------------------------------------------- lifecycle
+
+    def set_max_wait_ms(self, max_wait_ms: float) -> None:
+        """Retune the flush deadline live (the batch loop reads it per
+        flush) — lets ``tools/bench_serve.py`` sweep the latency lever
+        without rebuilding (and recompiling) the server."""
+        self._batcher.max_wait_s = float(max_wait_ms) / 1e3
+
+    def stats(self) -> dict:
+        """Counters + the steady-state compile assertion surface."""
+        with self._lock:
+            out = dict(self._stats, by_bucket=dict(self._stats["by_bucket"]))
+        out["queue_depth"] = self._batcher.qsize()
+        out["compiles_after_warmup"] = self._exe.compiles_since_warmup()
+        out["topk"] = self.topk
+        out["buckets"] = list(self.buckets)
+        return out
+
+    def close(self, drain: bool = True) -> None:
+        """Stop admissions and shut down. ``drain=True`` (default) flushes
+        every queued request before returning — graceful drain; ``False``
+        fails queued requests with ``ServerClosedError``."""
+        if not drain:
+            self._abandon = True
+        self._batcher.close()
+        self._batch_thread.join()
+        self._completion_thread.join()
+        self._pool.shutdown(wait=True)
+        if self._owns_metrics:
+            self._metrics.close()
+        trace_out = self._tracer.close()
+        if trace_out:
+            self._logger.info("serve trace spans written to %s", trace_out)
+
+    def __enter__(self) -> "InferenceServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

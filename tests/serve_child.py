@@ -1,0 +1,70 @@
+"""Child process for the replicated-serving smoke test.
+
+Runs the SAME serving workload in two world shapes:
+
+- a 2-process ``jax.distributed`` world (parent sets the coordinator env,
+  ``MPT_MULTIHOST=1``): each process builds a server REPLICA over its own
+  addressable devices via ``serve.local_replica_mesh()`` — the per-host
+  replica layout ``docs/SERVING.md`` prescribes for pods (≙ the
+  reference's independent predictor ranks);
+- a plain single process (no coordinator env): the baseline server.
+
+Every run submits an identical seeded request stream and prints
+``SERVE_OK <flattened top-k indices>``; the parent asserts all three
+lines agree — replicated-server predictions match single-process, and
+steady state compiled nothing after warmup in either world.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # before first device use
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, ".")
+
+from mpi_pytorch_tpu.parallel.distributed import maybe_initialize_distributed  # noqa: E402
+
+
+def main() -> None:
+    distributed = maybe_initialize_distributed()
+    if distributed:
+        assert jax.process_count() == 2, jax.process_count()
+
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.serve import InferenceServer, local_replica_mesh
+
+    cfg = Config(
+        model_name="resnet18", num_classes=32, width=32, height=32,
+        synthetic_data=True, compute_dtype="float32",
+        serve_buckets="1,4", serve_max_wait_ms=2.0, serve_topk=3,
+        metrics_file="", log_file="", eval_log_file="",
+    )
+    cfg.validate_config()
+    mesh = local_replica_mesh()
+    # Both world shapes run a 4-device replica (the parent pins
+    # --xla_force_host_platform_device_count=4), so the compiled programs
+    # are identical and the prediction comparison is exact.
+    assert mesh.devices.size == 4, mesh.devices.size
+
+    server = InferenceServer(cfg, mesh=mesh, load_checkpoint=False)
+    try:
+        rng = np.random.default_rng(7)  # SAME stream on every replica
+        images = [
+            rng.integers(0, 256, size=(32, 32, 3)).astype(np.uint8)
+            for _ in range(10)
+        ]
+        preds = server.predict_batch(images, timeout=300)
+        stats = server.stats()
+        assert stats["compiles_after_warmup"] == 0, stats
+        assert stats["served"] == len(images), stats
+    finally:
+        server.close()
+    flat = " ".join(str(v) for v in preds.astype(int).flatten().tolist())
+    print(f"SERVE_OK {flat}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

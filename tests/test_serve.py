@@ -1,0 +1,517 @@
+"""Tests for the online inference subsystem (mpi_pytorch_tpu/serve/).
+
+Covers the full acceptance surface: batcher semantics (buckets, deadline,
+backpressure, drain), the end-to-end server with ZERO steady-state
+compiles across a multi-bucket request mix (asserted via the obs
+backend-compile counter), top-k parity between the plain predict path and
+the fused ``head_predict`` argmax, the ``kind="serve"`` record schema, the
+``tools/bench_serve.py --smoke`` CPU bench, the persistent compilation
+cache satellite, and (slow) 2-process replicated serving.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cpu_env(**extra):
+    """Subprocess env pinned to a clean CPU world (the image's
+    sitecustomize would otherwise register the TPU plugin)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra)
+    return env
+
+
+# ---------------------------------------------------------------- batcher
+
+
+def test_parse_buckets_and_pick_bucket():
+    from mpi_pytorch_tpu.serve import parse_buckets, pick_bucket
+
+    assert parse_buckets([32, 1, 8, 8]) == (1, 8, 32)
+    with pytest.raises(ValueError):
+        parse_buckets([])
+    with pytest.raises(ValueError):
+        parse_buckets([0, 4])
+    buckets = (1, 8, 32)
+    assert pick_bucket(1, buckets) == 1
+    assert pick_bucket(2, buckets) == 8
+    assert pick_bucket(8, buckets) == 8
+    assert pick_bucket(9, buckets) == 32
+    assert pick_bucket(1000, buckets) == 32  # flushes cap at the largest
+
+
+def test_config_serve_knobs_validate():
+    from mpi_pytorch_tpu.config import Config
+
+    cfg = Config(serve_buckets="8,1,32")
+    assert cfg.parsed_serve_buckets() == (1, 8, 32)
+    with pytest.raises(ValueError):
+        Config(serve_buckets="").validate_config()
+    with pytest.raises(ValueError):
+        Config(serve_buckets="1,frog").validate_config()
+    with pytest.raises(ValueError):
+        Config(serve_topk=0).validate_config()
+    with pytest.raises(ValueError):
+        Config(serve_topk=6).validate_config()
+    with pytest.raises(ValueError):
+        Config(serve_max_wait_ms=-1).validate_config()
+    with pytest.raises(ValueError):
+        Config(serve_queue_depth=0).validate_config()
+    with pytest.raises(ValueError):
+        Config(serve_topk=5, num_classes=3).validate_config()
+
+
+def test_batcher_deadline_flush_and_drain():
+    from mpi_pytorch_tpu.serve import DynamicBatcher, PendingRequest
+
+    b = DynamicBatcher(buckets=(8,), max_wait_s=0.05, max_queue=16)
+    t0 = time.monotonic()
+    for i in range(3):
+        b.submit(PendingRequest(payload=i, future=None))
+    flush = b.next_flush()
+    waited = time.monotonic() - t0
+    assert [r.payload for r in flush] == [0, 1, 2]
+    # Flushed by the deadline (3 < bucket 8), not instantly and not never.
+    assert 0.03 <= waited < 2.0, waited
+
+    # A full bucket flushes immediately, without sitting out the deadline.
+    b2 = DynamicBatcher(buckets=(1, 4), max_wait_s=10.0, max_queue=16)
+    for i in range(4):
+        b2.submit(PendingRequest(payload=i, future=None))
+    t0 = time.monotonic()
+    assert len(b2.next_flush()) == 4
+    assert time.monotonic() - t0 < 1.0
+
+    # close() drains: queued requests still flush, then None forever.
+    b2.submit(PendingRequest(payload=9, future=None))
+    b2.close()
+    assert [r.payload for r in b2.next_flush()] == [9]
+    assert b2.next_flush() is None
+
+
+def test_batcher_backlog_coalesces_full_buckets():
+    """Regression (caught by a live flood drive): requests that sat in the
+    queue past their deadline must still coalesce into the LARGEST bucket —
+    the pre-fix behavior flushed one overdue request per batch, i.e. the
+    batch-1 regime bucketing exists to avoid."""
+    from mpi_pytorch_tpu.serve import DynamicBatcher, PendingRequest
+
+    b = DynamicBatcher(buckets=(1, 8), max_wait_s=0.0, max_queue=64)
+    for i in range(20):
+        b.submit(PendingRequest(payload=i, future=None))
+    time.sleep(0.01)  # everything queued is long past the 0 ms deadline
+    sizes = [len(b.next_flush()) for _ in range(3)]
+    assert sizes == [8, 8, 4], sizes
+
+
+def test_batcher_backpressure_and_closed():
+    from mpi_pytorch_tpu.serve import (
+        DynamicBatcher,
+        PendingRequest,
+        QueueFullError,
+        ServerClosedError,
+    )
+
+    b = DynamicBatcher(buckets=(4,), max_wait_s=1.0, max_queue=2)
+    b.submit(PendingRequest(payload=0, future=None))
+    b.submit(PendingRequest(payload=1, future=None))
+    with pytest.raises(QueueFullError):
+        b.submit(PendingRequest(payload=2, future=None))
+    b.close()
+    with pytest.raises(ServerClosedError):
+        b.submit(PendingRequest(payload=3, future=None))
+
+
+# ------------------------------------------------------------------ server
+
+
+@pytest.fixture(scope="module")
+def serve_cfg(tmp_path_factory):
+    from mpi_pytorch_tpu.config import Config
+
+    scratch = tmp_path_factory.mktemp("serve")
+    cfg = Config(
+        model_name="resnet18", num_classes=32, width=32, height=32,
+        synthetic_data=True, compute_dtype="float32",
+        serve_buckets="1,8", serve_max_wait_ms=5.0, serve_topk=3,
+        serve_queue_depth=64, loader_workers=4,
+        metrics_file=str(scratch / "serve_metrics.jsonl"),
+        log_file="", eval_log_file="",
+    )
+    cfg.validate_config()
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def server(serve_cfg):
+    from mpi_pytorch_tpu.serve import InferenceServer
+
+    srv = InferenceServer(serve_cfg, load_checkpoint=False)
+    yield srv
+    srv.close()
+
+
+def test_server_zero_compiles_across_bucket_mix(server):
+    """The acceptance invariant: after warmup, a request mix that lands in
+    BOTH buckets (1 and 8; replicated and data-sharded executables)
+    performs zero XLA compiles — measured by the backend-compile
+    listener, not assumed."""
+    rng = np.random.default_rng(0)
+    images = [
+        rng.integers(0, 256, size=(32, 32, 3)).astype(np.uint8)
+        for _ in range(13)
+    ]
+    preds = server.predict_batch(images, timeout=120)
+    assert preds.shape == (13, 3)
+    assert preds.dtype == np.int32
+    assert (preds >= 0).all() and (preds < 32).all()
+    # Each row's top-k indices are distinct classes.
+    assert all(len(set(row.tolist())) == 3 for row in preds)
+
+    # A second wave, single + bulk, post-warmup: still zero compiles.
+    one = server.predict_batch(images[:1], timeout=120)
+    again = server.predict_batch(images, timeout=120)
+    stats = server.stats()
+    assert stats["compiles_after_warmup"] == 0, stats
+    assert set(stats["buckets"]) == {1, 8}
+    assert sum(stats["by_bucket"].values()) == stats["batches"]
+    assert stats["served"] >= 27
+    # Determinism: the same image yields the same top-k every time.
+    np.testing.assert_array_equal(one[0], preds[0])
+    np.testing.assert_array_equal(again, preds)
+
+
+def test_server_preprocess_contract_and_bad_request(server):
+    """Float requests pass through as already-normalized; a wrong-shape
+    request fails ITS OWN future (typed), never the batch or the server."""
+    from mpi_pytorch_tpu.serve import ServeError
+
+    rng = np.random.default_rng(1)
+    raw = rng.integers(0, 256, size=(32, 32, 3)).astype(np.uint8)
+    from mpi_pytorch_tpu.data.pipeline import normalize_image
+
+    normalized = normalize_image(raw.astype(np.float32) / 255.0)
+    p_raw = server.predict_batch([raw], timeout=120)
+    p_norm = server.predict_batch([normalized], timeout=120)
+    np.testing.assert_array_equal(p_raw, p_norm)
+
+    bad = server.submit(np.zeros((4, 4, 3), np.uint8))
+    good = server.submit(raw)
+    with pytest.raises(ServeError):
+        bad.result(timeout=120)
+    np.testing.assert_array_equal(good.result(timeout=120), p_raw[0])
+
+
+def test_server_path_request_decodes(server, tmp_path):
+    """A path request goes through the real decode→resize→normalize stage
+    (native → PIL fallback) and predicts identically to submitting the
+    same pixels directly (PNG = lossless, so the arrays match exactly)."""
+    from PIL import Image
+
+    rng = np.random.default_rng(3)
+    raw = rng.integers(0, 256, size=(32, 32, 3)).astype(np.uint8)
+    path = tmp_path / "req.png"
+    Image.fromarray(raw).save(path)
+    from_path = server.predict_batch([str(path)], timeout=120)
+    from_array = server.predict_batch([raw], timeout=120)
+    np.testing.assert_array_equal(from_path, from_array)
+
+
+def test_server_metrics_records_schema(serve_cfg, server):
+    """The per-flush kind="serve" records validate against the shared obs
+    schema — the same contract report_run/check_results_artifacts read."""
+    from mpi_pytorch_tpu.obs.schema import load_records, validate_jsonl
+
+    # server fixture work has already run; records are on disk (line-buffered).
+    problems = validate_jsonl(serve_cfg.metrics_file)
+    assert not problems, problems
+    records = load_records(serve_cfg.metrics_file)
+    serves = [r for r in records if r["kind"] == "serve"]
+    assert serves, "no serve records written"
+    assert {r["bucket"] for r in serves} <= {1, 8}
+    for r in serves:
+        assert 0.0 < r["fill_ratio"] <= 1.0
+        assert r["requests"] <= r["bucket"]
+
+
+def test_server_rejects_after_close(serve_cfg):
+    from mpi_pytorch_tpu.serve import InferenceServer, ServerClosedError
+
+    # A second tiny server would recompile; reuse the executables via the
+    # lru-cached predict step — construction is the cheap part. Use a
+    # single-bucket config to keep it light.
+    import dataclasses
+
+    cfg = dataclasses.replace(serve_cfg, serve_buckets="8", metrics_file="")
+    cfg.validate_config()
+    srv = InferenceServer(cfg, load_checkpoint=False)
+    img = np.zeros((32, 32, 3), np.uint8)
+    fut = srv.submit(img)
+    assert fut.result(timeout=120).shape == (3,)
+    srv.close()  # graceful drain
+    with pytest.raises(ServerClosedError):
+        srv.submit(img)
+
+
+# ---------------------------------------------------------- top-k parity
+
+
+def test_topk_top1_matches_fused_head_argmax(monkeypatch):
+    """Satellite: the plain predict path's top-k column 0 IS the argmax the
+    fused head_predict computes — pinned through a real zoo model with the
+    real kernel (Pallas interpreter) on the 8-device mesh."""
+    import optax
+    from jax.sharding import Mesh
+
+    from mpi_pytorch_tpu.evaluate import _make_predict_step, _make_predict_step_impl
+    from mpi_pytorch_tpu.models import create_model_bundle
+    from mpi_pytorch_tpu.train.state import TrainState
+
+    bundle, variables = create_model_bundle(
+        "resnet18", 200, rng=jax.random.PRNGKey(0), image_size=32
+    )
+    state = TrainState.create(
+        apply_fn=bundle.model.apply, variables=variables,
+        tx=optax.identity(), rng=jax.random.PRNGKey(1),
+    )
+    mesh = Mesh(np.array(jax.devices()).reshape(-1, 1), ("data", "model"))
+    images = np.random.default_rng(0).normal(size=(8, 32, 32, 3)).astype(np.float32)
+    labels = np.asarray([3, 5, -1, 9, 0, 1, -1, 7], np.int32)
+    batch = (jnp.asarray(images), jnp.asarray(labels))
+
+    monkeypatch.setenv("MPT_HEAD_INTERPRET", "1")
+    _make_predict_step_impl.cache_clear()
+    try:
+        topk = _make_predict_step(mesh, jnp.float32, topk=5)
+        fused = _make_predict_step(mesh, jnp.float32, fused_head=True)
+        mk, pk = topk(state, batch)
+        mf, pf = fused(state, batch)
+    finally:
+        monkeypatch.delenv("MPT_HEAD_INTERPRET")
+        _make_predict_step_impl.cache_clear()
+    pk, pf = np.asarray(pk), np.asarray(pf)
+    assert pk.shape == (8, 5)
+    np.testing.assert_array_equal(pk[:, 0], pf)  # top-1 == fused argmax
+    # Metrics agree too (same logits, same masking).
+    for k in ("loss", "correct", "count"):
+        np.testing.assert_allclose(float(mk[k]), float(mf[k]), rtol=1e-4, atol=1e-4)
+    # topk>1 with the fused head is a contract violation, not a silent k=1.
+    with pytest.raises(ValueError):
+        _make_predict_step(mesh, jnp.float32, fused_head=True, topk=3)
+
+
+def test_topk1_path_unchanged(monkeypatch):
+    """topk=1 keeps the original [B] argmax contract (the predictions-CSV
+    path depends on it)."""
+    import optax
+    from jax.sharding import Mesh
+
+    from mpi_pytorch_tpu.evaluate import _make_predict_step
+    from mpi_pytorch_tpu.models import create_model_bundle
+    from mpi_pytorch_tpu.train.state import TrainState
+
+    bundle, variables = create_model_bundle(
+        "resnet18", 50, rng=jax.random.PRNGKey(0), image_size=32
+    )
+    state = TrainState.create(
+        apply_fn=bundle.model.apply, variables=variables,
+        tx=optax.identity(), rng=jax.random.PRNGKey(1),
+    )
+    mesh = Mesh(np.array(jax.devices()).reshape(-1, 1), ("data", "model"))
+    images = np.random.default_rng(2).normal(size=(8, 32, 32, 3)).astype(np.float32)
+    labels = np.arange(8, dtype=np.int32)
+    plain = _make_predict_step(mesh, jnp.float32)
+    _, p = plain(state, (jnp.asarray(images), jnp.asarray(labels)))
+    assert np.asarray(p).shape == (8,)
+
+
+# ----------------------------------------------------------- bench (smoke)
+
+
+def test_bench_serve_smoke(tmp_path):
+    """Acceptance: the CPU smoke bench emits schema-valid p50/p95/p99 +
+    throughput rows for at least two bucket sets, in both load shapes,
+    with zero steady-state compiles."""
+    from mpi_pytorch_tpu.obs.schema import validate_record
+
+    out = tmp_path / "serve_bench.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_serve.py"),
+         "--smoke", "--out", str(out)],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rows = [json.loads(l) for l in out.read_text().splitlines() if l.strip()]
+    assert len(rows) >= 4, rows
+    for r in rows:
+        assert not validate_record(r), validate_record(r)
+        assert r["p50_ms"] <= r["p95_ms"] <= r["p99_ms"]
+        assert r["images_per_sec"] > 0
+        assert r["compiles_after_warmup"] == 0
+        assert 0.0 < r["mean_fill_ratio"] <= 1.0
+    assert len({r["buckets"] for r in rows}) >= 2  # two bucket sets
+    assert {r["mode"] for r in rows} == {"closed", "open"}
+    open_rows = [r for r in rows if r["mode"] == "open"]
+    assert all(r["offered_rps"] for r in open_rows)
+
+
+def test_committed_serve_bench_artifact_validates():
+    """The committed docs/serve_bench.json rows pass the same lint CI
+    applies (check_results_artifacts covers it via the metrics sweep)."""
+    from mpi_pytorch_tpu.obs.schema import validate_jsonl
+
+    path = os.path.join(REPO, "docs", "serve_bench.json")
+    assert os.path.isfile(path), "docs/serve_bench.json missing"
+    assert not validate_jsonl(path)
+
+
+# ------------------------------------------------- compilation cache (sat)
+
+
+_CACHE_CHILD = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+hits = [0]
+def on_event(name, **kw):
+    if name == "/jax/compilation_cache/cache_hits":
+        hits[0] += 1
+jax.monitoring.register_event_listener(on_event)
+sys.path.insert(0, {repo!r})
+from mpi_pytorch_tpu.config import Config, apply_runtime_flags
+cfg = Config(compilation_cache_dir=sys.argv[1])
+apply_runtime_flags(cfg)   # the real wiring under test
+import jax.numpy as jnp
+jax.jit(lambda x: (x * 2 + 1).sum())(jnp.arange(64.0)).block_until_ready()
+print("CACHE_HITS", hits[0])
+"""
+
+
+def test_bench_serve_percentiles_survive_total_rejection():
+    """A fully-rejected sweep point (overload regime) must yield a row, not
+    an empty-array percentile crash."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_serve", os.path.join(REPO, "tools", "bench_serve.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod._percentiles([]) == {"p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0}
+    out = mod._percentiles([1.0, 2.0, 3.0])
+    assert out["p50_ms"] <= out["p95_ms"] <= out["p99_ms"]
+
+
+def test_compilation_cache_toggles_off(tmp_path, monkeypatch):
+    """A later run in the same process with the flag OFF must not keep
+    writing the previous run's cache dir (the jax_debug_nans rule)."""
+    monkeypatch.delenv("MPT_COMPILE_CACHE_DIR", raising=False)
+    from mpi_pytorch_tpu.config import enable_compilation_cache
+
+    enable_compilation_cache(str(tmp_path))
+    assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+    enable_compilation_cache("")
+    assert jax.config.jax_compilation_cache_dir is None
+
+
+def test_compilation_cache_reused_across_processes(tmp_path):
+    """Satellite: a second build in a FRESH subprocess reuses the cache dir
+    the first populated — --compilation-cache-dir turns repeat-run cold
+    compiles into cache hits."""
+    cache_dir = tmp_path / "jax_cache"
+    cache_dir.mkdir()
+    script = tmp_path / "cache_child.py"
+    script.write_text(_CACHE_CHILD.format(repo=REPO))
+
+    def run():
+        proc = subprocess.run(
+            [sys.executable, str(script), str(cache_dir)],
+            cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+            timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        line = [l for l in proc.stdout.splitlines() if l.startswith("CACHE_HITS")]
+        return int(line[0].split()[1])
+
+    assert run() == 0  # cold: populated, no hits
+    assert len(list(cache_dir.iterdir())) > 0, "cache dir not populated"
+    assert run() >= 1  # fresh process: served from the populated cache
+
+
+# ------------------------------------------------ multi-process replicas
+
+
+@pytest.mark.slow
+def test_two_process_serve_replicas(tmp_path):
+    """Satellite: replicated-server predictions match single-process. Two
+    real processes rendezvous through jax.distributed, each serving over
+    its LOCAL 4-device replica mesh; a third, plain single process runs
+    the identical workload. All three top-k streams must be identical."""
+    import socket
+
+    def _free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def _flags(env):
+        flags = [
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        return " ".join(flags + ["--xla_force_host_platform_device_count=4"])
+
+    child = os.path.join(REPO, "tests", "serve_child.py")
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = _cpu_env(
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid), MPT_MULTIHOST="1",
+        )
+        env["XLA_FLAGS"] = _flags(env)
+        procs.append(subprocess.Popen(
+            [sys.executable, child], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=900)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"child failed:\n{out}"
+
+    env = _cpu_env()
+    env["XLA_FLAGS"] = _flags(env)
+    single = subprocess.run(
+        [sys.executable, child], env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=900,
+    )
+    assert single.returncode == 0, single.stdout + single.stderr
+
+    lines = [
+        line
+        for out in outs + [single.stdout]
+        for line in out.splitlines()
+        if line.startswith("SERVE_OK")
+    ]
+    assert len(lines) == 3, (outs, single.stdout)
+    assert lines[0] == lines[1] == lines[2], lines

@@ -1,0 +1,273 @@
+"""Load-drive the online inference server: latency percentiles vs load.
+
+Two canonical load shapes, both per (bucket set, max_wait_ms) sweep point:
+
+- **closed loop**: N client threads in a submit→wait→repeat cycle — the
+  saturation throughput shape (offered load adapts to service rate).
+- **open loop**: seeded-Poisson arrivals at a fixed offered RPS — the SLO
+  shape (latency vs offered load, with typed rejections counted instead
+  of silently queueing unbounded). Open-loop numbers are the honest ones
+  for "can it hold X req/s at Y ms p99" (closed-loop coordinated omission
+  hides queueing).
+
+Each run prints ONE ``kind="serve_bench"`` JSONL row (p50/p95/p99 latency,
+images/sec, mean batch fill, rejected count, compiles-after-warmup — which
+must be 0, the serve subsystem's defining invariant). Rows validate
+against ``mpi_pytorch_tpu/obs/schema.py``; the committed artifact is
+``docs/serve_bench.json`` (``tools/summarize_benches.py`` renders it).
+
+``--smoke`` is the CPU tier-1 mode: tiny model, two bucket sets, closed +
+open loop, seconds not minutes — the shape of the measurement, not a
+number worth quoting. Chip rows are staged per the artifact discipline
+(docs/RESULTS.md staleness ledger) until a driver-confirmed TPU battery
+refreshes them.
+
+Run: ``python tools/bench_serve.py --smoke [--out docs/serve_bench.json]``
+     ``python tools/bench_serve.py --bucket-sets "1,8,32,128;1,32,512" \
+        --max-wait-ms 2,5,10 --requests 2000 --rps 0,500,2000``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentiles(lat_ms: list[float]) -> dict:
+    if not lat_ms:
+        # A fully-rejected sweep point (offered load >> capacity with a
+        # small queue) is a VALID result — the row must report rejected=N,
+        # not crash the sweep on an empty percentile.
+        return {"p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0}
+    arr = np.asarray(lat_ms, np.float64)
+    return {
+        "p50_ms": round(float(np.percentile(arr, 50)), 3),
+        "p95_ms": round(float(np.percentile(arr, 95)), 3),
+        "p99_ms": round(float(np.percentile(arr, 99)), 3),
+    }
+
+
+def _image_pool(n: int, size: tuple[int, int], seed: int) -> list[np.ndarray]:
+    """Distinct uint8 request images (raw pixels, so the server's
+    preprocess pool does real normalize work per request)."""
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(0, 256, size=(*size, 3)).astype(np.uint8) for _ in range(n)
+    ]
+
+
+def closed_loop(server, pool, requests: int, concurrency: int, timeout_s: float):
+    """N clients in submit→wait→repeat; returns (latencies_ms, wall_s, rejected)."""
+    lat_ms: list[float] = []
+    rejected = [0]
+    lock = threading.Lock()
+    counter = [0]
+
+    from mpi_pytorch_tpu.serve import QueueFullError
+
+    def client() -> None:
+        while True:
+            with lock:
+                i = counter[0]
+                if i >= requests:
+                    return
+                counter[0] += 1
+            t0 = time.monotonic()
+            try:
+                server.submit(pool[i % len(pool)]).result(timeout=timeout_s)
+            except QueueFullError:
+                with lock:
+                    rejected[0] += 1
+                continue
+            dt = 1e3 * (time.monotonic() - t0)
+            with lock:
+                lat_ms.append(dt)
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=client) for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return lat_ms, time.monotonic() - t0, rejected[0]
+
+
+def open_loop(server, pool, requests: int, rps: float, seed: int, timeout_s: float):
+    """Seeded-Poisson arrivals at ``rps``; latency measured per request
+    from its (intended) submit; full-queue submissions count as rejected."""
+    from mpi_pytorch_tpu.serve import QueueFullError
+
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rps, size=requests)
+    lat_ms: list[float] = []
+    lock = threading.Lock()
+    futures = []
+    rejected = 0
+    t0 = time.monotonic()
+    next_t = t0
+    for i in range(requests):
+        next_t += gaps[i]
+        delay = next_t - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        t_submit = time.monotonic()
+        try:
+            fut = server.submit(pool[i % len(pool)])
+        except QueueFullError:
+            rejected += 1
+            continue
+
+        def _done(f, t_submit=t_submit):
+            dt = 1e3 * (time.monotonic() - t_submit)
+            with lock:
+                lat_ms.append(dt)
+
+        fut.add_done_callback(_done)
+        futures.append(fut)
+    for f in futures:
+        f.result(timeout=timeout_s)
+    return lat_ms, time.monotonic() - t0, rejected
+
+
+def run_point(server, pool, *, mode, requests, concurrency, rps, seed, timeout_s):
+    stats0 = server.stats()
+    if mode == "open":
+        lat_ms, wall, rejected = open_loop(
+            server, pool, requests, rps, seed, timeout_s
+        )
+    else:
+        lat_ms, wall, rejected = closed_loop(
+            server, pool, requests, concurrency, timeout_s
+        )
+    stats1 = server.stats()
+    served = stats1["served"] - stats0["served"]
+    padded = stats1["padded_rows"] - stats0["padded_rows"]
+    fill = served / (served + padded) if served + padded else 0.0
+    row = {
+        "kind": "serve_bench",
+        "ts": time.time(),
+        "mode": mode,
+        "requests": len(lat_ms),
+        "rejected": rejected,
+        "offered_rps": round(rps, 1) if mode == "open" else None,
+        "images_per_sec": round(len(lat_ms) / wall, 1) if wall > 0 else 0.0,
+        "mean_fill_ratio": round(fill, 4),
+        "compiles_after_warmup": stats1["compiles_after_warmup"],
+        **_percentiles(lat_ms),
+    }
+    return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", default="resnet18")
+    ap.add_argument("--image", type=int, default=128)
+    ap.add_argument("--num-classes", type=int, default=64500)
+    ap.add_argument("--topk", type=int, default=5)
+    ap.add_argument("--compute-dtype", default="bfloat16")
+    ap.add_argument("--bucket-sets", default="1,8,32,128;1,32,512",
+                    help="semicolon-separated bucket SETS; one server build "
+                    "(and one warmup compile set) per entry")
+    ap.add_argument("--max-wait-ms", default="5",
+                    help="comma list; swept live per server (no recompile)")
+    ap.add_argument("--requests", type=int, default=2000)
+    ap.add_argument("--concurrency", type=int, default=32,
+                    help="closed-loop client threads")
+    ap.add_argument("--rps", default="0",
+                    help="comma list of offered open-loop rates; 0 = closed "
+                    "loop only for that sweep point")
+    ap.add_argument("--queue-depth", type=int, default=1024)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--timeout-s", type=float, default=120.0)
+    ap.add_argument("--fused-head", action="store_true",
+                    help="serve through ops.fused_head_ce.head_predict "
+                    "(TPU; forces topk=1)")
+    ap.add_argument("--out", default="",
+                    help="also write rows to this JSONL file (overwritten)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CPU tier-1 mode: tiny model, two bucket sets, "
+                    "closed+open loop, seconds not minutes")
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.model, args.image, args.num_classes = "resnet18", 32, 64
+        args.topk, args.compute_dtype = 3, "float32"
+        args.bucket_sets = "1,4;1,8"
+        args.max_wait_ms, args.requests, args.concurrency = "2", 48, 8
+        args.rps = "0,400"
+
+    # Pin the platform IN-SCRIPT: this image's sitecustomize registers the
+    # TPU plugin at interpreter startup, so the env var alone loses (the
+    # parse_config trick, config.py) — and --smoke is DEFINED as the CPU
+    # mode, so it must never claim the TPU grant.
+    platform = (
+        os.environ.get("MPT_PLATFORM")
+        or os.environ.get("JAX_PLATFORMS")
+        or ("cpu" if args.smoke else "")
+    )
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform.split(",")[0].strip())
+
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.serve import InferenceServer
+
+    out_rows = []
+    pool = _image_pool(32, (args.image, args.image), args.seed)
+    waits = [float(w) for w in args.max_wait_ms.split(",") if w.strip()]
+    rates = [float(r) for r in args.rps.split(",") if r.strip()]
+    for bucket_set in [b for b in args.bucket_sets.split(";") if b.strip()]:
+        cfg = Config(
+            model_name=args.model, num_classes=args.num_classes,
+            width=args.image, height=args.image, synthetic_data=True,
+            compute_dtype=args.compute_dtype, serve_buckets=bucket_set,
+            serve_max_wait_ms=waits[0], serve_queue_depth=args.queue_depth,
+            serve_topk=args.topk, fused_head_eval=args.fused_head,
+            metrics_file="", log_file="", eval_log_file="",
+        )
+        cfg.validate_config()
+        server = InferenceServer(cfg, load_checkpoint=False)
+        try:
+            for wait_ms in waits:
+                server.set_max_wait_ms(wait_ms)
+                for rps in rates:
+                    mode = "open" if rps > 0 else "closed"
+                    row = run_point(
+                        server, pool, mode=mode, requests=args.requests,
+                        concurrency=args.concurrency, rps=rps,
+                        seed=args.seed, timeout_s=args.timeout_s,
+                    )
+                    row.update(
+                        model=args.model, buckets=bucket_set,
+                        max_wait_ms=wait_ms, chips=jax.device_count(),
+                    )
+                    print(json.dumps(row), flush=True)
+                    out_rows.append(row)
+        finally:
+            server.close()
+
+    bad = [r for r in out_rows if r["compiles_after_warmup"] != 0]
+    if bad:
+        print(
+            f"WARNING: {len(bad)} row(s) observed steady-state compiles — "
+            "the zero-compile invariant is broken; rows are tainted",
+            file=sys.stderr,
+        )
+    if args.out:
+        with open(args.out, "w") as f:
+            for row in out_rows:
+                f.write(json.dumps(row) + "\n")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

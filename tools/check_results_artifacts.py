@@ -110,12 +110,19 @@ def check(path: str) -> list[str]:
 
 def check_metrics_artifacts(docs_dir: str | None = None) -> list[str]:
     """Schema violations across every committed ``*_metrics.jsonl`` artifact
-    (the obs record schema is the contract ``report_run.py`` renders by)."""
+    (the obs record schema is the contract ``report_run.py`` renders by),
+    plus ``serve_bench.json`` — the serve load driver's rows are obs
+    records too (``kind="serve_bench"``), so a truncated or hand-edited
+    latency row fails tier-1 like any other metrics artifact."""
     docs_dir = docs_dir or os.path.join(REPO, "docs")
     from mpi_pytorch_tpu.obs.schema import validate_jsonl
 
+    paths = sorted(glob.glob(os.path.join(docs_dir, "*_metrics.jsonl")))
+    serve_bench = os.path.join(docs_dir, "serve_bench.json")
+    if os.path.isfile(serve_bench):
+        paths.append(serve_bench)
     violations = []
-    for path in sorted(glob.glob(os.path.join(docs_dir, "*_metrics.jsonl"))):
+    for path in paths:
         rel = os.path.relpath(path, REPO)
         violations.extend(f"{rel}: {p}" for p in validate_jsonl(path))
     return violations

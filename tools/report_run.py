@@ -173,6 +173,37 @@ def summarize(records: list[dict]) -> dict:
              "time_s": round(e["time_s"], 2)}
             for e in evals
         ]
+    serves = by_kind.get("serve", [])
+    if serves:
+        waits = [s["queue_wait_ms"] for s in serves]
+        devs = [s["device_ms"] for s in serves]
+        preps = _finite([s.get("preprocess_ms") for s in serves])
+        by_bucket: dict[int, int] = {}
+        for s in serves:
+            by_bucket[s["bucket"]] = by_bucket.get(s["bucket"], 0) + 1
+        summary["serve"] = {
+            "batches": len(serves),
+            "requests": sum(s["requests"] for s in serves),
+            "mean_fill_ratio": round(_mean([s["fill_ratio"] for s in serves]), 4),
+            "queue_depth_max": max(s["queue_depth"] for s in serves),
+            "queue_wait_ms": {"mean": round(_mean(waits), 3), "max": round(max(waits), 3)},
+            "device_ms": {"mean": round(_mean(devs), 3), "max": round(max(devs), 3)},
+            "batches_by_bucket": {str(k): v for k, v in sorted(by_bucket.items())},
+        }
+        if preps:
+            summary["serve"]["preprocess_ms"] = {
+                "mean": round(_mean(preps), 3), "max": round(max(preps), 3),
+            }
+    serve_bench = by_kind.get("serve_bench", [])
+    if serve_bench:
+        summary["serve_bench"] = [
+            {k: r.get(k) for k in (
+                "mode", "buckets", "max_wait_ms", "offered_rps", "requests",
+                "rejected", "p50_ms", "p95_ms", "p99_ms", "images_per_sec",
+                "compiles_after_warmup",
+            )}
+            for r in serve_bench
+        ]
     anomalies = by_kind.get("anomaly", [])
     if anomalies:
         summary["anomalies"] = [
@@ -264,6 +295,35 @@ def render(path: str, records: list[dict], summary: dict) -> str:
             f"eval: acc {e['accuracy']} over {e['images']} images "
             f"in {e['time_s']} s"
         )
+    if "serve" in summary:
+        sv = summary["serve"]
+        out += ["", (
+            f"serving: {sv['requests']} request(s) over {sv['batches']} "
+            f"batch(es); mean fill {100.0 * sv['mean_fill_ratio']:.1f}%, "
+            f"peak queue depth {sv['queue_depth_max']}"
+        )]
+        phase_rows = [
+            ["queue-wait", sv["queue_wait_ms"]["mean"], sv["queue_wait_ms"]["max"]],
+            ["device", sv["device_ms"]["mean"], sv["device_ms"]["max"]],
+        ]
+        if "preprocess_ms" in sv:
+            phase_rows.insert(1, [
+                "preprocess", sv["preprocess_ms"]["mean"], sv["preprocess_ms"]["max"],
+            ])
+        out.append(table(["phase", "mean_ms", "max_ms"], phase_rows))
+        out.append(table(
+            ["bucket", "batches"],
+            [[k, v] for k, v in sv["batches_by_bucket"].items()],
+        ))
+    if "serve_bench" in summary:
+        out += ["", "serve bench rows:", table(
+            ["mode", "buckets", "wait_ms", "rps", "reqs", "p50", "p95",
+             "p99", "img/s", "compiles"],
+            [[r["mode"], r["buckets"], r["max_wait_ms"], r.get("offered_rps"),
+              r["requests"], r["p50_ms"], r["p95_ms"], r["p99_ms"],
+              r["images_per_sec"], r.get("compiles_after_warmup")]
+             for r in summary["serve_bench"]],
+        )]
     for a in summary.get("anomalies", []):
         out += ["", (
             f"ANOMALY: {a['reason']} at epoch {a['epoch']}"

@@ -154,6 +154,23 @@ def main() -> None:
             print(json.dumps(r))
         print()
 
+    sb = _load_jsonl(os.path.join(out, "serve_bench.json"))
+    if sb:
+        print("## serving latency vs load (tools/bench_serve.py)\n")
+        print("| mode | buckets | wait ms | offered rps | p50 ms | p95 ms | "
+              "p99 ms | img/s | fill | rejected | compiles |")
+        print("|---|---|---|---|---|---|---|---|---|---|---|")
+        for r in sb:
+            rps = r.get("offered_rps")
+            print(
+                f"| {r['mode']} | {_cell(r['buckets'])} | {r['max_wait_ms']} | "
+                f"{'—' if rps is None else rps} | {r['p50_ms']} | "
+                f"{r['p95_ms']} | {r['p99_ms']} | {r['images_per_sec']:,.0f} | "
+                f"{r.get('mean_fill_ratio', '?')} | {r.get('rejected', '?')} | "
+                f"{r.get('compiles_after_warmup', '?')} |"
+            )
+        print()
+
     for name in ("roofline_resnet18.txt", "roofline_densenet121.txt",
                  "flags_sweep.txt", "flags_densenet.txt",
                  "flags_squeezenet.txt"):
