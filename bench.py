@@ -91,6 +91,45 @@ def _fail_json(error: str) -> None:
     )
 
 
+# ---------------------------------------------------------------------------
+# Resumable partial bench rows (ROADMAP item 4's bench-resilience clause).
+#
+# A bench round through the device relay can die on ANY cell (r02 and r05
+# both burned whole rounds on one wedged backend, rc=3). The fix is cell-
+# granular durability: every completed row is appended to a
+# ``BENCH_*.partial.json`` (cell key → row, atomic rename) the moment it
+# lands, and ``--resume-from`` skips cells that file already holds — a
+# retry re-measures only what the wedge ate. Shared by this headline bench
+# and the tools/bench_modes.py sweep (which imports these helpers).
+# ---------------------------------------------------------------------------
+
+
+def load_partial(path: str) -> dict:
+    """Rows already measured in a partial file ({cell key: row}). A missing,
+    unreadable, or non-dict file is an empty dict — resume must never be
+    the thing that wedges a retry."""
+    if not path or not os.path.isfile(path):
+        return {}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (ValueError, OSError):
+        return {}
+    return data if isinstance(data, dict) else {}
+
+
+def append_partial_row(path: str, key: str, row: dict) -> None:
+    """Durably record one completed bench cell (read-modify-write, tmp +
+    atomic rename): a backend wedge later in the round costs a retry of the
+    REMAINING cells, not the whole round."""
+    rows = load_partial(path)
+    rows[key] = row
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rows, f, indent=1)
+    os.replace(tmp, path)
+
+
 def _probe_backend_with_retries(deadline: float) -> None:
     """Probe device-backend init in child interpreters, ``BACKEND_RETRIES``
     attempts with bounded jittered backoff inside the SHARED ``deadline``
@@ -174,7 +213,31 @@ BATCH_PER_CHIP = 2048  # throughput-optimal on v5e. B-sweep with the bf16
 WARMUP_STEPS = 5
 MEASURE_STEPS = 30
 
-def main() -> None:
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="headline resnet18 train bench (one JSON line)"
+    )
+    ap.add_argument(
+        "--partial-out", default=os.environ.get("MPT_BENCH_PARTIAL", ""),
+        help="also append the completed row to this BENCH_*.partial.json "
+             "the moment it lands (cell-granular durability)",
+    )
+    ap.add_argument(
+        "--resume-from", default="",
+        help="if this partial file already holds the cell, reprint the "
+             "stored row and exit without touching the backend",
+    )
+    args = ap.parse_args(argv)
+    cell = f"{MODEL}-b{BATCH_PER_CHIP}"
+    resumed = load_partial(args.resume_from).get(cell)
+    if resumed is not None:
+        # The whole point of resume: a retry after a wedge never re-enters
+        # backend init for cells that already landed.
+        print(json.dumps(resumed), flush=True)
+        return
+
     # ONE shared budget: child probes (bounded jittered retries) + the main
     # process's own init under the watchdog together fit the window, so the
     # driver's failure JSON always lands inside BACKEND_TIMEOUT_S.
@@ -294,6 +357,8 @@ def main() -> None:
     if peak and flops_per_step > 0:
         record["mfu_pct"] = round(100.0 * tflops_per_chip / peak, 1)
     print(json.dumps(record))
+    if args.partial_out:
+        append_partial_row(args.partial_out, cell, record)
 
 
 if __name__ == "__main__":

@@ -291,6 +291,55 @@ def _gather_to_host(arrays: dict, repl) -> dict:
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def _cast_moments(opt_state):
+    """``moments_bf16`` cast for a MIXED device/host optimizer tree:
+    jax.Array leaves go through the jitted device-side cast (fused into the
+    snapshot, as before); host numpy leaves — a ZeRO run's gathered-on-save
+    moments (trainer ``_saveable``) — are cast on the HOST. Routing them
+    through the jitted cast would device_put the full unsharded moment tree
+    back onto every device: exactly the 2×params transient the sharding
+    freed."""
+    import jax.numpy as jnp
+
+    flat, treedef = jax.tree_util.tree_flatten(opt_state)
+    dev_idx = [i for i, leaf in enumerate(flat) if isinstance(leaf, jax.Array)]
+    out = [
+        leaf.astype(jnp.bfloat16)
+        if (
+            not isinstance(leaf, jax.Array)
+            and hasattr(leaf, "dtype")
+            and leaf.dtype == np.float32
+            and leaf.size >= _MOMENT_CAST_MIN_SIZE
+        )
+        else leaf
+        for leaf in flat
+    ]
+    if dev_idx:
+        casted = _moment_cast_fn()([flat[i] for i in dev_idx])
+        for i, c in zip(dev_idx, casted):
+            out[i] = c
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _snapshot_mixed(arrays: dict, repl) -> dict:
+    """Donation-safe snapshot of a MIXED device/host state tree: jax.Array
+    leaves get the ~ms on-device jitted copy (fresh buffers the background
+    writer can read while the train loop donates the originals), host numpy
+    leaves pass through untouched. Jitting the whole tree would silently
+    device_put every host leaf replicated onto ALL devices — for a ZeRO
+    run's gathered-on-save optimizer state (trainer ``_saveable``) that is
+    exactly the 2×params transient HBM spike gather-on-save exists to
+    avoid."""
+    flat, treedef = jax.tree_util.tree_flatten(arrays)
+    dev_idx = [i for i, leaf in enumerate(flat) if isinstance(leaf, jax.Array)]
+    if dev_idx:
+        copied = _copy_fn(repl)([flat[i] for i in dev_idx])
+        jax.block_until_ready(copied)  # copy is cheap; be certain
+        for i, c in zip(dev_idx, copied):
+            flat[i] = c
+    return jax.tree_util.tree_unflatten(treedef, flat)
+
+
 class AsyncCheckpointer:
     """Non-blocking checkpointing: a ~ms on-device copy snapshots the state,
     then a background thread does the expensive ``device_get`` + serialize +
@@ -340,15 +389,14 @@ class AsyncCheckpointer:
         self.wait()
         arrays = _state_arrays(state)
         if moments_bf16:
-            arrays = dict(arrays, opt_state=_moment_cast_fn()(arrays["opt_state"]))
+            arrays = dict(arrays, opt_state=_cast_moments(arrays["opt_state"]))
         repl = _replicated_sharding(arrays)
         if repl is not None and _any_sharded(arrays):
             # Sharded state: leaf-by-leaf host gather (see _gather_to_host)
             # instead of materializing the whole unsharded state on-device.
             snapshot = _gather_to_host(arrays, repl)
         else:
-            snapshot = _copy_fn(repl)(arrays)
-            jax.block_until_ready(snapshot["params"])  # copy is cheap; be certain
+            snapshot = _snapshot_mixed(arrays, repl)
         if process_index() != 0:
             return None
         os.makedirs(ckpt_dir, exist_ok=True)

@@ -126,6 +126,24 @@ class Config:
     # sharded over the data axis instead of replicated — per-device optimizer
     # memory 2×params → 2×params/n. Auto (jit) mode only.
     zero_optimizer: bool = False
+    # ZeRO-style optimizer-state sharding for the SPMD (shard_map) step
+    # (ROADMAP item 2a, arXiv 2004.13336): every optimizer-state leaf is
+    # flatten-pad-partitioned 1/P over the data axis (train/state.py
+    # zero_shard_spec); each shard updates only its owned slice and an
+    # allgather reassembles full params for the next forward. Per-device
+    # optimizer HBM 2×params → 2×params/P; checkpoints gather-on-save, so
+    # the on-disk format is unchanged and legacy checkpoints load into
+    # either layout. spmd_mode only (the auto-jit twin is zero_optimizer).
+    zero_opt_state: bool = False
+    # Bucketed gradient sync for the SPMD step (ROADMAP item 2b, arXiv
+    # 1810.11112): replace the one fused post-backward pmean with one
+    # collective per ~N-MiB bucket of param leaves in reverse-topo order,
+    # so earlier buckets' collectives overlap the remaining backward
+    # compute; with zero_opt_state the buckets become reduce_scatters and
+    # grad comms halve. Value is the bucket size in MiB (~25 is the
+    # conventional sweet spot); 0 = the fused single-pmean baseline.
+    # spmd_mode only.
+    grad_sync_buckets: float = 0.0
     # ZeRO-3/FSDP-style parameter sharding (beyond reference parity): params
     # AND their Adam moments sharded over the data axis at rest; XLA
     # all-gathers each layer's weights at use and reduce-scatters its
@@ -372,6 +390,25 @@ class Config:
                 "zero_optimizer shards Adam moments via the auto-partitioned "
                 "jit step; the spmd_mode shard_map step replicates its state "
                 "specs, so the two do not compose"
+            )
+        if self.zero_opt_state and not self.spmd_mode:
+            raise ValueError(
+                "zero_opt_state shards the optimizer state inside the "
+                "spmd_mode shard_map step (explicit slice-update + params "
+                "allgather); for the auto-partitioned jit step use "
+                "zero_optimizer instead"
+            )
+        if self.grad_sync_buckets < 0:
+            raise ValueError(
+                f"grad_sync_buckets is a bucket size in MiB (0 disables), "
+                f"got {self.grad_sync_buckets}"
+            )
+        if self.grad_sync_buckets > 0 and not self.spmd_mode:
+            raise ValueError(
+                "grad_sync_buckets stages explicit per-bucket collectives "
+                "inside the spmd_mode shard_map step; the auto-partitioned "
+                "jit step has no explicit gradient collective to bucket "
+                "(XLA inserts and schedules its own)"
             )
         if self.track_best and not self.validate:
             raise ValueError(

@@ -98,9 +98,22 @@ class StepHealth:
         self.nan_sentinel = bool(nan_sentinel)
         self.tracer = tracer
         self._baseline = 0
+        # Gradient-sync telemetry (schema v2, optional): set by the trainer
+        # when --grad-sync-buckets is on. overlap_frac is the static
+        # bucket-plan estimate (train/step.py bucket_overlap_frac) stamped
+        # onto every step record; sync_ms is a per-step measured value where
+        # a caller has one (host code cannot decompose a fused device step,
+        # so the trainer leaves it unset — records carry it only from
+        # tooling that measures it by A/B).
+        self.overlap_frac: float | None = None
         if self.enabled:
             _ensure_compile_listener()
             self._baseline = _compile_count
+
+    def set_sync(self, *, overlap_frac: float | None = None) -> None:
+        """Arm the grad-sync fields on subsequent step records (trainer,
+        after the bucket plan is known)."""
+        self.overlap_frac = overlap_frac
 
     def start_epoch(self) -> None:
         """Re-arm the recompile counter: compiles BETWEEN epochs (first-call
@@ -117,24 +130,30 @@ class StepHealth:
         m: Mapping[str, Any],
         data_wait_s: float | None = None,
         step_s: float | None = None,
+        sync_ms: float | None = None,
     ) -> None:
         if not self.enabled:
             return
         loss = float(m["loss"])
         grad_norm = float(m["grad_norm"]) if "grad_norm" in m else None
-        self.metrics.write(
-            {
-                "kind": "step",
-                "epoch": epoch,
-                "step": step,
-                "loss": loss,
-                "grad_norm": grad_norm,
-                "data_wait_ms": None if data_wait_s is None else round(data_wait_s * 1e3, 3),
-                "step_ms": None if step_s is None else round(step_s * 1e3, 3),
-                "recompiles": _compile_count - self._baseline,
-                "hbm_bytes": device_bytes_in_use(),
-            }
-        )
+        record = {
+            "kind": "step",
+            "epoch": epoch,
+            "step": step,
+            "loss": loss,
+            "grad_norm": grad_norm,
+            "data_wait_ms": None if data_wait_s is None else round(data_wait_s * 1e3, 3),
+            "step_ms": None if step_s is None else round(step_s * 1e3, 3),
+            "recompiles": _compile_count - self._baseline,
+            "hbm_bytes": device_bytes_in_use(),
+        }
+        # Schema-v2 grad-sync fields only on runs that configured them —
+        # records from lever-less runs stay byte-identical to v1.
+        if self.overlap_frac is not None:
+            record["overlap_frac"] = self.overlap_frac
+        if sync_ms is not None:
+            record["sync_ms"] = round(sync_ms, 3)
+        self.metrics.write(record)
         self._sentinel(epoch, step, loss, grad_norm)
 
     def on_scan_epoch(self, epoch: int, m: Mapping[str, Any]) -> None:

@@ -16,7 +16,7 @@ Record kinds (every record also carries ``ts``, the epoch-seconds stamp
 | epoch     | epoch, loss, time_s, images_per_sec                 | tflops, mfu_pct |
 | val       | epoch, accuracy, loss                               |          |
 | eval      | accuracy, loss, images, time_s                      |          |
-| step      | epoch, step, loss                                   | grad_norm, data_wait_ms, step_ms, recompiles, hbm_bytes |
+| step      | epoch, step, loss                                   | grad_norm, data_wait_ms, step_ms, recompiles, hbm_bytes, sync_ms, overlap_frac |
 | heartbeat | epoch, step, step_ms, median_step_ms, stragglers, threshold | images_per_sec |
 | anomaly   | reason, epoch                                       | step, loss, grad_norm |
 | serve     | bucket, requests, queue_depth, fill_ratio, queue_wait_ms, device_ms | preprocess_ms, total_ms |
@@ -38,6 +38,16 @@ from __future__ import annotations
 
 import json
 from typing import Any, Mapping
+
+# Schema generations (additive only — readers accept every prior version's
+# records, and optional fields never become required):
+#   1: epoch/val/eval/step/heartbeat/anomaly (+serve, serve_bench in PR 4)
+#   2: step records may carry the grad-sync fields ``sync_ms`` (measured
+#      per-step gradient-sync milliseconds, where a tool measured one) and
+#      ``overlap_frac`` (the static bucket-plan overlap estimate the
+#      spmd --grad-sync-buckets trainer stamps; train/step.py
+#      bucket_overlap_frac) — ISSUE 6 / ROADMAP item 2.
+SCHEMA_VERSION = 2
 
 _NUM = (int, float)
 _INT = (int,)
@@ -74,6 +84,9 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
     "step": {
         "grad_norm": _NUM, "data_wait_ms": _NUM, "step_ms": _NUM,
         "recompiles": _INT, "hbm_bytes": _INT,
+        # v2 grad-sync fields (spmd --grad-sync-buckets; absent on v1
+        # records and on lever-less runs):
+        "sync_ms": _NUM, "overlap_frac": _NUM,
     },
     "heartbeat": {"images_per_sec": _NUM},
     "anomaly": {"step": _INT, "loss": _NUM, "grad_norm": _NUM},

@@ -17,6 +17,12 @@ step with numpy staging copies (one per parameter, ``mpi_tools.py:34-37``),
 XLA fuses it into the backward pass and schedules it on the ICI concurrently
 with remaining compute.
 
+Beyond the reference's surface: ``all_gather`` (tiled Allgather) and
+``reduce_scatter_mean`` (ReduceScatter/P) are the two halves of the
+ZeRO-sharded weight update (train/step.py ``zero_opt_state``) — the
+reference's MPI wrapper never needed them because every rank kept a full
+optimizer replica.
+
 These functions must run inside an SPMD context that binds the axis name
 (``shard_map`` over a mesh, or ``jit``-of-``shard_map``). Under plain
 auto-sharded ``jit`` they are unnecessary: replication + XLA's partitioner
@@ -52,6 +58,34 @@ def avg_grads(grads: Any, axis: str = "data") -> Any:
     """Average a gradient pytree across the data axis — the entire
     ``mpi_avg_grads`` stack (mpi_tools.py:30-37) as one fused collective."""
     return jax.tree_util.tree_map(lambda g: lax.pmean(g, axis), grads)
+
+
+def all_gather(x: Any, axis: str = "data") -> Any:
+    """Pytree tiled allgather over ``axis``: per-shard ``[n, ...]`` blocks →
+    the concatenated ``[P*n, ...]`` array on EVERY shard (≙ MPI Allgather on
+    device data). This is the reassembly half of the ZeRO-sharded weight
+    update (train/step.py, ``zero_opt_state``): each shard applies the
+    optimizer to its 1/P parameter slice, then one allgather rebuilds the
+    full parameter tree for the next forward."""
+    return jax.tree_util.tree_map(
+        lambda v: lax.all_gather(v, axis, tiled=True), x
+    )
+
+
+def reduce_scatter_mean(x: Any, axis: str = "data") -> Any:
+    """Pytree reduce-scatter-mean over ``axis``: each leaf must carry a
+    leading dimension divisible by the axis size; shard k receives block k of
+    the cross-shard MEAN (``psum_scatter / P`` — exactly slice k of what
+    ``pmean`` would hand every shard, at 1/P the egress bytes). The ZeRO
+    gradient path (train/step.py): with the optimizer state sharded, each
+    shard only ever *needs* its own gradient slice, so the grad collective
+    halves from allreduce to reduce-scatter."""
+    size = lax.psum(1, axis)
+    return jax.tree_util.tree_map(
+        lambda v: lax.psum_scatter(v, axis, scatter_dimension=0, tiled=True)
+        / size,
+        x,
+    )
 
 
 def broadcast_from(x: Any, axis: str = "data", root: int = 0) -> Any:

@@ -15,7 +15,12 @@ and the predict stage of #7 (SURVEY §2a). Two interchangeable SPMD styles:
   *explicit* collectives from ``parallel/collectives.py`` — per-shard forward
   with **local** BN statistics (exactly the reference's per-rank BN, SURVEY
   §7 'BatchNorm under DP'), then one fused ``pmean`` over grads. This is the
-  direct structural descendant of ``mpiexec`` + ``mpi_avg_grads``.
+  direct structural descendant of ``mpiexec`` + ``mpi_avg_grads``. Two
+  composable levers ride it (ROADMAP item 2): ``zero_opt_state`` shards the
+  optimizer state 1/P over the data axis (update-on-slice + params
+  allgather, arXiv 2004.13336) and ``grad_bucket_mb`` buckets the gradient
+  sync so collectives overlap the remaining backward (arXiv 1810.11112);
+  with both on, the buckets become reduce-scatters and grad comms halve.
 
 Both satisfy: N-shard step == 1-device step on the concatenated batch (up to
 BN-stats bookkeeping); tests/test_parallel.py asserts it.
@@ -511,13 +516,171 @@ def place_state_on_mesh(
 
 # ---------------------------------------------------------------------------
 # spmd mode: shard_map with explicit collectives (reference-parity semantics)
+# + the two training-half levers (ROADMAP item 2): ZeRO optimizer-state
+# sharding (arXiv 2004.13336) and bucketed gradient-sync overlap
+# (arXiv 1810.11112).
 # ---------------------------------------------------------------------------
 
 
-def make_spmd_train_step(mesh, compute_dtype=jnp.bfloat16, remat: bool = False) -> Callable:
+def _zero_chunk(size: int, n_shards: int) -> int:
+    """Rows per shard of a flatten-pad-reshaped leaf (``state.zero_shard_spec``)."""
+    return -(-size // n_shards)
+
+
+def grad_bucket_plan(params, bucket_mb: float) -> list[list[int]]:
+    """Partition the param tree's flat-leaf indices into ~``bucket_mb``-MiB
+    buckets in REVERSE flatten order — the reverse-topological approximation
+    (backward produces the later layers' gradients first, so the first
+    bucket to fill is the first whose collective can be issued while the
+    backward for earlier layers is still running; arXiv 1810.11112
+    characterizes exactly this allreduce/compute overlap). Leaves of
+    different dtypes never share a bucket (each bucket is one fused
+    collective over a concatenated flat vector); a single leaf larger than
+    the cap gets a bucket of its own. Works on concrete arrays AND on
+    tracers (the step calls it at trace time; the trainer calls it on the
+    placed params for telemetry — same plan, one source of truth)."""
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(params)
+    cap = max(1, int(bucket_mb * (1 << 20)))
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes, cur_dtype = 0, None
+    for i in reversed(range(len(leaves))):
+        leaf = leaves[i]
+        dtype = np.dtype(leaf.dtype)
+        nbytes = leaf.size * dtype.itemsize
+        if cur and (cur_bytes + nbytes > cap or dtype != cur_dtype):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+        cur_dtype = dtype
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def bucket_overlap_frac(params, buckets: list[list[int]]) -> float:
+    """Static dataflow estimate of the overlap opportunity: the fraction of
+    gradient-sync bytes whose collective is issued BEFORE the final bucket.
+    The final bucket holds the earliest layers' gradients, which only exist
+    once the backward itself completes — its collective can never hide under
+    remaining backward compute; every earlier bucket's can. A plan-derived
+    upper bound, not a measurement (one bucket ≡ the fused baseline → 0.0);
+    the measured per-bucket timings are a chip-profile question
+    (``tools/bench_modes.py --levers``)."""
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(params)
+
+    def bucket_bytes(bucket):
+        return sum(
+            leaves[i].size * np.dtype(leaves[i].dtype).itemsize for i in bucket
+        )
+
+    total = sum(bucket_bytes(b) for b in buckets)
+    if total == 0 or len(buckets) <= 1:
+        return 0.0
+    return round(1.0 - bucket_bytes(buckets[-1]) / total, 4)
+
+
+def _slice_tree(tree, data_axis: str, n_shards: int):
+    """Shard k's OWNED 1/P slice of every leaf (the ``zero_shard_spec``
+    flatten-pad partition), taken with one dynamic_slice per leaf at
+    ``lax.axis_index`` — must run inside a shard_map binding ``data_axis``."""
+    idx = lax.axis_index(data_axis)
+
+    def slc(x):
+        chunk = _zero_chunk(x.size, n_shards)
+        flat = jnp.pad(x.reshape(-1), (0, chunk * n_shards - x.size))
+        return lax.dynamic_slice(flat, (idx * chunk,), (chunk,))
+
+    return jax.tree_util.tree_map(slc, tree)
+
+
+def _bucketed_pmean(grads, buckets, data_axis: str):
+    """Replace the one whole-tree fused ``pmean`` with one pmean per bucket,
+    issued in reverse-topo order. Each bucket's collective depends ONLY on
+    its own leaves' gradients, so the XLA scheduler is free to start it on
+    the ICI while the backward is still producing earlier layers' grads —
+    the dataflow form of allreduce/compute overlap (arXiv 1810.11112)."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    out: list = [None] * len(leaves)
+    for bucket in buckets:
+        flat = jnp.concatenate([leaves[i].reshape(-1) for i in bucket])
+        mean = lax.pmean(flat, data_axis)
+        off = 0
+        for i in bucket:
+            n = leaves[i].size
+            out[i] = mean[off : off + n].reshape(leaves[i].shape)
+            off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _bucketed_reduce_scatter(grads, buckets, data_axis: str, n_shards: int):
+    """The (a)+(b) composition: each bucket is ONE ``psum_scatter`` over its
+    leaves stacked ``[P, chunk_i]`` and concatenated along the chunk axis —
+    shard k receives exactly row k, its OWNED slice of every leaf in the
+    ``zero_shard_spec`` layout, at half an allreduce's egress bytes (the
+    grad-comms halving of arXiv 2004.13336 §weight-update sharding).
+    Returns the tree of ``[chunk]`` mean-gradient slices."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    out: list = [None] * len(leaves)
+    for bucket in buckets:
+        stacked = []
+        for i in bucket:
+            chunk = _zero_chunk(leaves[i].size, n_shards)
+            flat = jnp.pad(
+                leaves[i].reshape(-1), (0, chunk * n_shards - leaves[i].size)
+            )
+            stacked.append(flat.reshape(n_shards, chunk))
+        cat = jnp.concatenate(stacked, axis=1)
+        sl = (
+            lax.psum_scatter(cat, data_axis, scatter_dimension=0, tiled=True)
+            / n_shards
+        ).reshape(-1)
+        off = 0
+        for i in bucket:
+            chunk = _zero_chunk(leaves[i].size, n_shards)
+            out[i] = sl[off : off + chunk]
+            off += chunk
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def make_spmd_train_step(
+    mesh,
+    compute_dtype=jnp.bfloat16,
+    remat: bool = False,
+    zero_opt_state: bool = False,
+    grad_bucket_mb: float = 0.0,
+) -> Callable:
     """Reference-parity DP step: shard_map over ``data``; local BN stats;
     explicit ``avg_grads`` pmean — the literal TPU translation of one
     training iteration of ``mpiexec -n N python -m mpi4py main.py``.
+
+    Two composable levers on top (ROADMAP item 2; both default OFF, in which
+    case the step is byte-identical to the reference-parity baseline):
+
+    - ``zero_opt_state`` (``--zero-opt-state``): the optimizer state arrives
+      in the ``zero_shard_spec`` layout (``state.zero_shard_opt_state``:
+      every array leaf ``[P, chunk]``, sharded over ``data``). Each shard
+      slices out ITS 1/P of the params and mean gradients, applies the
+      optimizer update to that slice only, and one tiled ``all_gather``
+      (collectives.py) reassembles full params for the next forward —
+      per-device optimizer HBM drops 2×params → 2×params/P with the same
+      update math (arXiv 2004.13336). The sliced update is exact because
+      adam/adamw/sgd-momentum (and ``multi_transform`` freezing) are
+      elementwise per leaf and the flatten-pad slicing preserves the optax
+      tree structure.
+
+    - ``grad_bucket_mb`` > 0 (``--grad-sync-buckets``): the one fused
+      post-backward ``pmean`` becomes one collective per ~N-MiB bucket of
+      param leaves in reverse-topo order (``grad_bucket_plan``) — each
+      bucket's collective depends only on its own grads, so it can overlap
+      the remaining backward (arXiv 1810.11112). With ``zero_opt_state``
+      the buckets become ``reduce_scatter``s: each shard receives only its
+      owned slice and grad comms halve.
 
     The self-partitioning Mosaic kernels (``ops/fused_stem.py``,
     ``ops/fused_head_ce.py``, ``ops/fused_attention_small.py``) compose
@@ -526,50 +689,148 @@ def make_spmd_train_step(mesh, compute_dtype=jnp.bfloat16, remat: bool = False) 
     per-shard kernel call directly instead of nesting a second shard_map
     over the same axis."""
     data_axis = mesh.axis_names[0]
+    n_shards = mesh.shape[data_axis]
 
-    def per_shard(state: TrainState, batch):
+    def _forward_backward(state: TrainState, batch):
         images, labels = batch
         images = ingest_images(images, compute_dtype)
         # Per-shard rng ≙ each MPI rank's independent dropout stream.
         rng = jax.random.fold_in(
             jax.random.fold_in(state.rng, state.step), lax.axis_index(data_axis)
         )
-        loss, logits, new_bs, grads = _loss_and_updates(state, images, labels, rng, remat=remat)
-
-        # THE line (≙ the entire mpi_avg_grads stack, mpi_tools.py:30-37):
-        grads = collectives.avg_grads(grads, axis=data_axis)
-
+        loss, logits, new_bs, grads = _loss_and_updates(
+            state, images, labels, rng, remat=remat
+        )
         # Running BN stats: normalization above used LOCAL batch stats
         # (reference per-rank semantics); the stored running averages are
         # pmean'd so the replicated state stays consistent across shards
         # (the reference instead checkpoints rank 0's stats, main.py:162-171).
         if new_bs is not None:
             new_bs = collectives.all_reduce(new_bs, "mean", axis=data_axis)
+        return loss, logits, new_bs, grads, labels
 
-        new_state = _apply_updates(state, grads, new_bs)
+    def _metrics(loss, logits, labels, grad_norm):
         # Reported loss is the GLOBAL per-sample mean (each shard's mean loss
         # weighted by its valid-row count), so padded tail steps with uneven
-        # shard occupancy stay exact — the *gradient* above keeps the
-        # reference's unweighted per-rank average (mpi_avg_grads divides by
-        # world size regardless of local batch size, mpi_tools.py:36).
+        # shard occupancy stay exact — the *gradient* keeps the reference's
+        # unweighted per-rank average (mpi_avg_grads divides by world size
+        # regardless of local batch size, mpi_tools.py:36).
         local_count = valid_count(labels)
         global_count = lax.psum(local_count, data_axis)
-        metrics = {
+        return {
             "loss": lax.psum(loss * local_count.astype(loss.dtype), data_axis)
             / jnp.maximum(global_count.astype(loss.dtype), 1),
             "correct": lax.psum(accuracy_count(logits, labels), data_axis),
             "count": global_count,
-            # grads were just pmean'd: every shard computes the identical
-            # global-gradient norm, so no further collective is needed.
-            "grad_norm": optax.global_norm(grads).astype(jnp.float32),
+            "grad_norm": grad_norm.astype(jnp.float32),
         }
-        return new_state, metrics
 
-    sharded = shard_map(
-        per_shard,
-        mesh=mesh,
-        in_specs=(P(), (P(data_axis), P(data_axis))),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
-    return jax.jit(sharded, donate_argnums=(0,))
+    if not zero_opt_state:
+
+        def per_shard(state: TrainState, batch):
+            loss, logits, new_bs, grads, labels = _forward_backward(state, batch)
+            if grad_bucket_mb > 0:
+                grads = _bucketed_pmean(
+                    grads, grad_bucket_plan(grads, grad_bucket_mb), data_axis
+                )
+            else:
+                # THE line (≙ the entire mpi_avg_grads stack, mpi_tools.py:30-37):
+                grads = collectives.avg_grads(grads, axis=data_axis)
+            new_state = _apply_updates(state, grads, new_bs)
+            # grads were just averaged: every shard computes the identical
+            # global-gradient norm, so no further collective is needed.
+            return new_state, _metrics(loss, logits, labels, optax.global_norm(grads))
+
+        sharded = shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(P(), (P(data_axis), P(data_axis))),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0,))
+
+    # --- zero_opt_state: ZeRO-sharded weight update ------------------------
+    # The optimizer state's array leaves travel through shard_map as a FLAT
+    # TUPLE with per-leaf specs (P(data) for [P, chunk] leaves, P() for
+    # scalars) — the rest of the TrainState stays one replicated P() prefix.
+    # The treedef is closed over per trace, so jit recompiles only if the
+    # optimizer structure itself changes (it never does mid-run: zero
+    # steady-state compiles, asserted by the dryrun leg).
+
+    def per_shard_zero(opt_treedef, state: TrainState, flat_opt, batch):
+        loss, logits, new_bs, grads, labels = _forward_backward(state, batch)
+
+        if grad_bucket_mb > 0:
+            grad_slices = _bucketed_reduce_scatter(
+                grads, grad_bucket_plan(grads, grad_bucket_mb), data_axis, n_shards
+            )
+        else:
+            grads = collectives.avg_grads(grads, axis=data_axis)
+            grad_slices = _slice_tree(grads, data_axis, n_shards)
+        # Global grad norm from the owned slices: the slices tile the mean
+        # gradient exactly (padding contributes zeros), so psum of per-slice
+        # squared sums is the global squared norm — same number every other
+        # step flavor reports, one scalar collective.
+        sq = sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32)))
+            for g in jax.tree_util.tree_leaves(grad_slices)
+        )
+        grad_norm = jnp.sqrt(lax.psum(sq, data_axis))
+
+        param_slices = _slice_tree(state.params, data_axis, n_shards)
+        opt_local = jax.tree_util.tree_unflatten(
+            opt_treedef,
+            [
+                leaf.reshape(leaf.shape[1:]) if getattr(leaf, "ndim", 0) else leaf
+                for leaf in flat_opt
+            ],
+        )
+        # The sliced trees preserve the params' TREE structure, so the optax
+        # chain (schedules off the replicated count scalar, multi_transform
+        # labels, adamw decay against the sliced params) applies unchanged.
+        updates, new_opt = state.tx.update(grad_slices, opt_local, param_slices)
+        new_param_slices = optax.apply_updates(param_slices, updates)
+        # Reassemble full params for the next forward: ONE tiled allgather
+        # per leaf, then strip the zero_shard_spec padding.
+        gathered = collectives.all_gather(new_param_slices, axis=data_axis)
+        new_params = jax.tree_util.tree_map(
+            lambda full, orig: full[: orig.size].reshape(orig.shape),
+            gathered,
+            state.params,
+        )
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_bs if state.batch_stats is not None else None,
+            rng=jax.random.fold_in(state.rng, 1),
+        )
+        new_flat = tuple(
+            leaf[None] if getattr(leaf, "ndim", 0) else leaf
+            for leaf in jax.tree_util.tree_leaves(new_opt)
+        )
+        return new_state, new_flat, _metrics(loss, logits, labels, grad_norm)
+
+    def step(state: TrainState, batch):
+        flat_opt, opt_treedef = jax.tree_util.tree_flatten(state.opt_state)
+        opt_specs = tuple(
+            P(data_axis) if getattr(leaf, "ndim", 0) else P() for leaf in flat_opt
+        )
+        core = shard_map(
+            functools.partial(per_shard_zero, opt_treedef),
+            mesh=mesh,
+            in_specs=(P(), opt_specs, (P(data_axis), P(data_axis))),
+            out_specs=(P(), opt_specs, P()),
+            check_vma=False,
+        )
+        new_state, new_flat, metrics = core(
+            state.replace(opt_state=()), tuple(flat_opt), batch
+        )
+        return (
+            new_state.replace(
+                opt_state=jax.tree_util.tree_unflatten(opt_treedef, list(new_flat))
+            ),
+            metrics,
+        )
+
+    return jax.jit(step, donate_argnums=(0,))

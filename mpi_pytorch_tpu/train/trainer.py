@@ -34,8 +34,15 @@ from mpi_pytorch_tpu.data import DataLoader, load_manifests
 from mpi_pytorch_tpu.models import create_model_bundle
 from mpi_pytorch_tpu.obs import Heartbeat, StepHealth, Tracer
 from mpi_pytorch_tpu.parallel.mesh import create_mesh, flat_mesh, shard_batch
-from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
+from mpi_pytorch_tpu.train.state import (
+    TrainState,
+    make_optimizer,
+    zero_shard_opt_state,
+    zero_unshard_opt_state,
+)
 from mpi_pytorch_tpu.train.step import (
+    bucket_overlap_frac,
+    grad_bucket_plan,
     make_cached_eval_step,
     make_cached_train_step,
     make_eval_step,
@@ -601,6 +608,38 @@ def _train_impl(
     state = place_state_on_mesh(
         state, mesh, zero_optimizer=cfg.zero_optimizer, fsdp=cfg.fsdp
     )
+    # ZeRO opt-state sharding (spmd mode): capture the UNSHARDED optimizer
+    # layout first (eval_shape: shapes only, zero device memory) — it is the
+    # gather-on-save template that keeps the on-disk checkpoint format
+    # identical to an unsharded run's — then repartition every moment leaf
+    # [P, chunk] over the data axis (train/state.py zero_shard_spec).
+    opt_template = None
+    if cfg.spmd_mode and cfg.zero_opt_state:
+        opt_template = jax.eval_shape(state.tx.init, state.params)
+        state = state.replace(opt_state=zero_shard_opt_state(state.opt_state, mesh))
+        n_data = mesh.shape[cfg.mesh.data_axis]
+        moment_bytes = sum(
+            s.data.nbytes
+            for leaf in jax.tree_util.tree_leaves(state.opt_state)
+            if hasattr(leaf, "addressable_shards") and leaf.ndim > 0
+            for s in leaf.addressable_shards[:1]
+        )
+        logger.info(
+            "ZeRO opt-state sharding: moments partitioned 1/%d over '%s' "
+            "(%.1f MB/device)",
+            n_data, cfg.mesh.data_axis, moment_bytes / 1e6,
+        )
+
+    def _saveable(st: TrainState) -> TrainState:
+        """The checkpoint view of the state: with ZeRO-sharded optimizer
+        state, gather-on-save to the unsharded host layout (one leaf at a
+        time) so the file format never depends on the run's sharding."""
+        if opt_template is None:
+            return st
+        return st.replace(
+            opt_state=zero_unshard_opt_state(st.opt_state, opt_template)
+        )
+
     host_batch = cfg.batch_size // jax.process_count()
 
     # AOT-compile the step on the static batch shape: one compile serves the
@@ -662,7 +701,11 @@ def _train_impl(
     else:
         _compile_span = tracer.begin("compile")
         step_fn = (
-            make_spmd_train_step(mesh, _dtype(cfg.compute_dtype), remat=(cfg.remat == "full"))
+            make_spmd_train_step(
+                mesh, _dtype(cfg.compute_dtype), remat=(cfg.remat == "full"),
+                zero_opt_state=cfg.zero_opt_state,
+                grad_bucket_mb=cfg.grad_sync_buckets,
+            )
             if cfg.spmd_mode
             else make_train_step(
                 _dtype(cfg.compute_dtype), remat=(cfg.remat == "full"),
@@ -708,6 +751,34 @@ def _train_impl(
     else:
         flops_per_step = hw.step_flops(compiled_step)
     tracer.end(_compile_span)
+    # Grad-sync bucket-plan telemetry (spmd + --grad-sync-buckets): one
+    # instant span per bucket (bytes/leaves, in reverse-topo issue order)
+    # and the static overlap_frac estimate stamped onto every step health
+    # record — the plan the chip A/B (tools/bench_modes.py --levers)
+    # measures against.
+    if cfg.spmd_mode and cfg.grad_sync_buckets > 0:
+        _plan = grad_bucket_plan(state.params, cfg.grad_sync_buckets)
+        _overlap = bucket_overlap_frac(state.params, _plan)
+        _leaves = jax.tree_util.tree_leaves(state.params)
+        for _order, _bucket in enumerate(_plan):
+            tracer.instant(
+                "grad_bucket",
+                args={
+                    "order": _order,
+                    "leaves": len(_bucket),
+                    "bytes": int(
+                        sum(_leaves[i].size * _leaves[i].dtype.itemsize
+                            for i in _bucket)
+                    ),
+                },
+            )
+        health.set_sync(overlap_frac=_overlap)
+        logger.info(
+            "grad-sync buckets: %d × ~%.0f MiB (reverse-topo issue order), "
+            "%.0f%% of sync bytes overlap-eligible%s",
+            len(_plan), cfg.grad_sync_buckets, 100.0 * _overlap,
+            ", reduce-scatter (ZeRO slices)" if cfg.zero_opt_state else "",
+        )
     peak = hw.peak_bf16_tflops(jax.devices()[0])
     if heartbeat.enabled and heartbeat.every > n_steps:
         # Beats never span epoch boundaries (the window resets per epoch),
@@ -920,7 +991,8 @@ def _train_impl(
                 ckpt_t0 = time.perf_counter()
                 with tracer.span("checkpoint", args={"epoch": epoch}):
                     path = checkpointer.save(
-                        cfg.checkpoint_dir, epoch=epoch, state=state, loss=epoch_loss,
+                        cfg.checkpoint_dir, epoch=epoch, state=_saveable(state),
+                        loss=epoch_loss,
                         keep=cfg.keep_checkpoints,
                         moments_bf16=cfg.ckpt_bf16_moments,
                     )
@@ -996,7 +1068,7 @@ def _train_impl(
                         _mark_best(path)
                     else:
                         best_path = checkpointer.save(
-                            cfg.checkpoint_dir, epoch=epoch, state=state,
+                            cfg.checkpoint_dir, epoch=epoch, state=_saveable(state),
                             loss=epoch_loss, keep=cfg.keep_checkpoints,
                             on_durable=_mark_best,
                             moments_bf16=cfg.ckpt_bf16_moments,
@@ -1031,7 +1103,8 @@ def _train_impl(
         completed = start_epoch + summary.epochs_run - 1
         if completed >= start_epoch and completed != last_saved_epoch:
             path = checkpointer.save(
-                cfg.checkpoint_dir, epoch=completed, state=state, loss=epoch_loss,
+                cfg.checkpoint_dir, epoch=completed, state=_saveable(state),
+                loss=epoch_loss,
                 keep=cfg.keep_checkpoints, dirty=stopped_mid_epoch,
                 moments_bf16=cfg.ckpt_bf16_moments,
             )

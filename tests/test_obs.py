@@ -293,6 +293,44 @@ def test_train_step_metrics_include_global_grad_norm():
     np.testing.assert_allclose(got, float(optax.global_norm(grads)), rtol=1e-4)
 
 
+def test_step_sync_fields_schema_and_render(tmp_path, capsys):
+    """Schema v2 (grad-sync levers): step records MAY carry sync_ms /
+    overlap_frac — v1 records without them stay valid, mistyped values fail
+    validation, and report_run renders the grad-sync phase row + overlap
+    line only when the fields are present (satellite: backward-compatible
+    rendering)."""
+    v2 = {"ts": 2.0, "kind": "step", "epoch": 0, "step": 1, "loss": 0.9,
+          "sync_ms": 3.2, "overlap_frac": 0.87}
+    v1 = {"ts": 1.0, "kind": "step", "epoch": 0, "step": 0, "loss": 1.0}
+    assert validate_record(v1) == [] and validate_record(v2) == []
+    assert validate_record({**v2, "overlap_frac": "high"}) != []
+    assert validate_record({**v2, "sync_ms": True}) != []
+
+    both = tmp_path / "levers_metrics.jsonl"
+    both.write_text(json.dumps(v1) + "\n" + json.dumps(v2) + "\n")
+    assert validate_jsonl(str(both)) == []
+    assert report_run.main([str(both)]) == 0
+    out = capsys.readouterr().out
+    assert "grad-sync" in out and "overlap-eligible" in out
+
+    old = tmp_path / "old_metrics.jsonl"
+    old.write_text(json.dumps(v1) + "\n")
+    assert report_run.main([str(old)]) == 0
+    assert "grad-sync" not in capsys.readouterr().out
+
+
+def test_report_run_renders_committed_levers_artifact(capsys):
+    """The committed §4e dryrun artifact (spmd --zero-opt-state
+    --grad-sync-buckets, 8-device CPU mesh) renders with the overlap line
+    and zero recompiles — the artifact CI schema-checks via
+    check_results_artifacts."""
+    path = os.path.join(REPO, "docs", "levers_dryrun_metrics.jsonl")
+    assert report_run.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "overlap-eligible" in out
+    assert "recompiles (max per record): 0" in out
+
+
 # ---------------------------------------------------------------------------
 # end-to-end: telemetry-enabled dryrun + the report tool
 # ---------------------------------------------------------------------------

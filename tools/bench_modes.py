@@ -11,11 +11,25 @@ discipline as bench.py/bench_zoo.py and prints one JSON line per mode:
     cached        — HBM-resident dataset, per-step index gather
     cached-scan   — HBM-resident dataset, whole epoch as one lax.scan
 
+Plus the TRAINING-HALF LEVER sweep (ISSUE 6 / ROADMAP item 2) over the spmd
+shard_map step — ``--levers`` runs the staged A/B in one command:
+
+    spmd-base         — fused single-pmean baseline (reference parity)
+    spmd-zero         — ZeRO optimizer-state sharding (--zero-opt-state)
+    spmd-buckets      — bucketed grad-sync overlap (--grad-sync-buckets)
+    spmd-zero-buckets — both: buckets become reduce_scatters
+
+Lever rows add per-chip HBM high-water, optimizer-state MB/chip, MFU, the
+static overlap_frac of the bucket plan, and compiles_after_warmup (must be
+0 — the zero-steady-state-compile invariant, re-checked per row).
+
 Streaming modes re-shard a fresh host batch EVERY step (device_put inside
 the timed loop), so they carry the real H2D cost the dtype modes differ by;
 the cached modes send only [B] int32 indices (and the scan, one dispatch per
-epoch). Run: ``python tools/bench_modes.py [--steps 20] [--out path]``.
-The packed-mmap path is host-side decode (no chip leg) — its numbers live in
+epoch). Run: ``python tools/bench_modes.py [--steps 20] [--out path]``
+(``--levers`` for the A/B; ``--partial-out``/``--resume-from`` give cell-
+granular durability across a wedged backend — see bench.py). The
+packed-mmap path is host-side decode (no chip leg) — its numbers live in
 docs/RESULTS.md §4 host-ingest table.
 """
 
@@ -94,7 +108,82 @@ def bench_streaming(input_dtype: str, batch_per_chip: int, steps: int, warmup: i
         state, _ = compiled(state, shard_batch((images, labels), mesh))
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
-    return dt, steps * batch, n_chips
+    return dt, steps * batch, n_chips, {}
+
+
+def _hbm_high_water():
+    """Per-chip HBM high-water mark (bytes), or None where the backend has
+    no memory_stats (CPU) — the column carries null, not a fake zero."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            return int(stats.get("peak_bytes_in_use", stats.get("bytes_in_use")))
+    except Exception:
+        pass
+    return None
+
+
+def bench_spmd(zero: bool, bucket_mb: float, batch_per_chip: int, steps: int, warmup: int):
+    """One training-half-lever cell: the spmd shard_map step with ZeRO
+    opt-state sharding and/or bucketed grad sync. Same timing discipline as
+    the streaming modes (fresh device_put per step), plus the lever
+    telemetry columns: optimizer-state MB actually resident per chip, the
+    bucket plan's static overlap_frac, HBM high-water, and a
+    compiles-after-warmup recheck of the zero-steady-state invariant."""
+    from mpi_pytorch_tpu.obs.health import compile_count, ensure_compile_listener
+    from mpi_pytorch_tpu.parallel.mesh import shard_batch
+    from mpi_pytorch_tpu.train.state import zero_shard_opt_state
+    from mpi_pytorch_tpu.train.step import (
+        bucket_overlap_frac,
+        grad_bucket_plan,
+        make_spmd_train_step,
+    )
+    from mpi_pytorch_tpu.utils.hardware import peak_bf16_tflops, step_flops
+
+    mesh, state = _setup()
+    if zero:
+        state = state.replace(opt_state=zero_shard_opt_state(state.opt_state, mesh))
+    opt_bytes_per_chip = sum(
+        leaf.addressable_shards[0].data.nbytes
+        for leaf in jax.tree_util.tree_leaves(state.opt_state)
+        if hasattr(leaf, "addressable_shards") and leaf.ndim > 0
+    )
+    n_chips = jax.device_count()
+    batch = batch_per_chip * n_chips
+    images, labels = _host_batch(batch, "float32")
+    step = make_spmd_train_step(
+        mesh, jnp.bfloat16, zero_opt_state=zero, grad_bucket_mb=bucket_mb
+    )
+    compiled = step.lower(state, shard_batch((images, labels), mesh)).compile()
+    flops = step_flops(compiled)
+
+    ensure_compile_listener()
+    for _ in range(warmup):
+        state, _ = compiled(state, shard_batch((images, labels), mesh))
+    jax.block_until_ready(state.params)
+    base_compiles = compile_count()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, _ = compiled(state, shard_batch((images, labels), mesh))
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    high_water = _hbm_high_water()
+    extra = {
+        "zero_opt_state": zero,
+        "grad_sync_buckets_mb": bucket_mb,
+        "opt_state_mb_per_chip": round(opt_bytes_per_chip / 1e6, 1),
+        "hbm_high_water_mb": round(high_water / 1e6, 1) if high_water else None,
+        "compiles_after_warmup": compile_count() - base_compiles,
+    }
+    if bucket_mb > 0:
+        plan = grad_bucket_plan(state.params, bucket_mb)
+        extra["buckets"] = len(plan)
+        extra["overlap_frac"] = bucket_overlap_frac(state.params, plan)
+    peak = peak_bf16_tflops(jax.devices()[0])
+    if peak and flops > 0:
+        extra["mfu_pct"] = round(100.0 * flops * steps / dt / 1e12 / peak, 1)
+    return dt, steps * batch, n_chips, extra
 
 
 def bench_cached(scan: bool, batch_per_chip: int, steps: int, warmup: int):
@@ -133,7 +222,7 @@ def bench_cached(scan: bool, batch_per_chip: int, steps: int, warmup: int):
         state, _ = compiled(state, dataset, labels_all, idx[:steps], valid[:steps])
         jax.block_until_ready(state.params)
         dt = time.perf_counter() - t0
-        return dt, steps * batch, n_chips
+        return dt, steps * batch, n_chips, {}
 
     step = make_cached_train_step(mesh, jnp.bfloat16)
     compiled = step.lower(state, dataset, labels_all, idx[0], valid[0]).compile()
@@ -145,16 +234,27 @@ def bench_cached(scan: bool, batch_per_chip: int, steps: int, warmup: int):
         state, _ = compiled(state, dataset, labels_all, idx[warmup + i], valid[warmup + i])
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
-    return dt, steps * batch, n_chips
+    return dt, steps * batch, n_chips, {}
 
 
 MODES = {
-    "stream-f32": lambda b, s, w: bench_streaming("float32", b, s, w),
-    "stream-bf16": lambda b, s, w: bench_streaming("bfloat16", b, s, w),
-    "stream-uint8": lambda b, s, w: bench_streaming("uint8", b, s, w),
-    "cached": lambda b, s, w: bench_cached(False, b, s, w),
-    "cached-scan": lambda b, s, w: bench_cached(True, b, s, w),
+    "stream-f32": lambda b, s, w, mb: bench_streaming("float32", b, s, w),
+    "stream-bf16": lambda b, s, w, mb: bench_streaming("bfloat16", b, s, w),
+    "stream-uint8": lambda b, s, w, mb: bench_streaming("uint8", b, s, w),
+    "cached": lambda b, s, w, mb: bench_cached(False, b, s, w),
+    "cached-scan": lambda b, s, w, mb: bench_cached(True, b, s, w),
+    # Training-half levers (spmd shard_map step; ROADMAP item 2):
+    "spmd-base": lambda b, s, w, mb: bench_spmd(False, 0.0, b, s, w),
+    "spmd-zero": lambda b, s, w, mb: bench_spmd(True, 0.0, b, s, w),
+    "spmd-buckets": lambda b, s, w, mb: bench_spmd(False, mb, b, s, w),
+    "spmd-zero-buckets": lambda b, s, w, mb: bench_spmd(True, mb, b, s, w),
 }
+
+LEVER_MODES = "spmd-base,spmd-zero,spmd-buckets,spmd-zero-buckets"
+# The documented default run stays the five INGEST modes — the lever cells
+# are the opt-in --levers A/B, not a silent doubling of a plain round's
+# backend time (and of the rows existing bench_modes artifacts expect).
+INGEST_MODES = "stream-f32,stream-bf16,stream-uint8,cached,cached-scan"
 
 
 def main() -> None:
@@ -162,14 +262,44 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--batch", type=int, default=2048, help="per chip")
-    ap.add_argument("--modes", default=",".join(MODES))
+    ap.add_argument("--modes", default=INGEST_MODES)
+    ap.add_argument(
+        "--levers", action="store_true",
+        help=f"the staged training-half A/B in one command: --modes {LEVER_MODES}",
+    )
+    ap.add_argument(
+        "--bucket-mb", type=float, default=25.0,
+        help="grad-sync bucket size (MiB) for the spmd-*buckets modes",
+    )
     ap.add_argument("--out", default="")
+    ap.add_argument(
+        "--partial-out", default="",
+        help="append each completed row to this *.partial.json as it lands "
+             "(cell-granular durability across a wedged backend; bench.py)",
+    )
+    ap.add_argument(
+        "--resume-from", default="",
+        help="skip cells this partial file already holds (reprinted as-is)",
+    )
     args = ap.parse_args()
+    if args.levers:
+        args.modes = LEVER_MODES
 
+    from bench import append_partial_row, load_partial  # repo root on sys.path above
+
+    done = load_partial(args.resume_from)
     records = []
     for mode in (m.strip() for m in args.modes.split(",") if m.strip()):
+        cell = f"{mode}-b{args.batch}"
+        if cell in done:
+            rec = done[cell]
+            records.append(rec)
+            print(json.dumps(rec), flush=True)
+            continue
         try:
-            dt, images, n_chips = MODES[mode](args.batch, args.steps, args.warmup)
+            dt, images, n_chips, extra = MODES[mode](
+                args.batch, args.steps, args.warmup, args.bucket_mb
+            )
             rec = {
                 "mode": mode,
                 "batch_per_chip": args.batch,
@@ -177,7 +307,10 @@ def main() -> None:
                 "vs_baseline": round(
                     images / dt / n_chips / REFERENCE_IMG_PER_SEC_PER_WORKER, 1
                 ),
+                **extra,
             }
+            if args.partial_out:
+                append_partial_row(args.partial_out, cell, rec)
         except Exception as e:
             rec = {"mode": mode, "error": f"{type(e).__name__}: {e}"[:300]}
         records.append(rec)

@@ -7,7 +7,16 @@ tracing (``--profile-dir``); this tool profiles ONE step in isolation so a
 kernel investigation doesn't need a training run:
 
     python tools/profile_step.py --model resnet18 --batch 2048 \
-        [--trace-dir /tmp/trace] [--accum 1] [--remat none|full|blocks]
+        [--trace-dir /tmp/trace] [--accum 1] [--remat none|full|blocks] \
+        [--spmd] [--zero-opt-state] [--grad-sync-buckets MB]
+
+``--spmd`` profiles the shard_map step instead of the auto-jit step, and
+composes with the two training-half levers (ISSUE 6 / ROADMAP item 2):
+``--zero-opt-state`` (ZeRO moment sharding — the summary then reports the
+actually-resident optimizer MB/chip) and ``--grad-sync-buckets`` (bucketed
+grad sync — the summary reports the plan's bucket count and static
+overlap_frac, and with --trace-dir the XLA trace shows whether the bucket
+collectives really hide under the backward).
 
 Prints a JSON summary (step ms, img/s/chip, per-chip TFLOP/s, MFU, HBM
 argument/output/temp sizes from XLA's memory analysis) and, with
@@ -40,21 +49,61 @@ def main() -> None:
     ap.add_argument("--accum", type=int, default=1)
     ap.add_argument("--remat", default="none", choices=["none", "full", "blocks"])
     ap.add_argument("--trace-dir", default="", help="write a jax.profiler trace here")
+    ap.add_argument("--spmd", action="store_true",
+                    help="profile the spmd shard_map step (explicit collectives)")
+    ap.add_argument("--zero-opt-state", action="store_true",
+                    help="spmd: ZeRO-shard the optimizer state over the data axis")
+    ap.add_argument("--grad-sync-buckets", type=float, default=0.0, metavar="MB",
+                    help="spmd: bucketed grad-sync collectives (MiB per bucket)")
     args = ap.parse_args()
 
     from mpi_pytorch_tpu.models.registry import supports_remat_blocks
-    from mpi_pytorch_tpu.train.step import make_train_step
+    from mpi_pytorch_tpu.train.step import (
+        bucket_overlap_frac,
+        grad_bucket_plan,
+        make_spmd_train_step,
+        make_train_step,
+    )
     from mpi_pytorch_tpu.utils.hardware import peak_bf16_tflops, step_flops
 
     if args.remat == "blocks" and not supports_remat_blocks(args.model):
         ap.error(f"--remat blocks not implemented for {args.model}")
+    if (args.zero_opt_state or args.grad_sync_buckets) and not args.spmd:
+        ap.error("--zero-opt-state / --grad-sync-buckets are spmd-step levers; add --spmd")
+    if args.spmd and args.accum > 1:
+        ap.error("--accum applies to the auto-jit step only")
 
     mesh, state, device_batch, n_chips, batch = build_state_and_batch(
         args.model, args.batch, args.image, remat_blocks=(args.remat == "blocks")
     )
-    step = make_train_step(
-        jnp.bfloat16, remat=(args.remat == "full"), accum_steps=args.accum, mesh=mesh
-    )
+    lever_info = {}
+    if args.spmd:
+        if args.zero_opt_state:
+            from mpi_pytorch_tpu.train.state import zero_shard_opt_state
+
+            state = state.replace(
+                opt_state=zero_shard_opt_state(state.opt_state, mesh)
+            )
+            lever_info["opt_state_mb_per_chip"] = round(
+                sum(
+                    leaf.addressable_shards[0].data.nbytes
+                    for leaf in jax.tree_util.tree_leaves(state.opt_state)
+                    if hasattr(leaf, "addressable_shards") and leaf.ndim > 0
+                ) / 1e6, 1,
+            )
+        if args.grad_sync_buckets > 0:
+            plan = grad_bucket_plan(state.params, args.grad_sync_buckets)
+            lever_info["buckets"] = len(plan)
+            lever_info["overlap_frac"] = bucket_overlap_frac(state.params, plan)
+        step = make_spmd_train_step(
+            mesh, jnp.bfloat16, remat=(args.remat == "full"),
+            zero_opt_state=args.zero_opt_state,
+            grad_bucket_mb=args.grad_sync_buckets,
+        )
+    else:
+        step = make_train_step(
+            jnp.bfloat16, remat=(args.remat == "full"), accum_steps=args.accum, mesh=mesh
+        )
     compiled = step.lower(state, device_batch).compile()
     mem = compiled.memory_analysis()
     flops = step_flops(compiled)
@@ -70,6 +119,8 @@ def main() -> None:
         "batch_per_chip": args.batch,
         "accum_steps": args.accum,
         "remat": args.remat,
+        "mode": "spmd" if args.spmd else "auto",
+        **lever_info,
         "chips": n_chips,
         "step_ms": round(dt / args.steps * 1e3, 2),
         "images_per_sec_per_chip": round(args.steps * batch / dt / n_chips, 1),

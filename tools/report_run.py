@@ -133,6 +133,16 @@ def summarize(records: list[dict]) -> dict:
                 # Host-visible time split: where a slow run's wall time
                 # actually went — the actionable number (arXiv:1810.11112).
                 stat["wait_fraction_pct"] = round(100.0 * sum(waits) / total, 1)
+        # Schema-v2 grad-sync fields (spmd --grad-sync-buckets runs); older
+        # records simply don't carry them and the section is omitted.
+        syncs = _finite([s.get("sync_ms") for s in steps])
+        if syncs:
+            stat["sync_ms"] = {
+                "mean": round(_mean(syncs), 3), "max": round(max(syncs), 3),
+            }
+        overlaps = _finite([s.get("overlap_frac") for s in steps])
+        if overlaps:
+            stat["overlap_frac"] = round(_mean(overlaps), 4)
         if norms:
             stat["grad_norm"] = {
                 "first": round(norms[0], 4), "last": round(norms[-1], 4),
@@ -252,8 +262,16 @@ def render(path: str, records: list[dict], summary: dict) -> str:
         if "step_ms" in ss:
             phase_rows.append(["device-step", ss["step_ms"]["mean"],
                                ss["step_ms"]["max"]])
+        if "sync_ms" in ss:
+            phase_rows.append(["grad-sync", ss["sync_ms"]["mean"],
+                               ss["sync_ms"]["max"]])
         if phase_rows:
             out.append(table(["phase", "mean_ms", "max_ms"], phase_rows))
+        if "overlap_frac" in ss:
+            out.append(
+                f"  grad-sync overlap-eligible: {100.0 * ss['overlap_frac']:.1f}%"
+                " of sync bytes (static bucket-plan estimate)"
+            )
         if "wait_fraction_pct" in ss:
             out.append(
                 f"  ingest wait = {ss['wait_fraction_pct']}% of host-visible "
