@@ -33,9 +33,31 @@ from mpi_pytorch_tpu.utils.logging import process_index, run_logger
 
 _CKPT_RE = re.compile(r"ckpt_(\d+)\.msgpack$")
 
+# Version of the msgpack payload layout ``_payload_from`` writes — stamped
+# into the topology-manifest sidecar so a future payload change can be
+# detected at load time instead of failing deep inside deserialization.
+PAYLOAD_SCHEMA = 1
+
+# Sidecar files that ride a checkpoint and share its lifecycle (written
+# after the atomic rename, removed by retention alongside the payload).
+_SIDECARS = (".dirty", ".manifest.json")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """The checkpoint file exists but cannot be restored (truncated write,
+    bit rot, or a payload that no longer matches the expected schema).
+    ``train/elastic.py`` catches this and falls back to the previous
+    checkpoint instead of crashing the resume."""
+
 
 def _ckpt_path(ckpt_dir: str, epoch: int) -> str:
     return os.path.join(ckpt_dir, f"ckpt_{epoch:05d}.msgpack")
+
+
+def checkpoint_epoch(path: str) -> int | None:
+    """The epoch a checkpoint file is filed under, from its name."""
+    m = _CKPT_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
 
 
 def _state_arrays(state: Any) -> dict:
@@ -82,11 +104,21 @@ def _payload(state: Any, epoch: int = 0, loss: float = 0.0) -> dict:
 
 
 def _write_atomic(
-    ckpt_dir: str, path: str, payload: dict, keep: int, dirty: bool = False
+    ckpt_dir: str, path: str, payload: dict, keep: int, dirty: bool = False,
+    manifest: dict | None = None,
 ) -> None:
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(serialization.to_bytes(payload))
+    # Topology manifest (ISSUE 7): the writer's world shape, so an elastic
+    # restore knows what layout the payload was gathered FROM. Sidecar, so
+    # the msgpack schema stays stable across checkpoint generations;
+    # atomically written BEFORE the payload rename so a loadable payload
+    # always has its manifest (a crash in between leaves an orphan sidecar
+    # next to no payload — harmless noise, overwritten by the next save of
+    # that epoch — whereas the reverse order would leave a manifest-less
+    # checkpoint that restores as 'legacy' with its topology unrecorded).
+    write_manifest(path, manifest)
     os.replace(tmp, path)  # atomic on POSIX
     # Dirty = the state carries a partial epoch's updates beyond the epoch it
     # is filed under (mid-epoch preemption). A sidecar rather than a payload
@@ -102,6 +134,41 @@ def _write_atomic(
     _cleanup(ckpt_dir, keep)
 
 
+def write_manifest(ckpt_path: str, manifest: dict | None) -> None:
+    """Atomically (re)write the topology-manifest sidecar of ``ckpt_path``
+    (None clears it — an overwrite by a manifest-less writer must not leave
+    a stale topology lying next to a new payload)."""
+    import json
+
+    sidecar = ckpt_path + ".manifest.json"
+    if manifest is None:
+        if os.path.exists(sidecar):
+            os.remove(sidecar)
+        return
+    tmp = sidecar + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    os.replace(tmp, sidecar)
+
+
+def read_manifest(ckpt_path: str) -> dict | None:
+    """The topology manifest saved next to ``ckpt_path``, or None for a
+    legacy/manifest-less checkpoint (including an unreadable sidecar — a
+    corrupt manifest downgrades the restore to legacy behavior rather than
+    failing a resume the payload itself could serve)."""
+    import json
+
+    sidecar = ckpt_path + ".manifest.json"
+    if not os.path.exists(sidecar):
+        return None
+    try:
+        with open(sidecar) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        run_logger().warning("unreadable checkpoint manifest %s (treating as legacy)", sidecar)
+        return None
+
+
 def save_checkpoint(
     ckpt_dir: str,
     *,
@@ -110,6 +177,7 @@ def save_checkpoint(
     loss: float,
     keep: int = 3,
     dirty: bool = False,
+    manifest: dict | None = None,
 ) -> str | None:
     """Synchronous save (process 0 only); returns the path written. The
     trainer uses ``AsyncCheckpointer``; this stays as the blocking variant
@@ -118,7 +186,7 @@ def save_checkpoint(
         return None
     os.makedirs(ckpt_dir, exist_ok=True)
     path = _ckpt_path(ckpt_dir, epoch)
-    _write_atomic(ckpt_dir, path, _payload(state, epoch, loss), keep, dirty)
+    _write_atomic(ckpt_dir, path, _payload(state, epoch, loss), keep, dirty, manifest)
     return path
 
 
@@ -137,9 +205,10 @@ def _cleanup(ckpt_dir: str, keep: int) -> None:
     for _, name in ckpts[:-keep] if keep > 0 else []:
         if name != pinned:
             os.remove(os.path.join(ckpt_dir, name))
-            marker = os.path.join(ckpt_dir, name + ".dirty")
-            if os.path.exists(marker):
-                os.remove(marker)
+            for suffix in _SIDECARS:
+                marker = os.path.join(ckpt_dir, name + suffix)
+                if os.path.exists(marker):
+                    os.remove(marker)
 
 
 def best_marker(ckpt_dir: str) -> dict | None:
@@ -172,14 +241,21 @@ def write_best_marker(ckpt_dir: str, *, epoch: int, accuracy: float, ckpt_path: 
 
 
 def latest_checkpoint(ckpt_dir: str) -> str | None:
+    paths = checkpoint_paths(ckpt_dir)
+    return paths[-1] if paths else None
+
+
+def checkpoint_paths(ckpt_dir: str) -> list[str]:
+    """Every checkpoint in ``ckpt_dir``, oldest→newest — the fallback order
+    (reversed) an elastic restore walks when the newest file is corrupt."""
     if not os.path.isdir(ckpt_dir):
-        return None
+        return []
     ckpts = sorted(
         (int(m.group(1)), name)
         for name in os.listdir(ckpt_dir)
         if (m := _CKPT_RE.search(name))
     )
-    return os.path.join(ckpt_dir, ckpts[-1][1]) if ckpts else None
+    return [os.path.join(ckpt_dir, name) for _, name in ckpts]
 
 
 @functools.lru_cache(maxsize=None)
@@ -366,6 +442,7 @@ class AsyncCheckpointer:
         on_durable=None,
         dirty: bool = False,
         moments_bf16: bool = False,
+        manifest: dict | None = None,
     ) -> str | None:
         """Snapshot now, write in the background; returns the path that will
         exist once the write completes (None on processes > 0).
@@ -405,7 +482,8 @@ class AsyncCheckpointer:
         def _worker() -> None:
             try:
                 _write_atomic(
-                    ckpt_dir, path, _payload_from(snapshot, epoch, loss), keep, dirty
+                    ckpt_dir, path, _payload_from(snapshot, epoch, loss), keep, dirty,
+                    manifest,
                 )
                 if on_durable is not None:
                     # Runs strictly AFTER the atomic rename: anything the
@@ -448,17 +526,32 @@ def load_checkpoint(path: str, state: Any) -> tuple[Any, int, float]:
         )
     with open(path, "rb") as f:
         data = f.read()
-    restored = serialization.from_bytes(_payload(state), data)
-    # A moments_bf16 checkpoint stores the big moment tensors in bf16; the
-    # optimizer expects its own dtype (f32) back. Cast against the live
-    # state's opt_state as the dtype template (no-op for exact saves).
-    opt_state = jax.tree_util.tree_map(
-        lambda tmpl, got: np.asarray(got).astype(tmpl.dtype)
-        if hasattr(tmpl, "dtype") and got.dtype != tmpl.dtype
-        else got,
-        _state_arrays(state)["opt_state"],
-        restored["opt_state"],
-    )
+    try:
+        restored = serialization.from_bytes(_payload(state), data)
+        # A moments_bf16 checkpoint stores the big moment tensors in bf16; the
+        # optimizer expects its own dtype (f32) back. Cast against the live
+        # state's opt_state as the dtype template (no-op for exact saves).
+        opt_state = jax.tree_util.tree_map(
+            lambda tmpl, got: np.asarray(got).astype(tmpl.dtype)
+            if hasattr(tmpl, "dtype") and got.dtype != tmpl.dtype
+            else got,
+            _state_arrays(state)["opt_state"],
+            restored["opt_state"],
+        )
+    except OSError:
+        raise  # a vanished file is a caller error, not payload corruption
+    except MemoryError:
+        # Host memory pressure, not on-disk damage: falling back to an
+        # OLDER checkpoint would silently discard good progress while the
+        # next attempt would fail the same way — surface it.
+        raise
+    except Exception as e:
+        # Truncated msgpack, garbage bytes, missing/mismatched payload keys:
+        # typed so the elastic restore (train/elastic.py) can fall back to
+        # the previous checkpoint instead of crashing the resume.
+        raise CheckpointCorruptError(
+            f"checkpoint {path} failed to restore ({type(e).__name__}: {e})"
+        ) from e
     new_state = state.replace(
         step=jax.numpy.asarray(restored["step"]),
         params=restored["params"],

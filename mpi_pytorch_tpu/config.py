@@ -308,6 +308,25 @@ class Config:
     track_best: bool = False
     # Evaluation: load the best.json checkpoint instead of the latest.
     use_best: bool = False
+    # --- elastic resume / preemption (ISSUE 7, ROADMAP item 4) ---
+    # Bounded retry+backoff around the RESUME side's backend init and state
+    # placement (train/elastic.with_retries): a transiently wedged backend
+    # (bench history r02/r05) costs retries, not the run. Backoff doubles
+    # per attempt from resume_backoff_s; retries bounds the attempts.
+    resume_retries: int = 3
+    resume_backoff_s: float = 0.5
+    # Preemption sentinel file: when this path exists, the watchdog stops
+    # the run at the next safe boundary, saves, and exits 0 for auto-resume
+    # (the cluster-scheduler preemption-notice pattern). "" reads the
+    # MPT_PREEMPT_FILE env gate instead.
+    preempt_file: str = ""
+    # Preempt (save + clean exit) after this many CONSECUTIVE heartbeat
+    # beats that flagged a straggler / steps with a non-finite grad norm —
+    # the self-healing escalation of the obs signals. 0 disables (default:
+    # the NaN-loss sentinel still aborts hard; preempt-on-streak is a
+    # fleet policy, opted into per run).
+    preempt_straggler_beats: int = 0
+    preempt_nonfinite_steps: int = 0
     # Evaluation: also write per-image predictions as CSV
     # (file_name, predicted_label, predicted_category_id) — the Herbarium
     # task's actual deliverable (a submission file), which the reference's
@@ -502,6 +521,35 @@ class Config:
         if self.serve_queue_depth < 1:
             raise ValueError(
                 f"serve_queue_depth must be >= 1, got {self.serve_queue_depth}"
+            )
+        if self.resume_retries < 0:
+            raise ValueError(
+                f"resume_retries must be >= 0 (0 = one attempt, no retry), "
+                f"got {self.resume_retries}"
+            )
+        if self.resume_backoff_s < 0:
+            raise ValueError(
+                f"resume_backoff_s must be >= 0, got {self.resume_backoff_s}"
+            )
+        if self.preempt_straggler_beats < 0:
+            raise ValueError(
+                f"preempt_straggler_beats must be >= 0 (0 disables), "
+                f"got {self.preempt_straggler_beats}"
+            )
+        if self.preempt_nonfinite_steps < 0:
+            raise ValueError(
+                f"preempt_nonfinite_steps must be >= 0 (0 disables), "
+                f"got {self.preempt_nonfinite_steps}"
+            )
+        if self.preempt_straggler_beats > 0 and self.heartbeat_every_steps <= 0:
+            raise ValueError(
+                "preempt_straggler_beats counts heartbeat beats; it needs "
+                "--heartbeat-every-steps > 0 to ever observe one"
+            )
+        if self.preempt_nonfinite_steps > 0 and not self.step_metrics:
+            raise ValueError(
+                "preempt_nonfinite_steps counts per-step grad norms; it "
+                "needs --step-metrics true to ever observe one"
             )
         if self.heartbeat_every_steps < 0:
             raise ValueError(

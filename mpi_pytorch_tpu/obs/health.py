@@ -106,6 +106,12 @@ class StepHealth:
         # so the trainer leaves it unset — records carry it only from
         # tooling that measures it by A/B).
         self.overlap_frac: float | None = None
+        # Consecutive steps whose GRADIENT norm was non-finite while the
+        # loss stayed finite — the slow-corruption signal the preemption
+        # watchdog (train/elastic.py) can act on before the loss itself
+        # goes NaN and the sentinel aborts. Only advances when step
+        # telemetry is on (the norm is a host float there anyway).
+        self.nonfinite_grad_streak = 0
         if self.enabled:
             _ensure_compile_listener()
             self._baseline = _compile_count
@@ -154,6 +160,10 @@ class StepHealth:
         if sync_ms is not None:
             record["sync_ms"] = round(sync_ms, 3)
         self.metrics.write(record)
+        if grad_norm is not None:
+            self.nonfinite_grad_streak = (
+                0 if math.isfinite(grad_norm) else self.nonfinite_grad_streak + 1
+            )
         self._sentinel(epoch, step, loss, grad_norm)
 
     def on_scan_epoch(self, epoch: int, m: Mapping[str, Any]) -> None:

@@ -59,6 +59,12 @@ class Heartbeat:
             gather = host_allgather
         self._gather = gather
         self._window: list[float] = []
+        # Consecutive beats that flagged at least one straggler — the
+        # persistent-slow-host signal the preemption watchdog
+        # (train/elastic.py) can preempt on. Deliberately NOT reset per
+        # epoch: a real straggler outlives epoch boundaries, and the
+        # beats that feed it already never span one.
+        self.straggler_streak = 0
 
     def start_epoch(self) -> None:
         """Drop samples left over when an epoch's step count is not a
@@ -83,6 +89,7 @@ class Heartbeat:
         per_host = np.asarray(self._gather(np.asarray([local_ms], np.float32)))
         per_host_ms = [round(float(v), 3) for v in per_host[:, 0]]
         stragglers = flag_stragglers(per_host_ms, self.threshold)
+        self.straggler_streak = self.straggler_streak + 1 if stragglers else 0
         record = {
             "kind": "heartbeat",
             "epoch": epoch,

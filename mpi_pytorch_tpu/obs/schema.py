@@ -21,11 +21,19 @@ Record kinds (every record also carries ``ts``, the epoch-seconds stamp
 | anomaly   | reason, epoch                                       | step, loss, grad_norm |
 | serve     | bucket, requests, queue_depth, fill_ratio, queue_wait_ms, device_ms | preprocess_ms, total_ms |
 | serve_bench | mode, buckets, max_wait_ms, requests, p50_ms, p95_ms, p99_ms, images_per_sec | model, offered_rps, rejected, mean_fill_ratio, compiles_after_warmup, chips |
+| resume    | epoch, to_devices                                   | from_devices, from_mesh, to_mesh, path, zero_shards_from, zero_shards_to, corrupt_skipped, strategy |
+| fault     | reason                                              | epoch, step, detail, streak |
 
 ``serve`` is the per-flush record the online inference server writes
 (serve/server.py: one coalesced batch dispatched to a bucket executable);
 ``serve_bench`` is a latency/throughput summary row from the load driver
 (tools/bench_serve.py — the committed ``docs/serve_bench.json`` rows).
+``resume`` is written once per elastic restore (train/elastic.py): the
+checkpoint's topology-manifest shape vs the mesh actually resumed onto;
+``fault`` is written when a preemption/fault signal is observed (the
+watchdog's SIGTERM / sentinel-file / streak triggers, and the
+fault-injection gates of ``tools/inject_faults.py`` announcing themselves
+before they strike).
 
 Optional fields may be ``null`` (unknown on this backend — e.g. HBM bytes
 on CPU, per-step host timing in scan-epoch mode); required fields may not.
@@ -47,7 +55,11 @@ from typing import Any, Mapping
 #      ``overlap_frac`` (the static bucket-plan overlap estimate the
 #      spmd --grad-sync-buckets trainer stamps; train/step.py
 #      bucket_overlap_frac) — ISSUE 6 / ROADMAP item 2.
-SCHEMA_VERSION = 2
+#   3: the elastic-training kinds ``resume`` (topology of an elastic
+#      restore) and ``fault`` (an observed preemption/fault signal), plus
+#      the ``serve`` record's optional ``preprocess_failures`` /
+#      ``worker_respawns`` counts — ISSUE 7 / ROADMAP item 4.
+SCHEMA_VERSION = 3
 
 _NUM = (int, float)
 _INT = (int,)
@@ -75,6 +87,8 @@ REQUIRED: dict[str, dict[str, tuple]] = {
         "requests": _INT, "p50_ms": _NUM, "p95_ms": _NUM, "p99_ms": _NUM,
         "images_per_sec": _NUM,
     },
+    "resume": {"epoch": _INT, "to_devices": _INT},
+    "fault": {"reason": (str,)},
 }
 
 OPTIONAL: dict[str, dict[str, tuple]] = {
@@ -90,11 +104,23 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
     },
     "heartbeat": {"images_per_sec": _NUM},
     "anomaly": {"step": _INT, "loss": _NUM, "grad_norm": _NUM},
-    "serve": {"preprocess_ms": _NUM, "total_ms": _NUM},
+    "serve": {
+        "preprocess_ms": _NUM, "total_ms": _NUM,
+        # v3: requests of this flush dropped at preprocess (typed
+        # PreprocessError to their callers) and cumulative worker-pool
+        # respawns — absent on clean flushes.
+        "preprocess_failures": _INT, "worker_respawns": _INT,
+    },
     "serve_bench": {
         "model": (str,), "offered_rps": _NUM, "rejected": _INT,
         "mean_fill_ratio": _NUM, "compiles_after_warmup": _INT, "chips": _INT,
     },
+    "resume": {
+        "from_devices": _INT, "from_mesh": (str,), "to_mesh": (str,),
+        "path": (str,), "zero_shards_from": _INT, "zero_shards_to": _INT,
+        "corrupt_skipped": _INT, "strategy": (str,),
+    },
+    "fault": {"epoch": _INT, "step": _INT, "detail": (str,), "streak": _INT},
 }
 
 

@@ -17,6 +17,7 @@ Load-drive it with ``tools/bench_serve.py``; tune it with
 from mpi_pytorch_tpu.serve.batcher import (
     DynamicBatcher,
     PendingRequest,
+    PreprocessError,
     QueueFullError,
     ServeError,
     ServerClosedError,
@@ -31,6 +32,7 @@ __all__ = [
     "DynamicBatcher",
     "InferenceServer",
     "PendingRequest",
+    "PreprocessError",
     "QueueFullError",
     "ServeError",
     "ServerClosedError",

@@ -48,6 +48,15 @@ class ServerClosedError(ServeError):
     """The server is closed (or closing) and accepts no new requests."""
 
 
+class PreprocessError(ServeError):
+    """A preprocess worker crashed (or raised an unexpected non-ServeError)
+    while preparing THIS request — the typed per-request failure the caller
+    receives instead of a silent loss or a misleading 'server is shut down'.
+    The batch goes on without the request, the worker pool is respawned if
+    it died, and the failure is counted on the flush's ``kind="serve"``
+    record (``preprocess_failures``)."""
+
+
 def parse_buckets(buckets: Sequence[int]) -> tuple[int, ...]:
     """Sorted, deduped, validated bucket sizes."""
     out = tuple(sorted({int(b) for b in buckets}))

@@ -49,6 +49,7 @@ import numpy as np
 from mpi_pytorch_tpu.serve.batcher import (
     DynamicBatcher,
     PendingRequest,
+    PreprocessError,
     QueueFullError,
     ServeError,
     ServerClosedError,
@@ -75,6 +76,7 @@ class _InFlight:
     preprocess_ms: float
     t_dispatch: float
     t_oldest: float
+    prep_failures: int = 0  # requests of this flush dropped at preprocess
 
 
 class InferenceServer:
@@ -156,7 +158,7 @@ class InferenceServer:
         self._lock = threading.Lock()
         self._stats = {
             "served": 0, "failed": 0, "rejected": 0, "batches": 0,
-            "padded_rows": 0,
+            "padded_rows": 0, "preprocess_failures": 0, "worker_respawns": 0,
             "by_bucket": {b: 0 for b in self.buckets},
         }
         self._batch_thread = threading.Thread(
@@ -218,10 +220,7 @@ class InferenceServer:
         if self._batcher.closed:
             raise ServerClosedError("server is shut down")
         fut: Future = Future()
-        try:
-            payload = self._pool.submit(self._preprocess, image)
-        except RuntimeError:  # pool already shut down (close raced us)
-            raise ServerClosedError("server is shut down") from None
+        payload = self._submit_preprocess(image)
         try:
             self._batcher.submit(PendingRequest(payload=payload, future=fut))
         except QueueFullError:
@@ -236,12 +235,66 @@ class InferenceServer:
         futs = [self.submit(im) for im in images]
         return np.stack([f.result(timeout=timeout) for f in futs])
 
+    def _submit_preprocess(self, image):
+        """Hand ``image`` to the preprocess pool, distinguishing a DEAD pool
+        from a CLOSED server. A ThreadPoolExecutor can refuse work while the
+        server is live (a crashed initializer marks it broken, an errant
+        shutdown kills it); before this path existed such requests died with
+        a misleading 'server is shut down' — a silent in-flight loss from
+        the caller's perspective. Now the pool is respawned once (counted in
+        ``worker_respawns``) and the request retried on the fresh pool."""
+        pool = self._pool
+        try:
+            return pool.submit(self._preprocess, image)
+        except RuntimeError:
+            if self._batcher.closed:  # genuine close() raced us
+                raise ServerClosedError("server is shut down") from None
+            pool = self._respawn_pool(pool)
+            try:
+                return pool.submit(self._preprocess, image)
+            except RuntimeError as e:  # fresh pool refused too: give up typed
+                raise PreprocessError(
+                    f"preprocess worker pool unavailable after respawn: {e}"
+                ) from e
+
+    def _respawn_pool(self, dead) -> ThreadPoolExecutor:
+        """Replace the ``dead`` preprocess pool with a fresh one and return
+        the current pool. Idempotent per death: concurrent submitters race
+        here, and only the one that still observes ``dead`` installed swaps
+        (and counts) — the losers reuse the winner's fresh pool instead of
+        shutting it down from under them. In-flight futures of the dead
+        pool stay valid (their work items either ran or carry an exception
+        the batch loop converts per request)."""
+        with self._lock:
+            replaced = self._pool is dead
+            if replaced:
+                self._stats["worker_respawns"] += 1
+                self._pool = ThreadPoolExecutor(
+                    max_workers=max(1, self.cfg.loader_workers),
+                    thread_name_prefix="serve-prep",
+                )
+            pool = self._pool
+            respawns = self._stats["worker_respawns"]
+        if replaced:
+            dead.shutdown(wait=False)
+            self._logger.warning(
+                "serve: preprocess worker pool died — respawned (respawns "
+                "so far: %d)", respawns,
+            )
+        return pool
+
     def _preprocess(self, image) -> np.ndarray:
         """Request payload → one model-ready (H, W, 3) row, per the loader
         contract (``data/pipeline.py``): f32/bf16 rows are normalized on
         the host, uint8 rows ship raw pixels (device normalize)."""
         from mpi_pytorch_tpu.data.pipeline import decode_image, normalize_image
+        from mpi_pytorch_tpu.utils.env import fault_countdown
 
+        if fault_countdown("MPT_FAULT_PREPROCESS_N"):
+            # The injected worker crash (tools/inject_faults.py): a
+            # non-ServeError from inside the pool, which the batch loop
+            # must convert to a typed PreprocessError for THIS caller only.
+            raise RuntimeError("injected fault: preprocess worker crash")
         size = self.cfg.image_size
         raw = self._exe.image_dtype == np.uint8
         if isinstance(image, (str, os.PathLike)):
@@ -299,15 +352,39 @@ class InferenceServer:
                 # Resolve the pool's preprocess futures (usually already
                 # done — they started at submit time). A bad request fails
                 # its own future only; the batch goes on without it.
-                rows, good = [], []
+                rows, good, prep_failures = [], [], 0
                 with self._tracer.span("serve/preprocess", args={"n": len(flush)}):
                     for req in flush:
                         try:
                             rows.append(req.payload.result())
                             good.append(req)
                         except BaseException as e:  # noqa: BLE001
+                            # Typed error to THIS caller only; a ServeError
+                            # is already a precise request error, anything
+                            # else is a worker crash and says so.
+                            if not isinstance(e, ServeError):
+                                e = PreprocessError(
+                                    f"preprocess worker crashed on this "
+                                    f"request ({type(e).__name__}: {e})"
+                                )
+                            prep_failures += 1
                             self._fail([req], e)
+                if prep_failures:
+                    with self._lock:
+                        self._stats["preprocess_failures"] += prep_failures
                 if not good:
+                    # Nothing to dispatch, so no kind="serve" record will
+                    # carry these failures — a whole-flush casualty is the
+                    # WORST outage and must not be the one that vanishes
+                    # from the stream: record it as a fault signal.
+                    self._metrics.write(
+                        {
+                            "kind": "fault",
+                            "reason": "preprocess_all_failed",
+                            "detail": f"{prep_failures} request(s), no "
+                            "surviving batch",
+                        }
+                    )
                     continue
                 t_prep = time.monotonic()
                 bucket = pick_bucket(len(good), self.buckets)
@@ -328,6 +405,7 @@ class InferenceServer:
                         preprocess_ms=1e3 * (t_prep - t_flush),
                         t_dispatch=time.monotonic(),
                         t_oldest=min(r.t_submit for r in good),
+                        prep_failures=prep_failures,
                     )
                 )
             except BaseException as e:  # noqa: BLE001 — keep serving
@@ -360,19 +438,24 @@ class InferenceServer:
                     self._stats["batches"] += 1
                     self._stats["by_bucket"][item.bucket] += 1
                     self._stats["padded_rows"] += item.bucket - n
-                self._metrics.write(
-                    {
-                        "kind": "serve",
-                        "bucket": item.bucket,
-                        "requests": n,
-                        "queue_depth": self._batcher.qsize(),
-                        "fill_ratio": round(n / item.bucket, 4),
-                        "queue_wait_ms": round(item.queue_wait_ms, 3),
-                        "preprocess_ms": round(item.preprocess_ms, 3),
-                        "device_ms": round(1e3 * (t_done - item.t_dispatch), 3),
-                        "total_ms": round(1e3 * (t_done - item.t_oldest), 3),
-                    }
-                )
+                record = {
+                    "kind": "serve",
+                    "bucket": item.bucket,
+                    "requests": n,
+                    "queue_depth": self._batcher.qsize(),
+                    "fill_ratio": round(n / item.bucket, 4),
+                    "queue_wait_ms": round(item.queue_wait_ms, 3),
+                    "preprocess_ms": round(item.preprocess_ms, 3),
+                    "device_ms": round(1e3 * (t_done - item.t_dispatch), 3),
+                    "total_ms": round(1e3 * (t_done - item.t_oldest), 3),
+                }
+                if item.prep_failures:
+                    # Schema-v3 fields only on flushes that saw a failure —
+                    # clean flushes stay byte-identical to v2 records.
+                    record["preprocess_failures"] = item.prep_failures
+                    with self._lock:
+                        record["worker_respawns"] = self._stats["worker_respawns"]
+                self._metrics.write(record)
             except BaseException as e:  # noqa: BLE001 — keep serving
                 self._logger.error("serve completion loop error: %s", e)
                 self._fail(item.requests, e)

@@ -100,14 +100,55 @@ def _replicated_gather_fn(repl):
     return jax.jit(lambda x: x, out_shardings=repl)
 
 
-def zero_shard_opt_state(opt_state: Any, mesh) -> Any:
+# Host leaves at or above this size take the per-row redistribution path in
+# ``zero_shard_opt_state``: each shard's [chunk] slice is device_put onto
+# its own device directly, so the peak transient HBM of placing the leaf is
+# ONE chunk per device — never the full unsharded leaf the jitted-reshape
+# path materializes. 4 MiB mirrors the checkpoint gather's big-leaf bound
+# (checkpoint._BIG_LEAF_BYTES): the same leaves that gather alone on save
+# redistribute chunked on restore.
+_BOUNDED_LEAF_BYTES = 4 * 1024 * 1024
+
+
+def _row_redistribute(host_leaf, mesh, row_sharded, n_shards: int, chunk: int):
+    """Chunked device redistribution of one HOST leaf into the
+    ``zero_shard_spec`` ``[P, chunk]`` layout: pad on host, then place each
+    data-axis row directly on the devices that own it
+    (``make_array_from_single_device_arrays``) — no device ever holds more
+    than its own 1/P slice, and each process places only its addressable
+    rows (multi-host safe). This is the bounded-HBM half of the elastic
+    reshard-on-load dataflow (arXiv 2112.01075's portable redistribution,
+    host-staged: the source here is always checkpoint bytes, so the host
+    hop is already paid)."""
+    import numpy as np
+
+    flat = np.asarray(host_leaf).reshape(-1)
+    padded = np.zeros((n_shards, chunk), flat.dtype)
+    padded.reshape(-1)[: flat.size] = flat
+    shape = padded.shape
+    arrays = [
+        jax.device_put(padded[idx], dev)
+        for dev, idx in row_sharded.addressable_devices_indices_map(shape).items()
+    ]
+    return jax.make_array_from_single_device_arrays(shape, row_sharded, arrays)
+
+
+def zero_shard_opt_state(opt_state: Any, mesh, bounded_bytes: int | None = None) -> Any:
     """Partition an optimizer state over ``mesh``'s data axis: array leaves
     become ``[P, chunk]`` jax Arrays sharded on dim 0 (each device holds one
     ``[1, chunk]`` row — 1/P of the leaf), scalars stay replicated. The
     placement runs through a jitted reshape with explicit out_shardings so
     it is multi-host safe (plain device_put of process-local numpy cannot
     target a cross-host sharding); leaves sharing a shape share one
-    compiled reshape (mu/nu pairs, BN scale/bias — ``_zero_reshape_fn``)."""
+    compiled reshape (mu/nu pairs, BN scale/bias — ``_zero_reshape_fn``).
+
+    HOST leaves above ``bounded_bytes`` (an elastic restore's gathered-on-
+    save checkpoint tree; default ``_BOUNDED_LEAF_BYTES``) bypass the jitted
+    reshape for ``_row_redistribute``: the jitted path transiently
+    materializes the full unsharded leaf on device before the sharded
+    output exists, which at 2×params scale is exactly the HBM spike the
+    sharding is meant to avoid — the per-row path bounds the transient to
+    one chunk per device."""
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -115,6 +156,7 @@ def zero_shard_opt_state(opt_state: Any, mesh) -> Any:
     n_shards = mesh.shape[data_axis]
     rep = NamedSharding(mesh, P())
     row_sharded = NamedSharding(mesh, P(data_axis))
+    cap = _BOUNDED_LEAF_BYTES if bounded_bytes is None else bounded_bytes
 
     def shard(leaf):
         if not hasattr(leaf, "ndim"):
@@ -122,6 +164,8 @@ def zero_shard_opt_state(opt_state: Any, mesh) -> Any:
         if leaf.ndim == 0:
             return jax.device_put(leaf, rep)
         chunk, padded = zero_shard_spec(np.shape(leaf), n_shards)
+        if not isinstance(leaf, jax.Array) and leaf.size * leaf.dtype.itemsize > cap:
+            return _row_redistribute(leaf, mesh, row_sharded, n_shards, chunk)
         return _zero_reshape_fn(n_shards, chunk, padded, row_sharded)(leaf)
 
     return jax.tree_util.tree_map(shard, opt_state)

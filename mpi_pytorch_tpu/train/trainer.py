@@ -34,6 +34,7 @@ from mpi_pytorch_tpu.data import DataLoader, load_manifests
 from mpi_pytorch_tpu.models import create_model_bundle
 from mpi_pytorch_tpu.obs import Heartbeat, StepHealth, Tracer
 from mpi_pytorch_tpu.parallel.mesh import create_mesh, flat_mesh, shard_batch
+from mpi_pytorch_tpu.train import elastic
 from mpi_pytorch_tpu.train.state import (
     TrainState,
     make_optimizer,
@@ -126,11 +127,12 @@ def _global_max(value: float, mesh) -> float:
     return float(jnp.max(vals))
 
 
-def _stop_agreed(guard: PreemptionGuard, mesh) -> bool:
+def _stop_agreed(stop: bool, mesh) -> bool:
     """Epoch-boundary stop decision: EITHER all processes break before the
     next epoch or none do — a host stopping unilaterally would leave the
-    others blocked in the next collective step."""
-    return _global_max(1.0 if guard.triggered else 0.0, mesh) > 0.0
+    others blocked in the next collective step. ``stop`` is this process's
+    local verdict (the watchdog's poll of SIGTERM/sentinel/health streaks)."""
+    return _global_max(1.0 if stop else 0.0, mesh) > 0.0
 
 
 def _p0_scalar(value: float, mesh) -> float:
@@ -585,7 +587,20 @@ def _train_impl(
     cfg: Config, logger, metrics, tracer, health, heartbeat, telemetry_sync
 ) -> TrainSummary:
     with tracer.span("build"):
-        mesh, bundle, state, (train_manifest, test_manifest, loader) = build_training(cfg)
+        mesh = None
+        if cfg.from_checkpoint:
+            # Resume side: backend init retries with bounded backoff — a
+            # transiently wedged backend (bench history r02/r05) must cost
+            # attempts, not the auto-resume (train/elastic.py).
+            mesh = elastic.with_retries(
+                lambda: create_mesh(cfg.mesh),
+                what="backend init (mesh build)",
+                retries=cfg.resume_retries, backoff_s=cfg.resume_backoff_s,
+                logger=logger,
+            )
+        mesh, bundle, state, (train_manifest, test_manifest, loader) = build_training(
+            cfg, mesh=mesh
+        )
     logger.info(
         "world: %d process(es), %d device(s), mesh %s",
         jax.process_count(), jax.device_count(), dict(mesh.shape),
@@ -596,18 +611,60 @@ def _train_impl(
     )
 
     start_epoch = 0
+    resumed = False
+    zero_shards_to = (
+        mesh.shape[cfg.mesh.data_axis] if (cfg.spmd_mode and cfg.zero_opt_state) else 0
+    )
     if cfg.from_checkpoint:
-        latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
-        if latest:
-            state, start_epoch, last_loss = ckpt.load_checkpoint(latest, state)
+        # Elastic restore (train/elastic.py): newest LOADABLE checkpoint
+        # (corrupt files log a kind="anomaly" record and fall back to the
+        # previous one), topology manifest compared against the current
+        # mesh, kind="resume" record written — the self-healing form of
+        # the reference's manual FROM_CHECKPOINT restart (main.py:127-130).
+        res = elastic.restore_latest(
+            cfg.checkpoint_dir, state, mesh, metrics=metrics, logger=logger,
+            zero_shards_to=zero_shards_to,
+        )
+        if res is not None:
+            state, start_epoch, last_loss, _resume = res
+            resumed = True
             start_epoch += 1
-            logger.info("resumed from %s (epoch %d, loss %.4f)", latest, start_epoch, last_loss)
+            logger.info(
+                "resumed from %s (epoch %d, loss %.4f)",
+                _resume["path"], start_epoch, last_loss,
+            )
         else:
             logger.info("from_checkpoint=True but no checkpoint found; fresh start")
 
-    state = place_state_on_mesh(
-        state, mesh, zero_optimizer=cfg.zero_optimizer, fsdp=cfg.fsdp
-    )
+    # With ZeRO opt-state sharding the optimizer tree must NOT go through
+    # the replicated placement below: that would device_put the full
+    # unsharded 2×params moments onto every device — exactly the transient
+    # HBM spike the sharding exists to avoid — before the [P, chunk]
+    # reshard even runs. Detach it here and hand the raw (host, on resume)
+    # tree straight to zero_shard_opt_state, whose bounded per-row path
+    # then never sees more than one chunk per device.
+    defer_zero_opt = cfg.spmd_mode and cfg.zero_opt_state
+    raw_opt_state = state.opt_state
+    if defer_zero_opt:
+        state = state.replace(opt_state=())
+    if resumed:
+        # Reshard-on-load placement, retried: the restored host state is
+        # re-placed onto THIS mesh (whatever its shape), with device_put
+        # wrapped in the same bounded retry+backoff as backend init.
+        state = elastic.with_retries(
+            lambda: elastic.checked_place(
+                state, mesh, zero_optimizer=cfg.zero_optimizer, fsdp=cfg.fsdp
+            ),
+            what="state placement (device_put)",
+            retries=cfg.resume_retries, backoff_s=cfg.resume_backoff_s,
+            logger=logger,
+        )
+    else:
+        state = place_state_on_mesh(
+            state, mesh, zero_optimizer=cfg.zero_optimizer, fsdp=cfg.fsdp
+        )
+    if defer_zero_opt:
+        state = state.replace(opt_state=raw_opt_state)
     # ZeRO opt-state sharding (spmd mode): capture the UNSHARDED optimizer
     # layout first (eval_shape: shapes only, zero device memory) — it is the
     # gather-on-save template that keeps the on-disk checkpoint format
@@ -639,6 +696,16 @@ def _train_impl(
         return st.replace(
             opt_state=zero_unshard_opt_state(st.opt_state, opt_template)
         )
+
+    # Topology manifest stamped onto every checkpoint this run writes
+    # (JSON sidecar, checkpoint.write_manifest): the world shape + ZeRO
+    # shard layout an elastic restore reshards FROM (train/elastic.py).
+    topology = elastic.topology_manifest(
+        mesh,
+        zero_opt_state=bool(zero_shards_to),
+        spmd_mode=cfg.spmd_mode,
+        opt_template=opt_template,
+    )
 
     host_batch = cfg.batch_size // jax.process_count()
 
@@ -808,6 +875,26 @@ def _train_impl(
     # (the run is already finishing), and only a SECOND signal falls through
     # to the previous handler — the escape hatch if the drain itself wedges.
     guard = PreemptionGuard()
+    # The watchdog unifies every stop signal behind one poll: the guard's
+    # SIGTERM flag, the MPT_PREEMPT_FILE sentinel, and repeated health
+    # signals (straggler beats / non-finite grad norms) — each firing
+    # writes a kind="fault" record and stops the run at the same safe
+    # boundary a SIGTERM would (train/elastic.py).
+    watchdog = elastic.PreemptionWatchdog(
+        guard,
+        preempt_file=cfg.preempt_file,
+        straggler_beats=cfg.preempt_straggler_beats,
+        nonfinite_steps=cfg.preempt_nonfinite_steps,
+        heartbeat=heartbeat, health=health, metrics=metrics, logger=logger,
+    )
+    # Deterministic chaos, armed only via the MPT_FAULT_* env gates
+    # (utils/env.py FAULT_GATES; driven by tools/inject_faults.py).
+    faults = elastic.FaultInjector(metrics=metrics)
+    if faults.active:
+        logger.warning(
+            "fault injection armed: kill_at_step=%d delay_step_ms=%d "
+            "(MPT_FAULT_* gates)", faults.kill_at_step, faults.delay_ms,
+        )
     last_saved_epoch = -1
     stopped_mid_epoch = False
     # A resumed run must not demote a better historical best (best.json
@@ -827,7 +914,7 @@ def _train_impl(
     with guard:
       try:
         for epoch in range(start_epoch, cfg.num_epochs):
-            if _stop_agreed(guard, mesh):
+            if _stop_agreed(watchdog.should_stop(epoch=epoch), mesh):
                 summary.preempted = True
                 logger.info(
                     "preemption signal: stopping before epoch %d "
@@ -910,7 +997,7 @@ def _train_impl(
                 # reported or saved as a completed epoch). Multi-host stops
                 # only at the agreed epoch boundary above — a unilateral
                 # mid-epoch break would strand the other hosts' collectives.
-                if guard.triggered and jax.process_count() == 1:
+                if watchdog.should_stop(epoch=epoch, step=step_i) and jax.process_count() == 1:
                     stopped_mid_epoch = True
                     break
                 t_step = time.perf_counter()
@@ -918,11 +1005,15 @@ def _train_impl(
                     state, m = compiled_step(state, *args)
                     if telemetry_sync:
                         jax.block_until_ready(m["loss"])
+                    # Inside the timed region so a faked straggler delay
+                    # lands in the step time the heartbeat exchanges.
+                    faults.maybe_delay()
                 step_s = time.perf_counter() - t_step
                 losses.append(m["loss"])
                 counts.append(m["count"])
                 health.on_step(epoch, step_i, m, data_wait_s, step_s)
                 heartbeat.on_step(epoch, step_i, step_s)
+                faults.after_step(epoch, step_i)
                 if cfg.log_every_steps and (step_i + 1) % cfg.log_every_steps == 0:
                     logger.info(
                         "epoch %d step %d loss %.4f", epoch, step_i + 1, float(m["loss"])
@@ -995,6 +1086,7 @@ def _train_impl(
                         loss=epoch_loss,
                         keep=cfg.keep_checkpoints,
                         moments_bf16=cfg.ckpt_bf16_moments,
+                        manifest=topology,
                     )
                 last_saved_epoch = epoch
                 if path:
@@ -1072,6 +1164,7 @@ def _train_impl(
                             loss=epoch_loss, keep=cfg.keep_checkpoints,
                             on_durable=_mark_best,
                             moments_bf16=cfg.ckpt_bf16_moments,
+                            manifest=topology,
                         )
                         last_saved_epoch = epoch
                         if best_path:
@@ -1107,6 +1200,7 @@ def _train_impl(
                 loss=epoch_loss,
                 keep=cfg.keep_checkpoints, dirty=stopped_mid_epoch,
                 moments_bf16=cfg.ckpt_bf16_moments,
+                manifest=topology,
             )
             if path:
                 summary.checkpoint_path = path

@@ -1,15 +1,25 @@
-"""Boolean ``MPT_*`` env-knob parsing — ONE definition of truthiness.
+"""Boolean ``MPT_*`` env-knob parsing — ONE definition of truthiness —
+plus the registry of fault-injection / elastic-resume gates.
 
 Every boolean knob in the framework reads through here so the convention
 (case-insensitive; '', '0', 'false', 'no', 'off' mean off, anything else
 means on — the same falsy set the CLI's ``--flag`` parser accepts,
 ``config._str2bool``) cannot drift between call sites. Advisor r5: 'no'
 used to silently mean ON because only ''/'0'/'false' were recognized.
+
+Fault gates (``MPT_FAULT_*``) are the deterministic chaos levers of
+``tools/inject_faults.py`` and the elastic-resume tests: every gate the
+framework honors is REGISTERED here (name → meaning), and the accessors
+refuse unregistered names — the check_results_artifacts.py-style hygiene
+rule that keeps an injected fault from hiding behind a typo'd env var
+(the gate would silently never fire and the chaos test would "pass" by
+testing nothing).
 """
 
 from __future__ import annotations
 
 import os
+import threading
 
 FALSY = ("", "0", "false", "no", "off")
 
@@ -20,3 +30,89 @@ def env_flag(name: str, default: bool = False) -> bool:
     if raw is None:
         return default
     return raw.lower() not in FALSY
+
+
+def env_int(name: str, default: int = 0) -> int:
+    """The value of integer env knob ``name``; ``default`` when unset or
+    empty. Raises on a non-integer value (a malformed gate must fail loudly,
+    not silently disable the fault it was meant to inject)."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    return int(raw)
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection / elastic-resume gate registry (ISSUE 7). Read by the
+# trainer (train/elastic.py FaultInjector + PreemptionWatchdog), the mesh
+# builder (parallel/mesh.py), the resume placement path, and the serve
+# preprocess pool; driven by tools/inject_faults.py and the chaos tests.
+# ---------------------------------------------------------------------------
+
+FAULT_GATES: dict[str, str] = {
+    "MPT_FAULT_KILL_AT_STEP": (
+        "SIGKILL this process immediately after the Nth completed train "
+        "step (1-based, counted across epochs) — a deterministic mid-run "
+        "crash with an async checkpoint possibly in flight"
+    ),
+    "MPT_FAULT_DELAY_STEP_MS": (
+        "sleep this many ms inside every timed train step — fakes a "
+        "straggler host for the heartbeat/watchdog path"
+    ),
+    "MPT_FAULT_DELAY_PROCESS": (
+        "restrict MPT_FAULT_DELAY_STEP_MS to this process index "
+        "(unset/-1 = every process)"
+    ),
+    "MPT_FAULT_BACKEND_WEDGE_N": (
+        "make the first N create_mesh calls in this process raise — the "
+        "wedged-backend-init scenario the resume-side retry loop absorbs"
+    ),
+    "MPT_FAULT_DEVICE_PUT_N": (
+        "make the first N resume-side state placements raise — exercises "
+        "the bounded retry+backoff around device_put on restore"
+    ),
+    "MPT_FAULT_PREPROCESS_N": (
+        "make the first N serve preprocess calls raise a non-ServeError — "
+        "the preprocess-worker-crash scenario (typed PreprocessError to "
+        "the caller, pool respawn)"
+    ),
+    "MPT_PREEMPT_FILE": (
+        "path to a preemption sentinel: when the file exists, the trainer's "
+        "watchdog stops at the next safe boundary, saves, and exits 0 "
+        "(the cluster-scheduler preemption-notice pattern)"
+    ),
+}
+
+# In-process countdown state for the *_N gates: each counts DOWN from its
+# env value as its fault site fires, so "wedge for N attempts" is exact and
+# deterministic within one process (retry loops run in-process). Lock-
+# guarded: fault sites run on concurrent threads (the serve preprocess
+# pool), and an unguarded check-then-decrement would let an N-shot gate
+# fire more than N times.
+_fault_counters: dict[str, int] = {}
+_fault_lock = threading.Lock()
+
+
+def reset_fault_counters() -> None:
+    """Forget consumed countdowns (tests; a fresh process needs nothing)."""
+    with _fault_lock:
+        _fault_counters.clear()
+
+
+def fault_countdown(name: str) -> bool:
+    """True while gate ``name`` still has shots left (and consume one).
+
+    Unset/zero gates never fire and cost one lock + dict lookup — the
+    production hot path stays clean. ``name`` must be a registered
+    ``FAULT_GATES`` entry; anything else is a programming error, raised
+    immediately.
+    """
+    if name not in FAULT_GATES:
+        raise KeyError(f"unregistered fault gate {name!r} (see utils/env.py FAULT_GATES)")
+    with _fault_lock:
+        if name not in _fault_counters:
+            _fault_counters[name] = env_int(name, 0)
+        if _fault_counters[name] <= 0:
+            return False
+        _fault_counters[name] -= 1
+        return True

@@ -246,6 +246,105 @@ def test_server_metrics_records_schema(serve_cfg, server):
         assert r["requests"] <= r["bucket"]
 
 
+def test_preprocess_worker_crash_typed_counted_and_batch_survives(server):
+    """ISSUE 7 satellite: a preprocess-WORKER crash (a non-ServeError from
+    inside the pool, injected via the MPT_FAULT_PREPROCESS_N gate) fails
+    only ITS request, with the typed PreprocessError — not a silent loss,
+    not a misleading ServerClosedError — while the rest of the flush
+    serves; the failure is counted in stats and on the flush's
+    kind=\"serve\" record (preprocess_failures)."""
+    from mpi_pytorch_tpu.serve import PreprocessError
+    from mpi_pytorch_tpu.utils.env import reset_fault_counters
+
+    rng = np.random.default_rng(7)
+    raw = [
+        rng.integers(0, 256, size=(32, 32, 3)).astype(np.uint8) for _ in range(3)
+    ]
+    before = server.stats()
+    os.environ["MPT_FAULT_PREPROCESS_N"] = "1"
+    reset_fault_counters()
+    try:
+        # The first payload entering the pool crashes; submit the whole
+        # wave quickly so survivors coalesce around the casualty.
+        futs = [server.submit(im) for im in raw]
+        results, crashes = [], []
+        for f in futs:
+            try:
+                results.append(f.result(timeout=120))
+            except PreprocessError as e:
+                crashes.append(e)
+        assert len(crashes) == 1 and "worker crash" in str(crashes[0])
+        assert len(results) == 2  # the batch went on without the casualty
+    finally:
+        os.environ.pop("MPT_FAULT_PREPROCESS_N", None)
+        reset_fault_counters()
+    stats = server.stats()
+    assert stats["preprocess_failures"] == before["preprocess_failures"] + 1
+    # The flush that saw the casualty carries the count on its record (the
+    # completion loop writes it just after resolving the futures — poll).
+    from mpi_pytorch_tpu.obs.schema import load_records, validate_jsonl
+
+    flagged = []
+    deadline = time.monotonic() + 30
+    while not flagged and time.monotonic() < deadline:
+        flagged = [
+            r for r in load_records(server.cfg.metrics_file)
+            if r["kind"] == "serve" and r.get("preprocess_failures")
+        ]
+        time.sleep(0.05)
+    assert validate_jsonl(server.cfg.metrics_file) == []
+    assert flagged and flagged[-1]["preprocess_failures"] >= 1
+    assert "worker_respawns" in flagged[-1]
+
+
+def test_preprocess_all_failed_flush_emits_fault_record(server):
+    """A flush in which EVERY request fails preprocess dispatches no batch
+    (no kind=\"serve\" record) — the failure must surface as a
+    kind=\"fault\" reason=preprocess_all_failed record instead of
+    vanishing from the stream."""
+    from mpi_pytorch_tpu.obs.schema import load_records, validate_jsonl
+    from mpi_pytorch_tpu.serve import PreprocessError
+    from mpi_pytorch_tpu.utils.env import reset_fault_counters
+
+    rng = np.random.default_rng(13)
+    raw = rng.integers(0, 256, size=(32, 32, 3)).astype(np.uint8)
+    os.environ["MPT_FAULT_PREPROCESS_N"] = "1"
+    reset_fault_counters()
+    try:
+        with pytest.raises(PreprocessError):
+            server.predict_batch([raw], timeout=120)  # lone request = whole flush
+    finally:
+        os.environ.pop("MPT_FAULT_PREPROCESS_N", None)
+        reset_fault_counters()
+    faults = []
+    deadline = time.monotonic() + 30
+    while not faults and time.monotonic() < deadline:
+        faults = [
+            r for r in load_records(server.cfg.metrics_file)
+            if r["kind"] == "fault" and r["reason"] == "preprocess_all_failed"
+        ]
+        time.sleep(0.05)
+    assert faults and "1 request(s)" in faults[-1]["detail"]
+    assert validate_jsonl(server.cfg.metrics_file) == []
+
+
+def test_preprocess_pool_death_respawns_and_serves(server):
+    """A DEAD worker pool (simulated by shutting it down under the live
+    server — the BrokenThreadPool/errant-shutdown scenario) used to turn
+    every subsequent request into a bogus 'server is shut down'; now the
+    pool respawns once, the request retries on the fresh pool, and the
+    respawn is counted."""
+    rng = np.random.default_rng(11)
+    raw = rng.integers(0, 256, size=(32, 32, 3)).astype(np.uint8)
+    baseline = server.predict_batch([raw], timeout=120)
+
+    before = server.stats()["worker_respawns"]
+    server._pool.shutdown(wait=True)  # the pool dies; the server is live
+    after_death = server.predict_batch([raw], timeout=120)
+    np.testing.assert_array_equal(after_death, baseline)
+    assert server.stats()["worker_respawns"] == before + 1
+
+
 def test_server_rejects_after_close(serve_cfg):
     from mpi_pytorch_tpu.serve import InferenceServer, ServerClosedError
 

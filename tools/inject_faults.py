@@ -1,0 +1,147 @@
+"""Deterministic fault-injection harness (ISSUE 7 / ROADMAP item 4).
+
+Chaos testing for the elastic-training stack, CLI + library. Every fault is
+DETERMINISTIC — a given gate value produces the same failure at the same
+point every run — so a chaos test that passes means the recovery path ran,
+not that the fault happened to miss. Two halves:
+
+- **Env gates** (``MPT_FAULT_*``, registered in ``utils/env.py
+  FAULT_GATES``): in-process faults the framework itself honors — kill a
+  rank right after step N, delay a host's steps to fake a straggler, wedge
+  backend init for N attempts, fail the first N resume placements, crash
+  the first N serve preprocess calls. ``fault_env()`` builds the env-var
+  dict a test hands its trainer subprocess.
+
+- **File faults** (this module's actions): corrupt the NEWEST checkpoint
+  (truncate / garbage / empty) so the restore fallback path
+  (``train/elastic.restore_latest`` → previous checkpoint + a
+  ``kind="anomaly"`` record) is exercised against real on-disk damage, and
+  SIGKILL/SIGTERM a live training process by pid.
+
+CLI::
+
+    python tools/inject_faults.py corrupt-latest --checkpoint-dir ckpt [--mode truncate]
+    python tools/inject_faults.py kill --pid 1234 [--signal TERM]
+    python tools/inject_faults.py list-gates
+
+The end-to-end chaos drive (kill an 8-device CPU-mesh run mid-step, resume
+on a 4-device mesh) lives in ``tests/test_elastic.py`` and the
+``__graft_entry__`` dryrun's elastic leg, both built on these helpers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CORRUPT_MODES = ("truncate", "garbage", "empty")
+
+
+def corrupt_latest(ckpt_dir: str, mode: str = "truncate", keep_bytes: int = 64) -> str:
+    """Damage the NEWEST checkpoint file in ``ckpt_dir`` in place and return
+    its path. Modes: ``truncate`` keeps the first ``keep_bytes`` bytes (a
+    crash mid-write past the atomic rename — possible only via bit rot or a
+    partial copy, but exactly what the loader must survive); ``garbage``
+    overwrites the middle third with 0xFF; ``empty`` leaves a zero-byte
+    file. The manifest sidecar is left intact — damage to the payload must
+    be detected from the payload."""
+    from mpi_pytorch_tpu import checkpoint as ckpt
+
+    if mode not in CORRUPT_MODES:
+        raise ValueError(f"mode must be one of {CORRUPT_MODES}, got {mode!r}")
+    latest = ckpt.latest_checkpoint(ckpt_dir)
+    if latest is None:
+        raise FileNotFoundError(f"no checkpoint in {ckpt_dir}")
+    size = os.path.getsize(latest)
+    if mode == "empty":
+        with open(latest, "wb"):
+            pass
+    elif mode == "truncate":
+        with open(latest, "rb+") as f:
+            f.truncate(min(keep_bytes, size))
+    else:  # garbage
+        with open(latest, "rb+") as f:
+            f.seek(size // 3)
+            f.write(b"\xff" * max(1, size // 3))
+    return latest
+
+
+def kill(pid: int, sig: str = "KILL") -> None:
+    """Deliver ``SIG<sig>`` to ``pid`` — the external-kill half of the
+    harness (SIGKILL = hard crash, SIGTERM = graceful-preemption drill)."""
+    os.kill(pid, getattr(signal, f"SIG{sig.upper()}"))
+
+
+def fault_env(
+    *,
+    kill_at_step: int | None = None,
+    delay_step_ms: int | None = None,
+    delay_process: int | None = None,
+    backend_wedge: int | None = None,
+    device_put_fail: int | None = None,
+    preprocess_crash: int | None = None,
+    preempt_file: str | None = None,
+    base: dict | None = None,
+) -> dict:
+    """The env-var dict arming the in-process gates — hand it to a trainer
+    subprocess (``env={**os.environ, **fault_env(kill_at_step=5)}``). Only
+    explicitly requested gates appear; every name is validated against the
+    ``utils/env.py`` registry so a renamed gate fails tests loudly."""
+    from mpi_pytorch_tpu.utils.env import FAULT_GATES
+
+    values = {
+        "MPT_FAULT_KILL_AT_STEP": kill_at_step,
+        "MPT_FAULT_DELAY_STEP_MS": delay_step_ms,
+        "MPT_FAULT_DELAY_PROCESS": delay_process,
+        "MPT_FAULT_BACKEND_WEDGE_N": backend_wedge,
+        "MPT_FAULT_DEVICE_PUT_N": device_put_fail,
+        "MPT_FAULT_PREPROCESS_N": preprocess_crash,
+        "MPT_PREEMPT_FILE": preempt_file,
+    }
+    env = dict(base) if base else {}
+    for name, value in values.items():
+        assert name in FAULT_GATES, name
+        if value is not None:
+            env[name] = str(value)
+    return env
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_corrupt = sub.add_parser(
+        "corrupt-latest", help="damage the newest checkpoint file in place"
+    )
+    p_corrupt.add_argument("--checkpoint-dir", required=True)
+    p_corrupt.add_argument("--mode", choices=CORRUPT_MODES, default="truncate")
+    p_corrupt.add_argument("--keep-bytes", type=int, default=64)
+
+    p_kill = sub.add_parser("kill", help="signal a live training process")
+    p_kill.add_argument("--pid", type=int, required=True)
+    p_kill.add_argument("--signal", default="KILL", dest="sig")
+
+    sub.add_parser("list-gates", help="print the registered MPT_FAULT_* gates")
+
+    args = parser.parse_args(argv)
+    if args.cmd == "corrupt-latest":
+        path = corrupt_latest(args.checkpoint_dir, args.mode, args.keep_bytes)
+        print(f"corrupted ({args.mode}): {path}")
+    elif args.cmd == "kill":
+        kill(args.pid, args.sig)
+        print(f"sent SIG{args.sig.upper()} to {args.pid}")
+    else:
+        from mpi_pytorch_tpu.utils.env import FAULT_GATES
+
+        for name, doc in sorted(FAULT_GATES.items()):
+            print(f"{name}\n    {doc}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

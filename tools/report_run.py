@@ -9,7 +9,10 @@ run recorded that kind:
 - step-phase breakdown (data-wait vs device-step ms, wait fraction,
   grad-norm trajectory, recompiles, non-finite losses);
 - heartbeat summary (beats, hosts, straggler flags per host);
-- validation/eval rows and anomaly records.
+- validation/eval rows and anomaly records;
+- serving flush/bench summaries;
+- elastic-resume lines (topology from → to, ZeRO re-chunking, corrupt
+  checkpoints skipped) and fault/preemption signals.
 
 Every record is validated against the shared schema
 (``mpi_pytorch_tpu/obs/schema.py``) first: malformed records are listed and
@@ -220,6 +223,22 @@ def summarize(records: list[dict]) -> dict:
             {k: a.get(k) for k in ("reason", "epoch", "step", "loss")}
             for a in anomalies
         ]
+    resumes = by_kind.get("resume", [])
+    if resumes:
+        summary["resumes"] = [
+            {k: r.get(k) for k in (
+                "epoch", "from_devices", "to_devices", "from_mesh", "to_mesh",
+                "zero_shards_from", "zero_shards_to", "corrupt_skipped",
+                "strategy",
+            )}
+            for r in resumes
+        ]
+    faults = by_kind.get("fault", [])
+    if faults:
+        summary["faults"] = [
+            {k: f.get(k) for k in ("reason", "epoch", "step", "detail", "streak")}
+            for f in faults
+        ]
     return summary
 
 
@@ -341,6 +360,29 @@ def render(path: str, records: list[dict], summary: dict) -> str:
               r["requests"], r["p50_ms"], r["p95_ms"], r["p99_ms"],
               r["images_per_sec"], r.get("compiles_after_warmup")]
              for r in summary["serve_bench"]],
+        )]
+    for r in summary.get("resumes", []):
+        frm = r.get("from_mesh") or (
+            f"{r['from_devices']} devices" if r.get("from_devices") is not None
+            else "legacy (no manifest)"
+        )
+        line = (
+            f"RESUME: epoch {r['epoch']} — {frm} → {r.get('to_mesh')} "
+            f"[{r.get('strategy')}]"
+        )
+        if r.get("zero_shards_from") or r.get("zero_shards_to"):
+            line += (
+                f"; ZeRO P {r.get('zero_shards_from')} → {r.get('zero_shards_to')}"
+            )
+        if r.get("corrupt_skipped"):
+            line += f"; {r['corrupt_skipped']} corrupt checkpoint(s) skipped"
+        out += ["", line]
+    for f in summary.get("faults", []):
+        out += ["", (
+            f"FAULT: {f['reason']}"
+            + ("" if f.get("epoch") is None else f" at epoch {f['epoch']}")
+            + ("" if f.get("step") is None else f" step {f['step']}")
+            + ("" if not f.get("detail") else f" — {f['detail']}")
         )]
     for a in summary.get("anomalies", []):
         out += ["", (
