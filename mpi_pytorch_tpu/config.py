@@ -362,6 +362,34 @@ class Config:
     # hosts slower than straggler_threshold x median are flagged. 0 = off.
     heartbeat_every_steps: int = 0
     straggler_threshold: float = 1.5
+    # --- live telemetry (obs/metrics.py, obs/monitor.py, obs/flight.py) ---
+    # Declarative SLO rules over the live metrics registry ("" = off).
+    # Rules separated by ";", e.g.
+    #   "serve/flush_ms:p99 > 250 for=3 name=serve_p99;
+    #    drift:train/step_ms_last > 2.0 for=2 action=log,preempt"
+    # Evaluated per step (trainer) / per flush (serve); a breach writes a
+    # kind="alert" record and runs its actions (log | metric | preempt —
+    # the last writes the preemption sentinel so the watchdog stops the
+    # run at a safe boundary). Syntax: obs/monitor.py / OBSERVABILITY.md.
+    slo_rules: str = ""
+    # Periodic kind="metrics" registry snapshots every N steps (0 = off).
+    # Step-count cadence (not wall time) because the multi-host merge is a
+    # collective: every process must snapshot at the same step.
+    metrics_every_steps: int = 0
+    # Anomaly flight recorder ("" = off): every record this process emits
+    # enters a bounded ring, and any kind="fault"/"alert" record dumps the
+    # ring as a JSON evidence file in this directory (obs/flight.py).
+    flight_dir: str = ""
+    flight_records: int = 256
+    # > 0: a flight dump also opens a jax.profiler trace for the next S
+    # seconds (closed on a later record), capturing the device-side
+    # aftermath of the incident next to the host evidence.
+    flight_profile_window_s: float = 0.0
+    # Serve-only: HTTP exposition thread (serve/http.py). 0 = off; > 0
+    # binds that port; -1 binds an ephemeral port (tests/smokes — read it
+    # back from InferenceServer.metrics_port). Serves /metrics (Prometheus
+    # text), /metricsz (JSON registry snapshot), /healthz.
+    serve_metrics_port: int = 0
     # Sanitizer (SURVEY §5 race-detection row): XLA collectives are
     # deterministic by construction, so the debug surface that remains is
     # numerics — this flag turns every NaN-producing op into an immediate
@@ -556,6 +584,70 @@ class Config:
                 f"heartbeat_every_steps must be >= 0 (0 disables), "
                 f"got {self.heartbeat_every_steps}"
             )
+        if self.metrics_every_steps < 0:
+            raise ValueError(
+                f"metrics_every_steps must be >= 0 (0 disables), "
+                f"got {self.metrics_every_steps}"
+            )
+        if self.flight_records < 1:
+            raise ValueError(
+                f"flight_records must be >= 1, got {self.flight_records}"
+            )
+        if self.flight_profile_window_s < 0:
+            raise ValueError(
+                f"flight_profile_window_s must be >= 0, "
+                f"got {self.flight_profile_window_s}"
+            )
+        if self.serve_metrics_port < -1:
+            raise ValueError(
+                "serve_metrics_port must be -1 (ephemeral), 0 (off), or a "
+                f"port number, got {self.serve_metrics_port}"
+            )
+        if self.slo_rules and self.scan_epoch:
+            raise ValueError(
+                "slo_rules are evaluated at per-step host boundaries; "
+                "scan_epoch runs the whole epoch as one device-side scan "
+                "with no step boundaries, so the rules would silently "
+                "never evaluate — drop one of the two"
+            )
+        if self.slo_rules:
+            # Parse now so a malformed rule fails the run at config time,
+            # not silently mid-training; dependency-free import.
+            from mpi_pytorch_tpu.obs.monitor import parse_rules
+
+            rules = parse_rules(self.slo_rules)
+            if any("preempt" in r.actions for r in rules) and not (
+                self.preempt_file or os.environ.get("MPT_PREEMPT_FILE")
+            ):
+                raise ValueError(
+                    "an SLO rule requests action=preempt but no preemption "
+                    "sentinel path is configured — set --preempt-file or "
+                    "MPT_PREEMPT_FILE so the watchdog has a file to watch"
+                )
+            # A rule over a metric whose publisher is off would silently
+            # never evaluate — the same silently-ignored-combination class
+            # validate_config rejects elsewhere (preempt_nonfinite_steps
+            # needs --step-metrics; fused-head silent degrade, advisor r5).
+            # The name sets live NEXT TO their registrations so a new
+            # gauge cannot silently escape this check.
+            from mpi_pytorch_tpu.obs.health import STEP_GAUGES
+            from mpi_pytorch_tpu.obs.heartbeat import BEAT_GAUGES
+
+            step_only = set(STEP_GAUGES)
+            beat_only = set(BEAT_GAUGES)
+            for r in rules:
+                base = r.metric.split(":")[0]
+                if base in step_only and not self.step_metrics:
+                    raise ValueError(
+                        f"SLO rule {r.name!r} reads {base!r}, which is only "
+                        "published with --step-metrics true (obs/health.py)"
+                    )
+                if base in beat_only and self.heartbeat_every_steps <= 0:
+                    raise ValueError(
+                        f"SLO rule {r.name!r} reads {base!r}, which is only "
+                        "published with --heartbeat-every-steps > 0 "
+                        "(obs/heartbeat.py)"
+                    )
         if self.straggler_threshold <= 1.0:
             raise ValueError(
                 "straggler_threshold is a multiple of the median step time "

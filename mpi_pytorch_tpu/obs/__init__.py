@@ -5,6 +5,14 @@ sentinel (``health.py``), the multi-host step-time heartbeat with straggler
 flagging (``heartbeat.py``), and the metrics-record schema shared by the
 drivers, ``tools/report_run.py``, and the artifacts linter (``schema.py``).
 
+On top of the write-only record stream sits the LIVE layer (ISSUE 8): the
+in-process metrics registry with streaming percentile sketches and
+cross-host merge (``metrics.py``), the declarative SLO monitor emitting
+``kind="alert"`` records with pluggable actions (``monitor.py``), and the
+anomaly flight recorder dumping the last-N-records ring whenever a fault
+or alert fires (``flight.py``). The registry's snapshot surface is the
+contract ROADMAP item 1's fleet controller reads.
+
 Everything here is host-side and backend-agnostic: importing this package
 never initializes jax (the tools import the schema without a device), and
 the tracer/health hooks are inert unless the corresponding config knob is
@@ -12,6 +20,7 @@ set — telemetry is opt-in per run, except the NaN sentinel, which defaults
 on (training on a NaN'd loss is never the right outcome).
 """
 
+from mpi_pytorch_tpu.obs.flight import FlightRecorder
 from mpi_pytorch_tpu.obs.health import (
     NonFiniteLossError,
     StepHealth,
@@ -20,18 +29,25 @@ from mpi_pytorch_tpu.obs.health import (
     ensure_compile_listener,
 )
 from mpi_pytorch_tpu.obs.heartbeat import Heartbeat, flag_stragglers
+from mpi_pytorch_tpu.obs.metrics import MetricsRegistry, resolve_metric
+from mpi_pytorch_tpu.obs.monitor import SLOMonitor, parse_rules
 from mpi_pytorch_tpu.obs.schema import validate_jsonl, validate_record
 from mpi_pytorch_tpu.obs.trace import Tracer
 
 __all__ = [
+    "FlightRecorder",
     "Heartbeat",
+    "MetricsRegistry",
     "NonFiniteLossError",
+    "SLOMonitor",
     "StepHealth",
     "Tracer",
     "compile_count",
     "device_bytes_in_use",
     "ensure_compile_listener",
     "flag_stragglers",
+    "parse_rules",
+    "resolve_metric",
     "validate_jsonl",
     "validate_record",
 ]

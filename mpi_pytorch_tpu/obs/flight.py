@@ -1,0 +1,196 @@
+"""Anomaly flight recorder: a per-process ring of recent records, dumped
+with context when something fires (ISSUE 8).
+
+A postmortem on a JSONL stream answers "what happened eventually"; the
+question during an incident is "what were the last N things this process
+saw when the alert fired". The flight recorder answers it without
+retaining the run: every record the process emits (``FlightRecorder.tap``
+wraps the ``MetricsWriter``, BEFORE its process-0-only file gate, so every
+process records even though only process 0 persists the stream) lands in a
+bounded ring, and any ``kind="fault"`` or ``kind="alert"`` record passing
+through triggers a dump — a self-contained JSON file with the ring's
+contents. That wires EVERY fault source at once (the preemption watchdog,
+the fault injector, serve's preprocess_all_failed, the SLO monitor)
+without touching each site.
+
+Dump layout (``--flight-dir DIR``)::
+
+    DIR/flight_000_alert_step_drift.p0.json   # {"reason", "ts", "process",
+    DIR/flight_001_fault_preempt_file.p0.json #  "records": [last N records]}
+    DIR/xla_000/ ...                          # optional profiler window
+
+Optionally (``--flight-profile-window-s S`` > 0) a dump also opens a
+``jax.profiler`` trace for the NEXT ``S`` seconds of run — captured
+forward from the trigger, closed on a later record or at ``close()`` — so
+the incident's device-side aftermath lands next to the host evidence.
+Profiler failures are swallowed: evidence capture must never take the run
+down. Dumps are capped (``max_dumps``) so a flapping alert cannot fill the
+disk; the trainer's failure path calls ``dump("crash")`` the same way it
+flushes the tracer, so an aborted run keeps its last-moments ring too.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from collections import deque
+
+_AUTO_DUMP_KINDS = ("fault", "alert")
+_SAFE = re.compile(r"[^a-zA-Z0-9_.-]+")
+
+# One profiler window process-wide: jax.profiler.start_trace raises if a
+# trace is already active, and two recorders (trainer + serve in one
+# process) must not fight over it.
+_profiler_lock = threading.Lock()
+_profiler_active = False
+
+
+class FlightRecorder:
+    """Bounded ring of recent metrics records + evidence dumps."""
+
+    def __init__(
+        self,
+        out_dir: str,
+        *,
+        capacity: int = 256,
+        max_dumps: int = 16,
+        profile_window_s: float = 0.0,
+        auto_dump_kinds=_AUTO_DUMP_KINDS,
+        clock=time.monotonic,
+    ):
+        if capacity < 1:
+            raise ValueError(f"flight capacity must be >= 1, got {capacity}")
+        self.out_dir = out_dir
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._max_dumps = max_dumps
+        self._profile_window_s = float(profile_window_s)
+        self._auto_kinds = tuple(auto_dump_kinds)
+        self._clock = clock
+        self._window_until: float | None = None
+        self._closed = False
+
+    # ---------------------------------------------------------------- record
+
+    def record(self, rec: dict) -> None:
+        """Append one record; auto-dump on fault/alert kinds. Called for
+        EVERY record on EVERY process (via ``tap``)."""
+        with self._lock:
+            self._ring.append(rec)
+        self._poll_profiler()
+        if rec.get("kind") in self._auto_kinds:
+            reason = rec.get("reason") or rec.get("rule") or ""
+            self.dump(f"{rec.get('kind')}_{reason}" if reason else rec.get("kind"))
+
+    def tap(self, writer):
+        """Wrap a ``MetricsWriter``-shaped sink: every ``write`` records
+        into the ring first (stamped with the ts the stream will carry),
+        then forwards. ``close`` closes the inner writer only — the
+        recorder itself outlives it for the failure-path ``dump``."""
+        return _TappedWriter(writer, self)
+
+    # ------------------------------------------------------------------ dumps
+
+    def dump(self, reason: str) -> str | None:
+        """Write the ring to a dump file; returns its path (None when the
+        dump cap is reached or the recorder is closed)."""
+        with self._lock:
+            if self._closed or self._seq >= self._max_dumps:
+                return None
+            seq = self._seq
+            self._seq += 1
+            records = list(self._ring)
+        from mpi_pytorch_tpu.utils.logging import process_index
+
+        safe = _SAFE.sub("_", reason).strip("_") or "dump"
+        os.makedirs(self.out_dir, exist_ok=True)
+        path = os.path.join(
+            self.out_dir, f"flight_{seq:03d}_{safe}.p{process_index()}.json"
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "reason": reason,
+                    "ts": time.time(),
+                    "process": process_index(),
+                    "records": records,
+                },
+                f,
+            )
+        os.replace(tmp, path)  # atomic: a dump mid-crash is whole or absent
+        self._start_profiler_window(seq)
+        return path
+
+    # ----------------------------------------------------- profiler windows
+
+    def _start_profiler_window(self, seq: int) -> None:
+        global _profiler_active
+        if self._profile_window_s <= 0:
+            return
+        with _profiler_lock:
+            if _profiler_active:
+                return
+            try:
+                import jax
+
+                jax.profiler.start_trace(
+                    os.path.join(self.out_dir, f"xla_{seq:03d}")
+                )
+            except Exception:
+                return
+            _profiler_active = True
+            self._window_until = self._clock() + self._profile_window_s
+
+    def _poll_profiler(self) -> None:
+        """Close an elapsed profiler window — piggybacked on record()/close()
+        so no extra thread exists just to stop a trace."""
+        global _profiler_active
+        if self._window_until is None:
+            return
+        if self._clock() < self._window_until:
+            return
+        with _profiler_lock:
+            self._window_until = None
+            if not _profiler_active:
+                return
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            _profiler_active = False
+
+    # ------------------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        """Stop any open profiler window; idempotent. Deliberately does NOT
+        clear the ring — a post-close ``dump`` is refused, but the evidence
+        stays inspectable in-process."""
+        if self._window_until is not None:
+            self._window_until = self._clock()  # force the window shut
+            self._poll_profiler()
+        self._closed = True
+
+
+class _TappedWriter:
+    """A MetricsWriter front that copies every record into the recorder's
+    ring before forwarding. The ts is stamped HERE (once), so the ring and
+    the persisted stream carry the identical record."""
+
+    def __init__(self, inner, recorder: FlightRecorder):
+        self._inner = inner
+        self._recorder = recorder
+
+    def write(self, record) -> None:
+        rec = {"ts": time.time(), **record}
+        self._recorder.record(rec)
+        self._inner.write(rec)
+
+    def close(self) -> None:
+        self._inner.close()

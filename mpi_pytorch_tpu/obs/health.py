@@ -30,6 +30,19 @@ _listener_installed = False
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
+# Registry gauges StepHealth publishes — ONLY when step telemetry is on.
+# config.validate_config imports this set to reject SLO rules over these
+# names without --step-metrics (the rule would silently never evaluate);
+# keeping the set next to the registrations means a new gauge cannot
+# escape that check.
+STEP_GAUGES = (
+    "train/loss",
+    "train/grad_norm",
+    "train/recompiles",
+    "train/nonfinite_grad_streak",
+    "train/sync_ms",
+)
+
 
 def ensure_compile_listener() -> None:
     """Arm the process-wide backend-compile counter (idempotent). Callers
@@ -92,11 +105,26 @@ class StepHealth:
         step_metrics: bool = False,
         nan_sentinel: bool = True,
         tracer=None,
+        registry=None,
     ):
         self.metrics = metrics
         self.enabled = bool(step_metrics)
         self.nan_sentinel = bool(nan_sentinel)
         self.tracer = tracer
+        # Live-telemetry publication (obs/metrics.MetricsRegistry): per-step
+        # loss/grad-norm/recompile/streak gauges the SLO monitor reads.
+        # Only advances when step telemetry is on — same gate as the
+        # records, so registry publication never adds a host sync. Gauges
+        # pre-bound (the registry's own hot-path guidance), and up front
+        # rather than on first use: the cross-host metrics merge flattens
+        # by name set, so registration must not depend on what a given
+        # host happened to observe.
+        self.registry = registry
+        if registry is not None:
+            self._g_loss = registry.gauge("train/loss")
+            self._g_grad_norm = registry.gauge("train/grad_norm")
+            self._g_recompiles = registry.gauge("train/recompiles")
+            self._g_nonfinite = registry.gauge("train/nonfinite_grad_streak")
         self._baseline = 0
         # Gradient-sync telemetry (schema v2, optional): set by the trainer
         # when --grad-sync-buckets is on. overlap_frac is the static
@@ -164,6 +192,18 @@ class StepHealth:
             self.nonfinite_grad_streak = (
                 0 if math.isfinite(grad_norm) else self.nonfinite_grad_streak + 1
             )
+        if self.registry is not None:
+            self._g_loss.set(loss)
+            if grad_norm is not None:
+                self._g_grad_norm.set(grad_norm)
+            self._g_recompiles.set(record["recompiles"])
+            self._g_nonfinite.set(self.nonfinite_grad_streak)
+            if sync_ms is not None:
+                # train/sync_ms intentionally NOT pre-registered: no
+                # trainer path passes sync_ms today (schema-v2 note), so
+                # the name would be a permanently-null gauge; any future
+                # caller passes it from step 0 on every host alike.
+                self.registry.gauge("train/sync_ms").set(sync_ms)
         self._sentinel(epoch, step, loss, grad_norm)
 
     def on_scan_epoch(self, epoch: int, m: Mapping[str, Any]) -> None:

@@ -20,6 +20,17 @@ from __future__ import annotations
 
 import numpy as np
 
+# Registry gauges the heartbeat publishes — ONLY when --heartbeat-every-
+# steps > 0. config.validate_config imports this set to reject SLO rules
+# over these names when no beat will ever publish them (the health.py
+# STEP_GAUGES discipline).
+BEAT_GAUGES = (
+    "train/straggler_streak",
+    "train/median_step_ms",
+    "train/slowest_step_ms",
+    "train/heartbeat_images_per_sec",
+)
+
 
 def flag_stragglers(per_host_ms, threshold: float) -> list[int]:
     """Indices (= process ids) of hosts slower than ``threshold × median``.
@@ -46,6 +57,7 @@ class Heartbeat:
         batch_images: int = 0,
         tracer=None,
         gather=None,
+        registry=None,
     ):
         self.metrics = metrics
         self.every = int(every_steps)
@@ -53,6 +65,19 @@ class Heartbeat:
         self.threshold = float(threshold)
         self.batch_images = int(batch_images)
         self.tracer = tracer
+        # Live-telemetry publication (obs/metrics.MetricsRegistry): per-beat
+        # straggler/pace gauges the SLO monitor's fleet rules read —
+        # pre-bound, and registered up front so every host's registry has
+        # the identical name set (the cross-host merge flattens by it).
+        self.registry = registry
+        if registry is not None:
+            self._g_streak = registry.gauge("train/straggler_streak")
+            self._g_median = registry.gauge("train/median_step_ms")
+            self._g_slowest = registry.gauge("train/slowest_step_ms")
+            self._g_ips = (
+                registry.gauge("train/heartbeat_images_per_sec")
+                if self.batch_images else None
+            )
         if gather is None:
             from mpi_pytorch_tpu.parallel.collectives import host_allgather
 
@@ -106,6 +131,12 @@ class Heartbeat:
                 self.batch_images / (max(per_host_ms) / 1e3), 1
             )
         self.metrics.write(record)
+        if self.registry is not None:
+            self._g_streak.set(self.straggler_streak)
+            self._g_median.set(record["median_step_ms"])
+            self._g_slowest.set(max(per_host_ms))
+            if self._g_ips is not None and "images_per_sec" in record:
+                self._g_ips.set(record["images_per_sec"])
         if self.tracer is not None:
             self.tracer.instant(
                 "heartbeat", args={"step": step, "stragglers": stragglers}

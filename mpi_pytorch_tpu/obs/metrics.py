@@ -1,0 +1,399 @@
+"""In-process metrics registry — the obs layer's LIVE read-path (ISSUE 8).
+
+The record stream (``MetricsWriter`` JSONL) is write-only: spans, step
+health, serve flushes all land on disk and are read post-hoc by
+``tools/report_run.py``. Anything that wants to react *during* the run —
+the SLO monitor (``obs/monitor.py``), ROADMAP item 1's fleet controller
+retuning bucket sets from serve telemetry, a Prometheus scraper — needs a
+queryable in-memory aggregate instead. This registry is that aggregate:
+
+- **Counter** — monotone float (requests served, rejects, alerts fired);
+- **Gauge** — last-set value (queue depth, straggler streak, MFU);
+- **Histogram** — a fixed-size log-bucketed percentile sketch: p50/p95/p99
+  without retaining samples. Buckets are powers of ``2^(1/16)`` (~4.4%
+  wide), so any quantile is exact to within half a bucket (~2.2% relative)
+  regardless of how many observations stream through; storage is one flat
+  int array of ``_N_BUCKETS`` entries per histogram, O(1) per observe.
+
+Three read surfaces:
+
+- ``snapshot()`` — plain dict (counters / gauges / histogram summaries
+  with sketch-derived quantiles); ``snapshot_record()`` wraps it as a
+  ``kind="metrics"`` record (schema v4) for the metrics stream;
+- ``prometheus_text()`` — Prometheus text exposition (the serve
+  ``/metrics`` endpoint, ``serve/http.py``);
+- ``merged()`` — the CROSS-HOST aggregate: every process flattens its
+  registry into one f32 vector, exchanges it over the existing telemetry
+  collective (``parallel/collectives.host_allgather`` — the heartbeat's
+  path), and reduces: counters and histogram buckets SUM, gauges take the
+  MAX (a fleet-level gauge answers "is any host past the threshold").
+  Like every host collective it must run at the same point on all
+  processes — the trainer snapshots on a step-count cadence
+  (``--metrics-every-steps``) for exactly that reason.
+
+Deliberately dependency-light: pure stdlib (math + threading), no jax, no
+numpy — the tools and the monitor import this without a backend, and an
+``observe``/``inc`` on the serving hot path is a few dict-free attribute
+ops under one small lock.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Callable, Mapping
+
+# Sketch geometry: 16 buckets per octave (base 2**(1/16) ≈ 1.0443) over
+# value range [2^-10, 2^30) ≈ [1e-3, 1e9] — micro-ms to ~11 days in ms, or
+# counts up to a billion. Index 0 is the underflow bucket (≤ 0 or < 2^-10);
+# the top bucket absorbs overflow. 640 ints per histogram.
+_BUCKETS_PER_OCTAVE = 16
+_MIN_LOG2 = -10
+_MAX_LOG2 = 30
+_N_BUCKETS = (_MAX_LOG2 - _MIN_LOG2) * _BUCKETS_PER_OCTAVE
+
+
+def _bucket_index(value: float) -> int:
+    if value <= 0 or not math.isfinite(value):
+        return 0
+    i = int(math.floor(math.log2(value) * _BUCKETS_PER_OCTAVE)) - (
+        _MIN_LOG2 * _BUCKETS_PER_OCTAVE
+    )
+    return min(max(i, 0), _N_BUCKETS - 1)
+
+
+def _bucket_upper(index: int) -> float:
+    """Exclusive upper bound of bucket ``index`` (its Prometheus ``le``)."""
+    return 2.0 ** ((index + 1) / _BUCKETS_PER_OCTAVE + _MIN_LOG2)
+
+
+def _bucket_mid(index: int) -> float:
+    """Geometric midpoint — the sketch's quantile estimate for the bucket."""
+    return 2.0 ** ((index + 0.5) / _BUCKETS_PER_OCTAVE + _MIN_LOG2)
+
+
+class Counter:
+    """Monotone counter. ``inc`` only — a decreasing 'counter' is a gauge."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counters only increase (inc({n}))")
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    """Last-written value (None until first set)."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value: float | None = None
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+
+class Histogram:
+    """Streaming log-bucketed percentile sketch (module docstring)."""
+
+    __slots__ = ("_lock", "counts", "n", "total", "vmin", "vmax")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.counts = [0] * _N_BUCKETS
+        self.n = 0
+        self.total = 0.0
+        self.vmin = math.inf
+        self.vmax = -math.inf
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self.counts[_bucket_index(value)] += 1
+            self.n += 1
+            self.total += value
+            if value < self.vmin:
+                self.vmin = value
+            if value > self.vmax:
+                self.vmax = value
+
+    def quantile(self, q: float) -> float | None:
+        """The q-quantile estimate (bucket geometric midpoint, clamped to
+        the observed [min, max]), or None when empty. Accurate to within
+        half a bucket (~2.2% relative) by construction."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile q must be in [0, 1], got {q}")
+        with self._lock:
+            if self.n == 0:
+                return None
+            rank = max(1, math.ceil(q * self.n))
+            cum = 0
+            for i, c in enumerate(self.counts):
+                cum += c
+                if cum >= rank:
+                    est = self.vmin if i == 0 else _bucket_mid(i)
+                    return min(max(est, self.vmin), self.vmax)
+        return self.vmax  # unreachable: cum == n >= rank by the last bucket
+
+    def summary(self) -> dict:
+        """The snapshot view: count/sum/min/max + the three SLO quantiles."""
+        with self._lock:
+            n = self.n
+        if n == 0:
+            return {"count": 0}
+        return {
+            "count": n,
+            "sum": round(self.total, 6),
+            "min": round(self.vmin, 6),
+            "max": round(self.vmax, 6),
+            "p50": round(self.quantile(0.50), 6),
+            "p95": round(self.quantile(0.95), 6),
+            "p99": round(self.quantile(0.99), 6),
+        }
+
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prom_name(name: str) -> str:
+    """Registry name → a stable Prometheus metric name: ``mpt_`` prefix,
+    every non-[a-zA-Z0-9_:] character collapsed to ``_``. 'serve/flush_ms'
+    → 'mpt_serve_flush_ms'. Deterministic, so dashboards can rely on it."""
+    return "mpt_" + _PROM_BAD.sub("_", name)
+
+
+class MetricsRegistry:
+    """Named counters/gauges/histograms behind get-or-create accessors.
+
+    Accessors are cheap but not free (one lock + dict get) — hot paths
+    should resolve their metric ONCE and hold the object (the serve
+    request path pre-binds its counters in ``server.__init__``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    def _get(self, table: dict, name: str, cls):
+        with self._lock:
+            m = table.get(name)
+            if m is None:
+                for other in (self._counters, self._gauges, self._histograms):
+                    if other is not table and name in other:
+                        raise ValueError(
+                            f"metric {name!r} already registered with a "
+                            "different type"
+                        )
+                m = table[name] = cls(threading.Lock())
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(self._counters, name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(self._gauges, name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(self._histograms, name, Histogram)
+
+    # ------------------------------------------------------------- snapshots
+
+    def snapshot(self) -> dict:
+        """Point-in-time plain-dict view: the monitor's and the snapshot
+        record's shared read (sorted names → deterministic output)."""
+        with self._lock:
+            counters = dict(sorted(self._counters.items()))
+            gauges = dict(sorted(self._gauges.items()))
+            histograms = dict(sorted(self._histograms.items()))
+        return {
+            "counters": {k: round(c.value, 6) for k, c in counters.items()},
+            "gauges": {
+                k: (None if g.value is None else round(g.value, 6))
+                for k, g in gauges.items()
+            },
+            "histograms": {k: h.summary() for k, h in histograms.items()},
+        }
+
+    def snapshot_record(self, merge: bool = False, gather=None) -> dict:
+        """The ``kind="metrics"`` record (schema v4). ``merge=True`` runs
+        the cross-host exchange first (a collective — every process must
+        call at the same point; only process 0's writer persists it)."""
+        if merge:
+            snap, hosts = self.merged(gather=gather)
+            return {"kind": "metrics", "merged_hosts": hosts, **snap}
+        return {"kind": "metrics", **self.snapshot()}
+
+    # -------------------------------------------------------- cross-host merge
+
+    def merged(self, gather=None) -> tuple[dict, int]:
+        """(snapshot-shaped dict aggregated across hosts, host count).
+
+        One ``host_allgather`` of a flat f32 vector per call: counters and
+        histogram state sum, gauges take the cross-host max, histogram
+        min/max combine. The vector layout is derived from THIS process's
+        sorted metric names — all processes must have registered the same
+        metrics (they run the same wiring code, and anything that can
+        register divergently pre-registers: SLOMonitor.__init__). The row
+        width check below turns a layout mismatch that survived the
+        gather into a loud error rather than a silent misalignment."""
+        if gather is None:
+            from mpi_pytorch_tpu.parallel.collectives import host_allgather
+
+            gather = host_allgather
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            histograms = sorted(self._histograms.items())
+
+        vec: list[float] = [c.value for _, c in counters]
+        # Gauges: NaN encodes "never set" (max-reduction below skips NaN).
+        vec += [math.nan if g.value is None else g.value for _, g in gauges]
+        for _, h in histograms:
+            with h._lock:
+                vec += [float(h.n), h.total]
+                vec += [-h.vmin, h.vmax]  # negate min → one max-reduction
+                vec += [float(c) for c in h.counts]
+        want = len(vec) if vec else 1
+        rows = gather(vec if vec else [0.0])
+        hosts = len(rows)
+        bad = [p for p in range(hosts) if len(rows[p]) != want]
+        if bad:
+            raise ValueError(
+                f"metrics merge misaligned: host rows {bad} carry "
+                f"{[len(rows[p]) for p in bad]} value(s), this process "
+                f"expects {want} — a metric was registered on some hosts "
+                "only (register divergent metrics up front)"
+            )
+
+        def col(j: int) -> list[float]:
+            return [float(rows[p][j]) for p in range(hosts)]
+
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        j = 0
+        for name, _ in counters:
+            out["counters"][name] = round(sum(col(j)), 6)
+            j += 1
+        for name, _ in gauges:
+            vals = [v for v in col(j) if not math.isnan(v)]
+            out["gauges"][name] = round(max(vals), 6) if vals else None
+            j += 1
+        for name, _ in histograms:
+            n = int(round(sum(col(j))))
+            total = sum(col(j + 1))
+            vmin = -max(col(j + 2))
+            vmax = max(col(j + 3))
+            counts = [
+                int(round(sum(col(j + 4 + k)))) for k in range(_N_BUCKETS)
+            ]
+            j += 4 + _N_BUCKETS
+            out["histograms"][name] = _merged_summary(n, total, vmin, vmax, counts)
+        return out, hosts
+
+    # --------------------------------------------------- Prometheus exposition
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format (the ``/metrics`` endpoint).
+
+        Counters gain the conventional ``_total`` suffix; histograms emit
+        the standard cumulative ``_bucket{le=...}`` series (only buckets
+        with observations, plus ``+Inf``), ``_sum`` and ``_count``."""
+        lines: list[str] = []
+        snap_lock = self._lock
+        with snap_lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            histograms = sorted(self._histograms.items())
+        for name, c in counters:
+            p = prom_name(name) + "_total"
+            lines += [f"# TYPE {p} counter", f"{p} {_fnum(c.value)}"]
+        for name, g in gauges:
+            if g.value is None:
+                continue
+            p = prom_name(name)
+            lines += [f"# TYPE {p} gauge", f"{p} {_fnum(g.value)}"]
+        for name, h in histograms:
+            p = prom_name(name)
+            lines.append(f"# TYPE {p} histogram")
+            with h._lock:
+                counts, n, total = list(h.counts), h.n, h.total
+            cum = 0
+            for i, c in enumerate(counts):
+                if c:
+                    cum += c
+                    le = _fnum(_bucket_upper(i))
+                    lines.append(f'{p}_bucket{{le="{le}"}} {cum}')
+            lines.append(f'{p}_bucket{{le="+Inf"}} {n}')
+            lines.append(f"{p}_sum {_fnum(total)}")
+            lines.append(f"{p}_count {n}")
+        return "\n".join(lines) + "\n"
+
+
+def _fnum(v: float) -> str:
+    """Prometheus float formatting: integers bare, floats with up to 6
+    significant decimals (stable — no scientific notation surprises for
+    the magnitudes this repo measures)."""
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.6g}"
+
+
+def _merged_summary(
+    n: int, total: float, vmin: float, vmax: float, counts: list[int]
+) -> dict:
+    """Histogram summary from merged raw state — same shape as
+    ``Histogram.summary`` so single- and multi-host snapshots render alike."""
+    if n <= 0:
+        return {"count": 0}
+    out = {
+        "count": n,
+        "sum": round(total, 6),
+        "min": round(vmin, 6),
+        "max": round(vmax, 6),
+    }
+    for label, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+        rank = max(1, math.ceil(q * n))
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank:
+                est = vmin if i == 0 else _bucket_mid(i)
+                out[label] = round(min(max(est, vmin), vmax), 6)
+                break
+    return out
+
+
+def resolve_metric(snapshot: Mapping, metric: str) -> float | None:
+    """Read one metric out of a ``snapshot()`` dict by name — the SLO
+    monitor's (and any controller's) lookup:
+
+    - ``"name"`` → counter value, else gauge value;
+    - ``"name:p50" | ":p95" | ":p99" | ":mean" | ":count"`` → that
+      histogram statistic.
+
+    None when the metric (or its histogram data) doesn't exist yet — a
+    rule on a not-yet-published metric simply hasn't observed anything.
+    """
+    name, _, stat = metric.rpartition(":")
+    if name and stat in ("p50", "p95", "p99", "mean", "count"):
+        h = snapshot.get("histograms", {}).get(name)
+        if h is None or h.get("count", 0) == 0:
+            return None
+        if stat == "count":
+            return float(h["count"])
+        if stat == "mean":
+            return h["sum"] / h["count"]
+        return h.get(stat)
+    if metric in snapshot.get("counters", {}):
+        return snapshot["counters"][metric]
+    return snapshot.get("gauges", {}).get(metric)

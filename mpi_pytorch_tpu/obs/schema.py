@@ -23,6 +23,8 @@ Record kinds (every record also carries ``ts``, the epoch-seconds stamp
 | serve_bench | mode, buckets, max_wait_ms, requests, p50_ms, p95_ms, p99_ms, images_per_sec | model, offered_rps, rejected, mean_fill_ratio, compiles_after_warmup, chips |
 | resume    | epoch, to_devices                                   | from_devices, from_mesh, to_mesh, path, zero_shards_from, zero_shards_to, corrupt_skipped, strategy |
 | fault     | reason                                              | epoch, step, detail, streak |
+| metrics   | counters, gauges, histograms                        | merged_hosts |
+| alert     | rule, severity                                      | metric, value, threshold, streak, action, detail, epoch, step |
 
 ``serve`` is the per-flush record the online inference server writes
 (serve/server.py: one coalesced batch dispatched to a bucket executable);
@@ -59,7 +61,13 @@ from typing import Any, Mapping
 #      restore) and ``fault`` (an observed preemption/fault signal), plus
 #      the ``serve`` record's optional ``preprocess_failures`` /
 #      ``worker_respawns`` counts — ISSUE 7 / ROADMAP item 4.
-SCHEMA_VERSION = 3
+#   4: the live-telemetry kinds ``metrics`` (a point-in-time snapshot of
+#      the in-process metrics registry, ``obs/metrics.py`` — counters,
+#      gauges, and histogram summaries with sketch-derived p50/p95/p99)
+#      and ``alert`` (one SLO-rule breach from the monitor,
+#      ``obs/monitor.py``: the rule that fired, the observed value vs its
+#      threshold, and the action(s) taken) — ISSUE 8.
+SCHEMA_VERSION = 4
 
 _NUM = (int, float)
 _INT = (int,)
@@ -89,6 +97,10 @@ REQUIRED: dict[str, dict[str, tuple]] = {
     },
     "resume": {"epoch": _INT, "to_devices": _INT},
     "fault": {"reason": (str,)},
+    # v4: live-telemetry snapshot (the three registry sections; each a
+    # name → value/summary object) and SLO alerts.
+    "metrics": {"counters": (dict,), "gauges": (dict,), "histograms": (dict,)},
+    "alert": {"rule": (str,), "severity": (str,)},
 }
 
 OPTIONAL: dict[str, dict[str, tuple]] = {
@@ -121,6 +133,15 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         "corrupt_skipped": _INT, "strategy": (str,),
     },
     "fault": {"epoch": _INT, "step": _INT, "detail": (str,), "streak": _INT},
+    "metrics": {
+        # How many hosts' registries were merged into this snapshot
+        # (absent on single-host runs — the local registry IS the merge).
+        "merged_hosts": _INT,
+    },
+    "alert": {
+        "metric": (str,), "value": _NUM, "threshold": _NUM, "streak": _INT,
+        "action": (str,), "detail": (str,), "epoch": _INT, "step": _INT,
+    },
 }
 
 

@@ -114,11 +114,15 @@ def host_allgather(values) -> "Any":
     contribution (≙ ``comm.allgather`` — the one reference collective with no
     in-step equivalent here, because auto-partitioned jit never needs it).
 
-    This is the telemetry exchange path (``obs/heartbeat.py``): step-time /
-    throughput rows, a few floats per host, NOT tensors — the device hop is
-    one tiny collective over the same ICI/DCN fabric as the gradient
-    all-reduce. Every process must call it at the same point (it is a
-    collective); single-process is the identity with a leading axis."""
+    This is the telemetry exchange path, with two consumers: the step-time
+    heartbeat (``obs/heartbeat.py``) and the metrics-registry cross-host
+    merge (``obs/metrics.py MetricsRegistry.merged`` — counters/histogram
+    buckets sum, gauges max, one flat vector per process). Rows are a few
+    floats per host, NOT tensors — the device hop is one tiny collective
+    over the same ICI/DCN fabric as the gradient all-reduce. Every process
+    must call it at the same point (it is a collective; the trainer
+    snapshots the registry on a step-count cadence for exactly that
+    reason); single-process is the identity with a leading axis."""
     import numpy as np
 
     vals = np.atleast_1d(np.asarray(values, np.float32))

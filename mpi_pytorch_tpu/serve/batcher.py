@@ -83,6 +83,11 @@ class PendingRequest:
     payload: Any  # np image, or a concurrent Future resolving to one
     future: Any  # concurrent.futures.Future -> np int32 [topk]
     t_submit: float = field(default_factory=time.monotonic)
+    # Per-request trace id (server-assigned, monotone per process): the
+    # same id appears on the request's enqueue marker and on every batch
+    # phase span it rides (preprocess/dispatch/fetch), so one request's
+    # path threads through the trace end to end. -1 = untraced.
+    req_id: int = -1
 
 
 class DynamicBatcher:

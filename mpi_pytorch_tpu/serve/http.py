@@ -1,0 +1,79 @@
+"""Lightweight HTTP exposition for the serve replica's live telemetry.
+
+One daemon ``ThreadingHTTPServer`` per ``InferenceServer`` (opt-in:
+``--serve-metrics-port``), serving three read-only endpoints off the live
+``MetricsRegistry`` — the scrape surface a Prometheus collector or ROADMAP
+item 1's fleet controller polls without touching the record stream:
+
+- ``/metrics``  — Prometheus text exposition (``registry.prometheus_text``);
+- ``/metricsz`` — the JSON registry snapshot (counters / gauges /
+  histogram summaries with sketch p50/p95/p99) — the controller-friendly
+  form, no Prometheus parsing required;
+- ``/healthz``  — liveness JSON from the server's stats callback (queue
+  depth, compiles-after-warmup, served/rejected counters).
+
+The handler never blocks the serve path: every read is a registry
+snapshot under its own small locks; request handling runs on the HTTP
+server's threads. Binds 127.0.0.1 by default — exposure beyond the host
+is a deployment decision (front it with the fleet router / a sidecar),
+not a default.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class ObsHTTPServer:
+    """Serve /metrics, /metricsz, /healthz for one registry."""
+
+    def __init__(self, registry, healthz=None, port: int = 0, host: str = "127.0.0.1"):
+        self.registry = registry
+        self.healthz = healthz
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                try:
+                    if self.path.split("?")[0] == "/metrics":
+                        body = outer.registry.prometheus_text().encode()
+                        ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    elif self.path.split("?")[0] == "/metricsz":
+                        body = json.dumps(outer.registry.snapshot()).encode()
+                        ctype = "application/json"
+                    elif self.path.split("?")[0] == "/healthz":
+                        payload = outer.healthz() if outer.healthz else {"status": "ok"}
+                        body = json.dumps(payload).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:  # noqa: BLE001 — a scrape must not kill serving
+                    self.send_error(500, str(e))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request stderr noise
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serve-obs-http", daemon=True
+        )
+        self._thread.start()
+
+    def url(self, path: str = "/metrics") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)

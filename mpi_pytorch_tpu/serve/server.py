@@ -36,6 +36,7 @@ contract, not a serving one.
 
 from __future__ import annotations
 
+import itertools
 import os
 import queue
 import threading
@@ -101,7 +102,13 @@ class InferenceServer:
         import jax
 
         from mpi_pytorch_tpu.config import apply_runtime_flags
-        from mpi_pytorch_tpu.obs import Tracer
+        from mpi_pytorch_tpu.obs import (
+            FlightRecorder,
+            MetricsRegistry,
+            SLOMonitor,
+            Tracer,
+            parse_rules,
+        )
         from mpi_pytorch_tpu.utils.logging import MetricsWriter, run_logger
 
         apply_runtime_flags(cfg)
@@ -137,38 +144,106 @@ class InferenceServer:
         self._metrics = metrics or MetricsWriter(cfg.metrics_file)
         self._owns_metrics = metrics is None
         self._tracer = Tracer(cfg.trace_file)
+        # Anomaly flight recorder: tap the metrics writer so every record
+        # enters the ring and any fault/alert record dumps it (obs/flight.py).
+        self._flight = None
+        if cfg.flight_dir:
+            self._flight = FlightRecorder(
+                cfg.flight_dir, capacity=cfg.flight_records,
+                profile_window_s=cfg.flight_profile_window_s,
+            )
+            self._metrics = self._flight.tap(self._metrics)
+        # Live metrics registry — the serve replica's queryable aggregate
+        # (the /metrics scrape surface, and the read-path ROADMAP item 1's
+        # controller retunes bucket sets / max_wait_ms from). Always on:
+        # the request path pays one pre-bound counter inc; everything else
+        # updates per FLUSH on the completion loop, off the request path.
+        self._registry = MetricsRegistry()
+        self._m_requests = self._registry.counter("serve/requests")
+        self._m_rejected = self._registry.counter("serve/rejected")
+        self._m_served = self._registry.counter("serve/served")
+        self._m_flush_ms = self._registry.histogram("serve/flush_ms")
+        self._m_req_ms = self._registry.histogram("serve/request_latency_ms")
+        self._m_qwait_ms = self._registry.histogram("serve/queue_wait_ms")
+        self._m_dev_ms = self._registry.histogram("serve/device_ms")
+        self._m_fill = self._registry.histogram("serve/fill_pct")
+        self._g_qdepth = self._registry.gauge("serve/queue_depth")
+        self._g_compiles = self._registry.gauge("serve/compiles_after_warmup")
+        self._monitor = None
+        if cfg.slo_rules:
+            self._monitor = SLOMonitor(
+                self._registry, parse_rules(cfg.slo_rules),
+                metrics=self._metrics, preempt_path=cfg.preempt_file,
+                tracer=self._tracer, logger=self._logger,
+            )
+        self._req_ids = itertools.count()
+        self._sinks_closed = False
+        self._close_started = False
+        self._http = None
+        # SLO evaluation is driven from BOTH ends: per completed flush
+        # (fine-grained, the happy path) and — throttled — from the submit
+        # path, so a total outage (no flush ever completes, every submit
+        # rejected) still evaluates its rate/latency rules instead of
+        # going silent at exactly the moment the monitor exists for.
+        self._slo_eval_interval = 1.0
+        self._last_slo_eval = 0.0
+        self._slo_eval_lock = threading.Lock()
 
-        self._exe = BucketExecutables(cfg, state, mesh, logger=self._logger)
-        self.buckets = self._exe.buckets
-        self.topk = self._exe.topk
-        self._exe.warmup()  # zero steady-state compiles from here on
+        # From here on __init__ can fail mid-way (executable build/warmup
+        # compiles, thread spin-up): flush the obs sinks on THAT path too —
+        # the aborted startup is exactly the one whose trace is needed
+        # (the trainer's failure-path discipline).
+        try:
+            self._exe = BucketExecutables(cfg, state, mesh, logger=self._logger)
+            self.buckets = self._exe.buckets
+            self.topk = self._exe.topk
+            self._exe.warmup()  # zero steady-state compiles from here on
 
-        self._batcher = DynamicBatcher(
-            self.buckets, cfg.serve_max_wait_ms / 1e3, cfg.serve_queue_depth
-        )
-        self._pool = ThreadPoolExecutor(
-            max_workers=max(1, cfg.loader_workers),
-            thread_name_prefix="serve-prep",
-        )
-        # Depth-2 in-flight queue = double buffering: the batch loop may run
-        # one batch ahead of the completion loop, no further (bounding device
-        # queue growth under burst load).
-        self._inflight: queue.Queue = queue.Queue(maxsize=2)
-        self._abandon = False
-        self._lock = threading.Lock()
-        self._stats = {
-            "served": 0, "failed": 0, "rejected": 0, "batches": 0,
-            "padded_rows": 0, "preprocess_failures": 0, "worker_respawns": 0,
-            "by_bucket": {b: 0 for b in self.buckets},
-        }
-        self._batch_thread = threading.Thread(
-            target=self._batch_loop, name="serve-batch", daemon=True
-        )
-        self._completion_thread = threading.Thread(
-            target=self._completion_loop, name="serve-fetch", daemon=True
-        )
-        self._batch_thread.start()
-        self._completion_thread.start()
+            self._batcher = DynamicBatcher(
+                self.buckets, cfg.serve_max_wait_ms / 1e3, cfg.serve_queue_depth
+            )
+            self._pool = ThreadPoolExecutor(
+                max_workers=max(1, cfg.loader_workers),
+                thread_name_prefix="serve-prep",
+            )
+            # Depth-2 in-flight queue = double buffering: the batch loop may run
+            # one batch ahead of the completion loop, no further (bounding device
+            # queue growth under burst load).
+            self._inflight: queue.Queue = queue.Queue(maxsize=2)
+            self._abandon = False
+            self._lock = threading.Lock()
+            self._stats = {
+                "served": 0, "failed": 0, "rejected": 0, "batches": 0,
+                "padded_rows": 0, "preprocess_failures": 0, "worker_respawns": 0,
+                "by_bucket": {b: 0 for b in self.buckets},
+            }
+            self._batch_thread = threading.Thread(
+                target=self._batch_loop, name="serve-batch", daemon=True
+            )
+            self._completion_thread = threading.Thread(
+                target=self._completion_loop, name="serve-fetch", daemon=True
+            )
+            self._batch_thread.start()
+            self._completion_thread.start()
+            if cfg.serve_metrics_port:
+                from mpi_pytorch_tpu.serve.http import ObsHTTPServer
+
+                self._http = ObsHTTPServer(
+                    self._registry, healthz=self._healthz,
+                    port=max(0, cfg.serve_metrics_port),
+                )
+                self._logger.info(
+                    "serve: obs endpoints at %s (/metrics /metricsz /healthz)",
+                    self._http.url(""),
+                )
+        except BaseException:
+            # A failure mid-construction (warmup compile, HTTP port bind)
+            # must not orphan whatever already started: stop the pipeline
+            # pieces that exist, then flush the obs sinks — a retry loop
+            # around a failing bind must not accumulate live thread pairs.
+            self._teardown_partial_pipeline()
+            self._shutdown_sinks()
+            raise
         self._logger.info(
             "serve: %d bucket executable(s) %s warm (topk=%d, fused_head=%s, "
             "max_wait=%.1f ms, queue=%d) — steady state compiles: 0 by "
@@ -220,14 +295,26 @@ class InferenceServer:
         if self._batcher.closed:
             raise ServerClosedError("server is shut down")
         fut: Future = Future()
+        rid = next(self._req_ids)
+        self._m_requests.inc()
+        if self._tracer.enabled:
+            # The enqueue end of the per-request trace thread: the same id
+            # reappears in the req_ids args of every batch-phase span this
+            # request rides (preprocess → dispatch → fetch).
+            self._tracer.instant("serve/enqueue", args={"req": rid})
         payload = self._submit_preprocess(image)
         try:
-            self._batcher.submit(PendingRequest(payload=payload, future=fut))
+            self._batcher.submit(
+                PendingRequest(payload=payload, future=fut, req_id=rid)
+            )
         except QueueFullError:
             with self._lock:
                 self._stats["rejected"] += 1
+            self._m_rejected.inc()
+            self._maybe_evaluate_slo()
             payload.cancel()
             raise
+        self._maybe_evaluate_slo()
         return fut
 
     def predict_batch(self, images, timeout: float | None = None) -> np.ndarray:
@@ -353,7 +440,10 @@ class InferenceServer:
                 # done — they started at submit time). A bad request fails
                 # its own future only; the batch goes on without it.
                 rows, good, prep_failures = [], [], 0
-                with self._tracer.span("serve/preprocess", args={"n": len(flush)}):
+                prep_args = {"n": len(flush)}
+                if self._tracer.enabled:
+                    prep_args["req_ids"] = [r.req_id for r in flush]
+                with self._tracer.span("serve/preprocess", args=prep_args):
                     for req in flush:
                         try:
                             rows.append(req.payload.result())
@@ -390,9 +480,10 @@ class InferenceServer:
                 bucket = pick_bucket(len(good), self.buckets)
                 labels = np.full((len(good),), -1, np.int32)
                 images, labels = pad_batch(np.stack(rows), labels, bucket)
-                with self._tracer.span(
-                    "serve/dispatch", args={"bucket": bucket, "requests": len(good)}
-                ):
+                dispatch_args = {"bucket": bucket, "requests": len(good)}
+                if self._tracer.enabled:
+                    dispatch_args["req_ids"] = [r.req_id for r in good]
+                with self._tracer.span("serve/dispatch", args=dispatch_args):
                     preds = self._exe(bucket, self._exe.place(images, labels))
                 self._inflight.put(
                     _InFlight(
@@ -420,9 +511,10 @@ class InferenceServer:
             if item is None:
                 return
             try:
-                with self._tracer.span(
-                    "serve/fetch", args={"bucket": item.bucket}
-                ):
+                fetch_args = {"bucket": item.bucket}
+                if self._tracer.enabled:
+                    fetch_args["req_ids"] = [r.req_id for r in item.requests]
+                with self._tracer.span("serve/fetch", args=fetch_args):
                     # The ONLY device readback on the serve path: tiny int32
                     # top-k rows. Blocks until the dispatched forward is
                     # done — meanwhile the batch loop is already
@@ -430,8 +522,6 @@ class InferenceServer:
                     rows = np.asarray(jax.device_get(item.preds))
                 t_done = time.monotonic()
                 rows = rows.reshape(rows.shape[0], -1)  # [bucket] -> [bucket, 1]
-                for i, req in enumerate(item.requests):
-                    req.future.set_result(rows[i].astype(np.int32, copy=False))
                 n = len(item.requests)
                 with self._lock:
                     self._stats["served"] += n
@@ -456,6 +546,29 @@ class InferenceServer:
                     with self._lock:
                         record["worker_respawns"] = self._stats["worker_respawns"]
                 self._metrics.write(record)
+                # Live registry: per-flush aggregates (the /metrics p99 the
+                # acceptance test matches against this record stream) plus
+                # honest per-REQUEST latency (each request's own submit →
+                # result, not just the oldest's).
+                self._m_served.inc(n)
+                self._m_flush_ms.observe(record["total_ms"])
+                self._m_qwait_ms.observe(record["queue_wait_ms"])
+                self._m_dev_ms.observe(record["device_ms"])
+                self._m_fill.observe(100.0 * record["fill_ratio"])
+                for req in item.requests:
+                    self._m_req_ms.observe(1e3 * (t_done - req.t_submit))
+                self._g_qdepth.set(record["queue_depth"])
+                self._g_compiles.set(self._exe.compiles_since_warmup())
+                self._maybe_evaluate_slo(force=True)
+                # Futures resolve LAST: by the time a caller observes its
+                # result, the flush is already visible in the record
+                # stream and the registry — a controller (or test) that
+                # scrapes right after predict_batch returns sees this
+                # flush, never a torn read. (On a failure above, _fail in
+                # the handler below still resolves the not-done futures
+                # with the error — callers never hang.)
+                for i, req in enumerate(item.requests):
+                    req.future.set_result(rows[i].astype(np.int32, copy=False))
             except BaseException as e:  # noqa: BLE001 — keep serving
                 self._logger.error("serve completion loop error: %s", e)
                 self._fail(item.requests, e)
@@ -485,21 +598,117 @@ class InferenceServer:
         out["buckets"] = list(self.buckets)
         return out
 
+    def registry_snapshot(self) -> dict:
+        """The live registry's snapshot — the in-process read a colocated
+        controller uses (the HTTP /metricsz endpoint serves the same)."""
+        return self._registry.snapshot()
+
+    @property
+    def metrics_port(self) -> int | None:
+        """The obs HTTP port (None when --serve-metrics-port is off) —
+        read this back when binding ephemeral (-1)."""
+        return self._http.port if self._http is not None else None
+
+    def _teardown_partial_pipeline(self) -> None:
+        """Best-effort stop of whatever pipeline pieces a failed
+        ``__init__`` had already started (attribute-guarded: the crash may
+        precede any of them)."""
+        batcher = getattr(self, "_batcher", None)
+        if batcher is not None:
+            batcher.close()
+        for name in ("_batch_thread", "_completion_thread"):
+            thread = getattr(self, name, None)
+            if thread is not None and thread.is_alive():
+                thread.join(timeout=10)
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    def _maybe_evaluate_slo(self, force: bool = False) -> None:
+        """Run the monitor, throttled (submit path) or forced (per flush).
+        Non-blocking across threads: rule state is not thread-safe, so
+        concurrent callers skip rather than queue."""
+        if self._monitor is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_slo_eval < self._slo_eval_interval:
+            return
+        if not self._slo_eval_lock.acquire(blocking=False):
+            return
+        try:
+            self._last_slo_eval = now
+            self._monitor.evaluate()
+        finally:
+            self._slo_eval_lock.release()
+
+    def _healthz(self) -> dict:
+        stats = self.stats()
+        return {
+            "status": "ok" if not self._batcher.closed else "closing",
+            "queue_depth": stats["queue_depth"],
+            "compiles_after_warmup": stats["compiles_after_warmup"],
+            "served": stats["served"],
+            "rejected": stats["rejected"],
+            "buckets": stats["buckets"],
+        }
+
+    def _shutdown_sinks(self) -> None:
+        """Flush/close every obs sink exactly once — reached from the
+        normal ``close()``, from a repeated ``close()`` (idempotent no-op),
+        and from the ``__init__`` failure path, where a warmup crash must
+        still leave the trace/flight evidence on disk (the satellite fix:
+        shutdown used to leave per-process sinks unflushed when the drain
+        path died part-way)."""
+        if self._sinks_closed:
+            return
+        self._sinks_closed = True
+        if self._http is not None:
+            try:
+                self._http.close()
+            except Exception as e:  # noqa: BLE001
+                self._logger.warning("serve obs-http close failed: %s", e)
+        try:
+            # Final registry snapshot: even a short-lived server leaves one
+            # kind="metrics" record summarizing its whole life.
+            self._metrics.write(self._registry.snapshot_record())
+        except Exception as e:  # noqa: BLE001
+            self._logger.warning("serve final metrics snapshot failed: %s", e)
+        if self._owns_metrics:
+            try:
+                self._metrics.close()
+            except Exception as e:  # noqa: BLE001
+                self._logger.warning("serve metrics close failed: %s", e)
+        try:
+            trace_out = self._tracer.close()
+            if trace_out:
+                self._logger.info("serve trace spans written to %s", trace_out)
+        except Exception as e:  # noqa: BLE001
+            self._logger.warning("serve trace close failed: %s", e)
+        if self._flight is not None:
+            try:
+                self._flight.close()
+            except Exception as e:  # noqa: BLE001
+                self._logger.warning("serve flight close failed: %s", e)
+
     def close(self, drain: bool = True) -> None:
         """Stop admissions and shut down. ``drain=True`` (default) flushes
         every queued request before returning — graceful drain; ``False``
-        fails queued requests with ``ServerClosedError``."""
+        fails queued requests with ``ServerClosedError``. Idempotent: a
+        second call is a no-op, and the obs sinks (trace/metrics/flight/
+        http) flush even when the drain path itself raises."""
+        with self._lock:
+            if self._close_started:
+                return
+            self._close_started = True
         if not drain:
             self._abandon = True
-        self._batcher.close()
-        self._batch_thread.join()
-        self._completion_thread.join()
-        self._pool.shutdown(wait=True)
-        if self._owns_metrics:
-            self._metrics.close()
-        trace_out = self._tracer.close()
-        if trace_out:
-            self._logger.info("serve trace spans written to %s", trace_out)
+        try:
+            self._batcher.close()
+            self._batch_thread.join()
+            self._completion_thread.join()
+            self._pool.shutdown(wait=True)
+        finally:
+            self._shutdown_sinks()
 
     def __enter__(self) -> "InferenceServer":
         return self

@@ -381,17 +381,22 @@ class FaultInjector:
       n-th completed train step — a hard crash with the async checkpoint
       writer possibly mid-write, exactly what the atomic tmp+rename
       discipline must survive;
-    - ``MPT_FAULT_DELAY_STEP_MS=m`` (+ ``MPT_FAULT_DELAY_PROCESS=k``):
-      sleep m ms inside every timed step (on process k only, if set) — a
-      fake straggler the heartbeat/watchdog stack must flag.
+    - ``MPT_FAULT_DELAY_STEP_MS=m`` (+ ``MPT_FAULT_DELAY_PROCESS=k``,
+      ``MPT_FAULT_DELAY_AFTER_STEP=j``): sleep m ms inside every timed
+      step (on process k only, if set; only after the first j clean steps,
+      if set) — a fake straggler the heartbeat/watchdog stack must flag,
+      appearing mid-run when j > 0 so the SLO monitor's warmup-baseline
+      drift rules (obs/monitor.py) see a clean "normal" first.
     """
 
     def __init__(self, metrics=None):
         self.kill_at_step = env_int("MPT_FAULT_KILL_AT_STEP", 0)
         self.delay_ms = env_int("MPT_FAULT_DELAY_STEP_MS", 0)
         self.delay_process = env_int("MPT_FAULT_DELAY_PROCESS", -1)
+        self.delay_after = env_int("MPT_FAULT_DELAY_AFTER_STEP", 0)
         self.metrics = metrics
         self._steps = 0
+        self._delay_calls = 0
 
     @property
     def active(self) -> bool:
@@ -399,10 +404,14 @@ class FaultInjector:
 
     def maybe_delay(self) -> None:
         """The straggler fake — called inside the step's timed region so
-        heartbeats attribute the delay to this host's step time."""
-        if self.delay_ms > 0 and (
-            self.delay_process < 0 or process_index() == self.delay_process
-        ):
+        heartbeats attribute the delay to this host's step time. With
+        ``MPT_FAULT_DELAY_AFTER_STEP`` the first j steps stay clean."""
+        if self.delay_ms <= 0:
+            return
+        self._delay_calls += 1
+        if self._delay_calls <= self.delay_after:
+            return
+        if self.delay_process < 0 or process_index() == self.delay_process:
             time.sleep(self.delay_ms / 1e3)
 
     def after_step(self, epoch: int, step: int) -> None:

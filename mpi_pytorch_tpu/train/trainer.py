@@ -32,7 +32,15 @@ from mpi_pytorch_tpu import checkpoint as ckpt
 from mpi_pytorch_tpu.config import Config
 from mpi_pytorch_tpu.data import DataLoader, load_manifests
 from mpi_pytorch_tpu.models import create_model_bundle
-from mpi_pytorch_tpu.obs import Heartbeat, StepHealth, Tracer
+from mpi_pytorch_tpu.obs import (
+    FlightRecorder,
+    Heartbeat,
+    MetricsRegistry,
+    SLOMonitor,
+    StepHealth,
+    Tracer,
+    parse_rules,
+)
 from mpi_pytorch_tpu.parallel.mesh import create_mesh, flat_mesh, shard_batch
 from mpi_pytorch_tpu.train import elastic
 from mpi_pytorch_tpu.train.state import (
@@ -544,14 +552,35 @@ def train(cfg: Config) -> TrainSummary:
     # the NaN sentinel, and the multi-host straggler heartbeat. All inert
     # unless their knobs are set (the sentinel's epoch check is free).
     tracer = Tracer(cfg.trace_file)
+    # Anomaly flight recorder (obs/flight.py): tap the metrics writer so
+    # every record on EVERY process enters the ring (only process 0's
+    # writer persists the stream), and any fault/alert record dumps it.
+    flight = None
+    if cfg.flight_dir:
+        flight = FlightRecorder(
+            cfg.flight_dir, capacity=cfg.flight_records,
+            profile_window_s=cfg.flight_profile_window_s,
+        )
+        metrics = flight.tap(metrics)
+    # Live metrics registry + SLO monitor (obs/metrics.py, obs/monitor.py):
+    # built only when a live consumer is configured — the default hot path
+    # never touches either.
+    registry = monitor = None
+    if cfg.slo_rules or cfg.metrics_every_steps:
+        registry = MetricsRegistry()
+    if cfg.slo_rules:
+        monitor = SLOMonitor(
+            registry, parse_rules(cfg.slo_rules), metrics=metrics,
+            preempt_path=cfg.preempt_file, tracer=tracer, logger=logger,
+        )
     health = StepHealth(
         metrics, step_metrics=cfg.step_metrics, nan_sentinel=cfg.nan_sentinel,
-        tracer=tracer,
+        tracer=tracer, registry=registry,
     )
     heartbeat = Heartbeat(
         metrics, every_steps=cfg.heartbeat_every_steps,
         threshold=cfg.straggler_threshold, batch_images=cfg.batch_size,
-        tracer=tracer,
+        tracer=tracer, registry=registry,
     )
     if heartbeat.enabled and cfg.device_cache and cfg.scan_epoch:
         # The scan runs the whole epoch on device — there are no per-step
@@ -564,27 +593,49 @@ def train(cfg: Config) -> TrainSummary:
             cfg.heartbeat_every_steps,
         )
         heartbeat.enabled = False
+    if registry is not None and cfg.metrics_every_steps and cfg.device_cache and cfg.scan_epoch:
+        # Same silent-degrade class: the scan path has no per-step host
+        # boundaries, so the snapshot cadence never advances — only the
+        # run-end snapshot lands. (slo_rules + scan_epoch is already a
+        # config ERROR; a reduced snapshot cadence merely degrades.)
+        run_logger().warning(
+            "metrics_every_steps=%d has no per-step cadence with "
+            "scan_epoch=True (the epoch is one device-side scan); only "
+            "the final kind='metrics' snapshot will be written",
+            cfg.metrics_every_steps,
+        )
     # Per-step telemetry must observe step COMPLETION, not dispatch: block
     # on the step's metrics before timestamping (documented cost of
-    # step_metrics/heartbeat; the default loop stays fully async).
-    telemetry_sync = health.enabled or heartbeat.enabled
+    # step_metrics/heartbeat; registry step-time gauges/histograms must be
+    # completion times too, so a live registry also syncs; the default
+    # loop stays fully async).
+    telemetry_sync = health.enabled or heartbeat.enabled or registry is not None
     try:
         return _train_impl(
-            cfg, logger, metrics, tracer, health, heartbeat, telemetry_sync
+            cfg, logger, metrics, tracer, health, heartbeat, telemetry_sync,
+            registry, monitor, flight,
         )
     except BaseException:
         # A failure anywhere — including build/cache/compile, BEFORE the
         # epoch loop's own handler exists — must still flush the buffered
         # spans: the aborted run is exactly the one whose trace is needed.
+        # The flight recorder dumps its last-moments ring the same way.
         try:
             tracer.close()
         except BaseException as terr:
             logger.warning("trace write also failed: %s", terr)
+        if flight is not None:
+            try:
+                flight.dump("crash")
+                flight.close()
+            except BaseException as ferr:
+                logger.warning("flight-recorder dump also failed: %s", ferr)
         raise
 
 
 def _train_impl(
-    cfg: Config, logger, metrics, tracer, health, heartbeat, telemetry_sync
+    cfg: Config, logger, metrics, tracer, health, heartbeat, telemetry_sync,
+    registry=None, monitor=None, flight=None,
 ) -> TrainSummary:
     with tracer.span("build"):
         mesh = None
@@ -840,6 +891,8 @@ def _train_impl(
                 },
             )
         health.set_sync(overlap_frac=_overlap)
+        if registry is not None:
+            registry.gauge("train/overlap_frac").set(_overlap)
         logger.info(
             "grad-sync buckets: %d × ~%.0f MiB (reverse-topo issue order), "
             "%.0f%% of sync bytes overlap-eligible%s",
@@ -857,6 +910,18 @@ def _train_impl(
             "lower it to at most the per-epoch step count",
             heartbeat.every, n_steps,
         )
+
+    # Live-registry step instrumentation, pre-bound so the loop body does
+    # no registry lookups; snapshot cadence counts STEPS (not wall time)
+    # because the multi-host merge inside snapshot_record is a collective
+    # every process must reach at the same step.
+    h_step_ms = h_wait_ms = g_step_last = None
+    if registry is not None:
+        h_step_ms = registry.histogram("train/step_ms")
+        h_wait_ms = registry.histogram("train/data_wait_ms")
+        g_step_last = registry.gauge("train/step_ms_last")
+    snapshot_merge = jax.process_count() > 1
+    steps_since_snapshot = 0
 
     summary = TrainSummary()
     checkpointer = ckpt.AsyncCheckpointer()
@@ -1013,6 +1078,18 @@ def _train_impl(
                 counts.append(m["count"])
                 health.on_step(epoch, step_i, m, data_wait_s, step_s)
                 heartbeat.on_step(epoch, step_i, step_s)
+                if registry is not None:
+                    h_wait_ms.observe(data_wait_s * 1e3)
+                    h_step_ms.observe(step_s * 1e3)
+                    g_step_last.set(step_s * 1e3)
+                if monitor is not None:
+                    monitor.evaluate(epoch=epoch, step=step_i)
+                if registry is not None and cfg.metrics_every_steps:
+                    steps_since_snapshot += 1
+                    if steps_since_snapshot % cfg.metrics_every_steps == 0:
+                        metrics.write(
+                            registry.snapshot_record(merge=snapshot_merge)
+                        )
                 faults.after_step(epoch, step_i)
                 if cfg.log_every_steps and (step_i + 1) % cfg.log_every_steps == 0:
                     logger.info(
@@ -1065,6 +1142,17 @@ def _train_impl(
                 {"kind": "epoch", "epoch": epoch, "loss": epoch_loss, "time_s": dt,
                  "images_per_sec": ips, "tflops": tflops, "mfu_pct": mfu}
             )
+            if registry is not None:
+                # The MFU-estimate / throughput gauges a fleet controller
+                # (ROADMAP item 1) reads live instead of tailing the stream.
+                # No monitor.evaluate here: rules are defined in per-step
+                # evaluation units (for=/warmup/rate deltas), and a second
+                # pass over the same last-step state would double-count a
+                # single breach; the next epoch's first step evaluates
+                # these gauges instead.
+                registry.gauge("train/images_per_sec").set(ips)
+                if mfu is not None:
+                    registry.gauge("train/mfu_pct").set(mfu)
             if steps_run and n_valid:
                 # Free epoch-granularity sentinel (the loss is already a
                 # host float); zero-valid-row epochs are legitimately NaN.
@@ -1221,7 +1309,14 @@ def _train_impl(
     trace_out = tracer.close()
     if trace_out:
         logger.info("host trace spans written to %s (chrome://tracing)", trace_out)
+    if registry is not None:
+        # Final snapshot so even a run below the step cadence leaves one
+        # kind="metrics" record (all processes reach here together — the
+        # epoch loop breaks by agreement — so the merge collective is safe).
+        metrics.write(registry.snapshot_record(merge=snapshot_merge))
     metrics.close()
+    if flight is not None:
+        flight.close()
     return summary
 
 

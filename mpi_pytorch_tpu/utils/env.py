@@ -63,6 +63,12 @@ FAULT_GATES: dict[str, str] = {
         "restrict MPT_FAULT_DELAY_STEP_MS to this process index "
         "(unset/-1 = every process)"
     ),
+    "MPT_FAULT_DELAY_AFTER_STEP": (
+        "start MPT_FAULT_DELAY_STEP_MS only after this many steps have run "
+        "cleanly (0 = from the first step) — a straggler that APPEARS "
+        "mid-run, so warmup-baseline SLO rules (drift:) have a clean "
+        "baseline to drift from"
+    ),
     "MPT_FAULT_BACKEND_WEDGE_N": (
         "make the first N create_mesh calls in this process raise — the "
         "wedged-backend-init scenario the resume-side retry loop absorbs"

@@ -1,0 +1,131 @@
+"""Anomaly flight recorder (obs/flight.py): ring capacity, the
+MetricsWriter tap, auto-dump on fault/alert records, dump-file contents
+(schema-clean, atomic), the dump cap, and the trainer failure path."""
+
+import json
+import os
+
+from mpi_pytorch_tpu.obs.flight import FlightRecorder
+from mpi_pytorch_tpu.obs.schema import validate_record
+from mpi_pytorch_tpu.utils.logging import MetricsWriter
+
+
+def _step(i):
+    return {"kind": "step", "epoch": 0, "step": i, "loss": 1.0}
+
+
+def test_ring_bounded_and_dump_carries_last_n(tmp_path):
+    fr = FlightRecorder(str(tmp_path), capacity=8)
+    for i in range(50):
+        fr.record({"ts": float(i), **_step(i)})
+    path = fr.dump("manual")
+    data = json.load(open(path))
+    assert data["reason"] == "manual" and data["process"] == 0
+    steps = [r["step"] for r in data["records"]]
+    assert steps == list(range(42, 50))  # exactly the last 8
+    for rec in data["records"]:
+        assert validate_record(rec) == []
+
+
+def test_tap_forwards_and_auto_dumps_on_fault_and_alert(tmp_path):
+    inner = MetricsWriter(str(tmp_path / "m.jsonl"))
+    fr = FlightRecorder(str(tmp_path / "flight"), capacity=16)
+    writer = fr.tap(inner)
+    writer.write(_step(0))
+    writer.write({"kind": "fault", "reason": "injected_kill"})
+    writer.write(_step(1))
+    writer.write(
+        {"kind": "alert", "rule": "p99", "severity": "warn"}
+    )
+    writer.close()
+
+    # The stream still got every record, ts-stamped once.
+    lines = [json.loads(line) for line in open(tmp_path / "m.jsonl")]
+    assert [r["kind"] for r in lines] == ["step", "fault", "step", "alert"]
+    assert all("ts" in r for r in lines)
+
+    dumps = sorted(os.listdir(tmp_path / "flight"))
+    assert len(dumps) == 2
+    assert "fault_injected_kill" in dumps[0] and dumps[0].endswith(".p0.json")
+    assert "alert_p99" in dumps[1]
+    fault_dump = json.load(open(tmp_path / "flight" / dumps[0]))
+    # The dump ends with its own trigger, preceded by the context records.
+    assert [r["kind"] for r in fault_dump["records"]] == ["step", "fault"]
+    alert_dump = json.load(open(tmp_path / "flight" / dumps[1]))
+    assert [r["kind"] for r in alert_dump["records"]] == [
+        "step", "fault", "step", "alert",
+    ]
+
+
+def test_dump_cap_stops_disk_spam(tmp_path):
+    fr = FlightRecorder(str(tmp_path), capacity=4, max_dumps=3)
+    for i in range(10):
+        fr.record({"ts": float(i), "kind": "fault", "reason": f"f{i}"})
+    assert len(os.listdir(tmp_path)) == 3
+    assert fr.dump("manual") is None  # cap reached: refused, not raised
+
+
+def test_closed_recorder_refuses_dumps_keeps_ring(tmp_path):
+    fr = FlightRecorder(str(tmp_path), capacity=4)
+    fr.record({"ts": 0.0, **_step(0)})
+    fr.close()
+    fr.close()  # idempotent
+    assert fr.dump("late") is None
+    assert list(fr._ring)  # evidence still inspectable in-process
+
+
+def test_no_stray_tmp_files_after_dump(tmp_path):
+    """Dumps are atomic (tmp+rename): a reader never sees a half-written
+    evidence file, and no .tmp litter survives."""
+    fr = FlightRecorder(str(tmp_path), capacity=4)
+    fr.record({"ts": 0.0, "kind": "fault", "reason": "x"})
+    names = os.listdir(tmp_path)
+    assert names and not [n for n in names if n.endswith(".tmp")]
+
+
+def test_trainer_crash_path_dumps_flight(tmp_path):
+    """A NaN'd run (the sentinel abort) must leave a crash dump next to
+    the flushed trace — the failure-path discipline the tracer already
+    follows, extended to the flight recorder."""
+    import pytest
+
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.obs import NonFiniteLossError
+    from mpi_pytorch_tpu.train.trainer import train
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = Config()
+    cfg.debug = True
+    cfg.debug_sample_size = 48
+    cfg.train_csv = os.path.join(REPO, "data", "train_sample.csv")
+    cfg.test_csv = os.path.join(REPO, "data", "test_sample.csv")
+    cfg.synthetic_data = True
+    cfg.num_classes = 64
+    cfg.batch_size = 16
+    cfg.width = cfg.height = 16
+    cfg.num_epochs = 2
+    cfg.compute_dtype = "float32"
+    cfg.learning_rate = 1e38  # NaNs within two steps
+    cfg.validate = False
+    cfg.loader_workers = 2
+    cfg.log_every_steps = 0
+    cfg.step_metrics = True
+    cfg.checkpoint_dir = str(tmp_path / "ckpt")
+    cfg.log_file = str(tmp_path / "training.log")
+    cfg.metrics_file = str(tmp_path / "metrics.jsonl")
+    cfg.flight_dir = str(tmp_path / "flight")
+    cfg.validate_config()
+    with pytest.raises(NonFiniteLossError):
+        train(cfg)
+
+    dumps = sorted(os.listdir(cfg.flight_dir))
+    # The anomaly record is not an auto-dump kind, so the evidence comes
+    # from the failure path's explicit crash dump.
+    assert any("crash" in d for d in dumps), dumps
+    crash = json.load(
+        open(os.path.join(cfg.flight_dir, [d for d in dumps if "crash" in d][0]))
+    )
+    kinds = [r["kind"] for r in crash["records"]]
+    assert "anomaly" in kinds and "step" in kinds
+    for rec in crash["records"]:
+        assert validate_record(rec) == []

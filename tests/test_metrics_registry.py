@@ -1,0 +1,280 @@
+"""Live metrics registry (obs/metrics.py): percentile-sketch accuracy
+against exact quantiles on known distributions, merge associativity across
+simulated hosts, Prometheus exposition parseability + counter
+monotonicity, snapshot-record schema, and the metric-resolution helper the
+SLO monitor reads through."""
+
+import math
+import re
+
+import numpy as np
+import pytest
+
+from mpi_pytorch_tpu.obs.metrics import (
+    MetricsRegistry,
+    prom_name,
+    resolve_metric,
+)
+from mpi_pytorch_tpu.obs.schema import validate_record
+
+
+# ---------------------------------------------------------------------------
+# sketch accuracy
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "name,sampler",
+    [
+        ("uniform", lambda rng: rng.uniform(1.0, 1000.0, 20000)),
+        ("lognormal", lambda rng: rng.lognormal(3.0, 1.0, 20000)),
+        ("bimodal", lambda rng: np.concatenate(
+            [rng.normal(5.0, 0.5, 10000), rng.normal(400.0, 20.0, 10000)]
+        )),
+    ],
+)
+def test_sketch_quantiles_within_bucket_error(name, sampler):
+    """p50/p95/p99 within the sketch's documented relative error (~2.2%,
+    half a 2^(1/16) bucket) of the exact empirical quantile — without
+    retaining a single sample."""
+    rng = np.random.default_rng(0)
+    values = np.abs(sampler(rng)) + 1e-6
+    reg = MetricsRegistry()
+    h = reg.histogram("lat")
+    for v in values:
+        h.observe(float(v))
+    s = np.sort(values)
+    for q in (0.50, 0.95, 0.99):
+        exact = float(s[max(0, math.ceil(q * len(s)) - 1)])
+        est = h.quantile(q)
+        assert abs(est - exact) <= 0.05 * exact, (name, q, est, exact)
+
+
+def test_sketch_edge_cases():
+    reg = MetricsRegistry()
+    h = reg.histogram("h")
+    assert h.quantile(0.5) is None  # empty
+    h.observe(0.0)  # underflow bucket: estimate clamps to observed min
+    h.observe(-3.0)
+    h.observe(5.0)
+    assert h.quantile(0.0) == pytest.approx(-3.0)
+    assert h.quantile(1.0) == pytest.approx(5.0)
+    summary = h.summary()
+    assert summary["count"] == 3 and summary["min"] == -3.0
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_single_value_histogram_is_exact():
+    reg = MetricsRegistry()
+    h = reg.histogram("h")
+    for _ in range(100):
+        h.observe(42.0)
+    # Clamping to [vmin, vmax] makes a constant stream exactly recoverable.
+    assert h.quantile(0.5) == 42.0 and h.quantile(0.99) == 42.0
+
+
+# ---------------------------------------------------------------------------
+# merge: associativity + semantics across simulated hosts
+# ---------------------------------------------------------------------------
+
+
+def _host_registry(seed: int) -> MetricsRegistry:
+    rng = np.random.default_rng(seed)
+    reg = MetricsRegistry()
+    reg.counter("reqs").inc(float(rng.integers(1, 50)))
+    reg.gauge("depth").set(float(rng.integers(0, 20)))
+    h = reg.histogram("lat")
+    for v in rng.lognormal(2.0, 0.7, 500):
+        h.observe(float(v))
+    return reg
+
+
+def _flat_vec(reg: MetricsRegistry) -> np.ndarray:
+    """The flat f32 vector ``merged`` would exchange for this registry."""
+    captured = []
+
+    def capture(vec):
+        captured.append(np.asarray(vec, np.float64))
+        return [vec]
+
+    reg.merged(gather=capture)
+    return captured[0]
+
+
+def test_merge_matches_pooled_data():
+    """Merging host sketches must equal the sketch of the POOLED samples:
+    counters sum, gauges max, histogram buckets add — so the cross-host
+    p99 is the p99 the fleet actually served."""
+    regs = [_host_registry(s) for s in (1, 2, 3)]
+    rows = [list(_flat_vec(r)) for r in regs]
+    merged_abc, hosts = regs[0].merged(gather=lambda v: rows)
+    assert hosts == 3
+
+    # Pooled ground truth: one registry fed every host's samples.
+    pooled = MetricsRegistry()
+    rngs = [np.random.default_rng(s) for s in (1, 2, 3)]
+    total_reqs = 0.0
+    depths = []
+    hp = pooled.histogram("lat")
+    for rng in rngs:
+        total_reqs += float(rng.integers(1, 50))
+        depths.append(float(rng.integers(0, 20)))
+        for v in rng.lognormal(2.0, 0.7, 500):
+            hp.observe(float(v))
+    assert merged_abc["counters"]["reqs"] == pytest.approx(total_reqs)
+    assert merged_abc["gauges"]["depth"] == pytest.approx(max(depths))
+    ps = pooled.snapshot()["histograms"]["lat"]
+    ms = merged_abc["histograms"]["lat"]
+    assert ms["count"] == ps["count"] == 1500
+    for k in ("p50", "p95", "p99", "min", "max"):
+        assert ms[k] == pytest.approx(ps[k], rel=1e-6), k
+    assert ms["sum"] == pytest.approx(ps["sum"], rel=1e-4)
+
+
+def test_merge_associative_across_hosts():
+    """Grouping must not matter: merging (A,B) then C gives the same
+    summaries as (A,B,C) in one exchange — the property that lets a
+    hierarchical fleet (per-pod then cross-pod) aggregate in stages."""
+    regs = [_host_registry(s) for s in (1, 2, 3)]
+    rows = [list(_flat_vec(r)) for r in regs]
+    one_shot, _ = regs[0].merged(gather=lambda v: rows)
+
+    # Staged: exchange A+B's raw vectors first, then the partial with C.
+    # The vector encoding is (sums, -min/max trick) — reduce it the same
+    # way merged() does and hand the partial to the second stage.
+    ab = np.asarray(rows[0]) + np.asarray(rows[1])
+    n_gauges = 1  # 'depth' is the only gauge; max-reduce it, not sum
+    g_off = 1  # after the single 'reqs' counter
+    ab[g_off:g_off + n_gauges] = np.maximum(
+        np.asarray(rows[0])[g_off:g_off + n_gauges],
+        np.asarray(rows[1])[g_off:g_off + n_gauges],
+    )
+    # min/max per histogram ride as (-min, max) and max-reduce; the sum
+    # above corrupted them — redo those two slots the reduction way.
+    hist_head = g_off + n_gauges + 2  # [n, total] sum-reduce correctly
+    for slot in (hist_head, hist_head + 1):  # (-vmin, vmax)
+        ab[slot] = max(rows[0][slot], rows[1][slot])
+    staged, _ = regs[0].merged(gather=lambda v: [list(ab), rows[2]])
+    for k in ("count", "sum", "min", "max", "p50", "p95", "p99"):
+        assert staged["histograms"]["lat"][k] == pytest.approx(
+            one_shot["histograms"]["lat"][k], rel=1e-6
+        ), k
+    assert staged["counters"]["reqs"] == pytest.approx(one_shot["counters"]["reqs"])
+    assert staged["gauges"]["depth"] == pytest.approx(one_shot["gauges"]["depth"])
+
+
+def test_merge_single_host_is_identity():
+    reg = _host_registry(7)
+    merged, hosts = reg.merged(gather=lambda v: [v])
+    assert hosts == 1
+    snap = reg.snapshot()
+    assert merged["counters"] == snap["counters"]
+    assert merged["histograms"]["lat"]["p99"] == pytest.approx(
+        snap["histograms"]["lat"]["p99"]
+    )
+
+
+def test_merged_unset_gauges_stay_null():
+    reg = MetricsRegistry()
+    reg.gauge("never_set")
+    reg.counter("c").inc()
+    merged, _ = reg.merged(gather=lambda v: [v, v])
+    assert merged["gauges"]["never_set"] is None
+    assert merged["counters"]["c"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+_EXPO_LINE = re.compile(
+    r'^(# (TYPE|HELP) .*|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9+.][^ ]*)$'
+)
+
+
+def test_prometheus_text_parseable_and_stable_names():
+    reg = MetricsRegistry()
+    reg.counter("serve/requests").inc(7)
+    reg.gauge("serve/queue_depth").set(3)
+    h = reg.histogram("serve/flush_ms")
+    for v in (1.0, 2.0, 400.0):
+        h.observe(v)
+    text = reg.prometheus_text()
+    for line in text.strip().splitlines():
+        assert _EXPO_LINE.match(line), repr(line)
+    # Stable, sanitized names: '/' → '_', counters get _total.
+    assert prom_name("serve/flush_ms") == "mpt_serve_flush_ms"
+    assert "mpt_serve_requests_total 7" in text
+    assert "mpt_serve_queue_depth 3" in text
+    # Histogram contract: cumulative buckets, +Inf == _count, sum present.
+    assert 'mpt_serve_flush_ms_bucket{le="+Inf"} 3' in text
+    assert "mpt_serve_flush_ms_count 3" in text
+    assert "mpt_serve_flush_ms_sum 403" in text
+    # Cumulative monotonicity of the le-buckets.
+    cums = [
+        int(m.group(1))
+        for m in re.finditer(r'mpt_serve_flush_ms_bucket\{le="[^+]*"\} (\d+)', text)
+    ]
+    assert cums == sorted(cums) and cums[-1] <= 3
+
+
+def test_prometheus_counter_monotonic_across_scrapes():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs")
+    seen = []
+    for _ in range(5):
+        c.inc(2)
+        m = re.search(r"mpt_reqs_total (\d+)", reg.prometheus_text())
+        seen.append(int(m.group(1)))
+    assert seen == [2, 4, 6, 8, 10]
+    with pytest.raises(ValueError):
+        c.inc(-1)  # a decreasing counter is a gauge
+
+
+def test_unset_gauge_not_exposed():
+    reg = MetricsRegistry()
+    reg.gauge("pending")
+    assert "pending" not in reg.prometheus_text()
+
+
+# ---------------------------------------------------------------------------
+# snapshot record + resolution
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_record_schema_valid():
+    reg = MetricsRegistry()
+    reg.counter("c").inc()
+    reg.gauge("g").set(1.5)
+    reg.histogram("h").observe(3.0)
+    rec = {"ts": 1.0, **reg.snapshot_record()}
+    assert rec["kind"] == "metrics"
+    assert validate_record(rec) == []
+    merged = {"ts": 1.0, **reg.snapshot_record(merge=True, gather=lambda v: [v, v])}
+    assert merged["merged_hosts"] == 2
+    assert validate_record(merged) == []
+
+
+def test_type_collision_rejected():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(ValueError, match="different type"):
+        reg.gauge("x")
+
+
+def test_resolve_metric_forms():
+    reg = MetricsRegistry()
+    reg.counter("reqs").inc(4)
+    reg.gauge("depth").set(9)
+    h = reg.histogram("lat")
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.observe(v)
+    snap = reg.snapshot()
+    assert resolve_metric(snap, "reqs") == 4.0
+    assert resolve_metric(snap, "depth") == 9.0
+    assert resolve_metric(snap, "lat:count") == 4.0
+    assert resolve_metric(snap, "lat:mean") == pytest.approx(2.5)
+    assert resolve_metric(snap, "lat:p50") == pytest.approx(2.0, rel=0.05)
+    assert resolve_metric(snap, "nope") is None
+    assert resolve_metric(snap, "nope:p99") is None

@@ -1,0 +1,264 @@
+"""SLO rules engine (obs/monitor.py): DSL parsing, threshold/rate/drift
+evaluation, for=N streaks, latch-until-recovery, alert-record schema, and
+the three actions (log / metric / preempt sentinel)."""
+
+import json
+import os
+
+import pytest
+
+from mpi_pytorch_tpu.obs.metrics import MetricsRegistry
+from mpi_pytorch_tpu.obs.monitor import SLOMonitor, parse_rules
+from mpi_pytorch_tpu.obs.schema import validate_record
+from mpi_pytorch_tpu.utils.logging import MetricsWriter
+
+
+# ---------------------------------------------------------------------------
+# parsing
+# ---------------------------------------------------------------------------
+
+
+def test_parse_rule_full_form():
+    (r,) = parse_rules(
+        "serve/flush_ms:p99 > 250 for=3 warmup=7 name=serve_p99 "
+        "severity=critical action=log,metric,preempt"
+    )
+    assert (r.name, r.metric, r.op, r.threshold) == (
+        "serve_p99", "serve/flush_ms:p99", ">", 250.0,
+    )
+    assert (r.mode, r.for_count, r.warmup, r.severity) == ("value", 3, 7, "critical")
+    assert r.actions == ("log", "metric", "preempt")
+
+
+def test_parse_rule_modes_defaults_and_spacing():
+    rules = parse_rules(
+        "rate:serve/rejected>=5; drift:train/step_ms_last > 2.0;"
+        "train/recompiles>0"
+    )
+    assert [r.mode for r in rules] == ["rate", "drift", "value"]
+    assert [r.op for r in rules] == [">=", ">", ">"]
+    assert rules[0].name == "serve/rejected"  # default name = metric
+    assert rules[1].for_count == 1 and rules[1].warmup == 5
+    assert rules[2].actions == ("log",)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "no_comparison_here",
+        "m > notanumber",
+        "m > 5 for=0",
+        "m > 5 severity=panic",
+        "m > 5 action=page",
+        "m > 5 bogus=1",
+        "> 5",
+        "rate:idle < 1",  # below-rate rules page on silence: rejected
+        "a > 1 name=x; b > 2 name=x",  # duplicate names
+    ],
+)
+def test_parse_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_rules(bad)
+
+
+def test_config_validates_rules_and_preempt_path(monkeypatch):
+    from mpi_pytorch_tpu.config import Config
+
+    cfg = Config(slo_rules="train/recompiles > 0", step_metrics=True)
+    cfg.validate_config()
+    cfg = Config(slo_rules="train/recompiles > zero", step_metrics=True)
+    with pytest.raises(ValueError, match="not a number"):
+        cfg.validate_config()
+    # action=preempt needs a sentinel path the watchdog will poll.
+    monkeypatch.delenv("MPT_PREEMPT_FILE", raising=False)
+    cfg = Config(
+        slo_rules="train/recompiles > 0 action=preempt", step_metrics=True
+    )
+    with pytest.raises(ValueError, match="preempt"):
+        cfg.validate_config()
+    cfg.preempt_file = "/tmp/x.sentinel"
+    cfg.validate_config()
+
+
+def test_config_rejects_rules_over_unpublished_metrics():
+    """A rule whose source publisher is off would silently never evaluate
+    — config rejects the combination loudly (the repo's silently-ignored-
+    combination rule), naming the knob that arms the metric."""
+    from mpi_pytorch_tpu.config import Config
+
+    cfg = Config(slo_rules="train/recompiles > 0")  # step_metrics off
+    with pytest.raises(ValueError, match="--step-metrics"):
+        cfg.validate_config()
+    cfg = Config(slo_rules="train/straggler_streak >= 3")  # heartbeat off
+    with pytest.raises(ValueError, match="--heartbeat-every-steps"):
+        cfg.validate_config()
+    cfg = Config(
+        slo_rules="train/straggler_streak >= 3", heartbeat_every_steps=4
+    )
+    cfg.validate_config()
+    # Trainer-loop metrics the trainer itself publishes need no extra knob.
+    Config(slo_rules="drift:train/step_ms_last > 2.0").validate_config()
+    # scan_epoch has no per-step host boundaries to evaluate at.
+    cfg = Config(
+        slo_rules="drift:train/step_ms_last > 2.0",
+        device_cache=True, scan_epoch=True,
+    )
+    with pytest.raises(ValueError, match="scan_epoch"):
+        cfg.validate_config()
+
+
+# ---------------------------------------------------------------------------
+# evaluation
+# ---------------------------------------------------------------------------
+
+
+def _monitor(rules, tmp_path, registry=None, **kw):
+    registry = registry or MetricsRegistry()
+    writer = MetricsWriter(str(tmp_path / "m.jsonl"))
+    mon = SLOMonitor(registry, parse_rules(rules), metrics=writer, **kw)
+    return registry, writer, mon
+
+
+def _records(tmp_path):
+    path = tmp_path / "m.jsonl"
+    if not path.exists():
+        return []
+    return [json.loads(line) for line in open(path) if line.strip()]
+
+
+def test_threshold_rule_streak_latch_and_recovery(tmp_path):
+    reg, writer, mon = _monitor("q > 10 for=2 name=deep", tmp_path)
+    g = reg.gauge("q")
+    g.set(50)
+    assert mon.evaluate(epoch=0, step=0) == []  # streak 1 of 2
+    assert mon.evaluate(epoch=0, step=1) == ["deep"]  # fires at streak 2
+    assert mon.evaluate(epoch=0, step=2) == []  # latched: no alert spam
+    g.set(3)
+    assert mon.evaluate(epoch=0, step=3) == []  # recovery re-arms
+    g.set(99)
+    mon.evaluate(epoch=1, step=0)
+    assert mon.evaluate(epoch=1, step=1) == ["deep"]  # fires again
+    writer.close()
+
+    alerts = [r for r in _records(tmp_path) if r["kind"] == "alert"]
+    assert len(alerts) == 2
+    a = alerts[0]
+    assert validate_record(a) == []
+    assert (a["rule"], a["severity"], a["value"], a["threshold"]) == (
+        "deep", "warn", 50.0, 10.0,
+    )
+    assert (a["epoch"], a["step"], a["streak"]) == (0, 1, 2)
+
+
+def test_unpublished_metric_never_fires(tmp_path):
+    reg, writer, mon = _monitor("ghost:p99 > 1", tmp_path)
+    for _ in range(5):
+        assert mon.evaluate() == []
+    writer.close()
+    assert _records(tmp_path) == []
+
+
+def test_histogram_quantile_rule(tmp_path):
+    reg, writer, mon = _monitor("lat:p99 > 100 name=p99", tmp_path)
+    h = reg.histogram("lat")
+    for _ in range(99):
+        h.observe(10.0)
+    assert mon.evaluate() == []  # p99 of uniform 10s ≈ 10
+    for _ in range(30):
+        h.observe(5000.0)  # a latency cliff
+    assert mon.evaluate() == ["p99"]
+    writer.close()
+
+
+def test_rate_rule_counts_deltas_per_second(tmp_path):
+    t = [0.0]
+    reg, writer, mon = _monitor(
+        "rate:rejected > 5 name=reject_rate", tmp_path, clock=lambda: t[0],
+    )
+    c = reg.counter("rejected")
+    assert mon.evaluate() == []  # no time elapsed since construction
+    c.inc(2)
+    t[0] = 1.0
+    assert mon.evaluate() == []  # 2/s
+    c.inc(50)
+    t[0] = 2.0
+    assert mon.evaluate() == ["reject_rate"]  # 50/s
+    writer.close()
+    (alert,) = [r for r in _records(tmp_path) if r["kind"] == "alert"]
+    assert alert["metric"] == "rate:rejected"
+    assert alert["value"] == pytest.approx(50.0)
+
+
+def test_rate_rule_sees_burst_before_first_evaluation(tmp_path):
+    """Rate rules baseline at CONSTRUCTION (counter = 0), so a burst that
+    lands before the first evaluation counts as rate instead of vanishing
+    into the baseline sample — the flood-of-rejects-while-the-first-flush-
+    is-in-flight scenario, caught by a live flood drive."""
+    t = [0.0]
+    reg, writer, mon = _monitor(
+        "rate:rejected > 5 name=reject_rate", tmp_path, clock=lambda: t[0],
+    )
+    reg.counter("rejected").inc(500)  # the pre-first-eval burst
+    t[0] = 1.0
+    assert mon.evaluate() == ["reject_rate"]  # 500/s, seen
+    writer.close()
+
+
+def test_drift_rule_builds_baseline_then_judges(tmp_path):
+    reg, writer, mon = _monitor(
+        "drift:step_ms > 2.0 warmup=3 name=drift", tmp_path
+    )
+    g = reg.gauge("step_ms")
+    for v in (100.0, 110.0, 90.0):  # the baseline evals judge nothing
+        g.set(v)
+        assert mon.evaluate() == []
+    g.set(150.0)  # 1.5x baseline(100): healthy
+    assert mon.evaluate() == []
+    g.set(330.0)  # 3.3x: drifted
+    assert mon.evaluate() == ["drift"]
+    writer.close()
+    (alert,) = [r for r in _records(tmp_path) if r["kind"] == "alert"]
+    assert alert["value"] == pytest.approx(3.3)
+    assert alert["metric"] == "drift:step_ms"
+
+
+def test_metric_action_counts_alerts(tmp_path):
+    reg, writer, mon = _monitor("q > 1 action=metric name=a", tmp_path)
+    reg.gauge("q").set(5)
+    mon.evaluate()
+    assert reg.snapshot()["counters"]["obs/alerts_fired"] == 1.0
+    writer.close()
+
+
+def test_preempt_action_writes_sentinel(tmp_path):
+    sentinel = tmp_path / "deep" / "preempt.sentinel"
+    reg, writer, mon = _monitor(
+        "q > 1 action=preempt name=a", tmp_path, preempt_path=str(sentinel),
+    )
+    reg.gauge("q").set(5)
+    assert mon.evaluate() == ["a"]
+    assert sentinel.exists()
+    body = sentinel.read_text()
+    assert "slo:a" in body and "value=5" in body
+    writer.close()
+
+
+def test_preempt_action_without_path_warns_not_crashes(tmp_path, monkeypatch):
+    monkeypatch.delenv("MPT_PREEMPT_FILE", raising=False)
+    reg, writer, mon = _monitor("q > 1 action=preempt name=a", tmp_path)
+    reg.gauge("q").set(5)
+    assert mon.evaluate() == ["a"]  # alert recorded, preemption skipped
+    writer.close()
+    assert [r["kind"] for r in _records(tmp_path)] == ["alert"]
+
+
+def test_monitor_env_sentinel_fallback(tmp_path, monkeypatch):
+    sentinel = tmp_path / "env.sentinel"
+    monkeypatch.setenv("MPT_PREEMPT_FILE", str(sentinel))
+    reg, writer, mon = _monitor("q > 1 action=preempt name=a", tmp_path)
+    assert mon.preempt_path == str(sentinel)
+    reg.gauge("q").set(5)
+    mon.evaluate()
+    assert sentinel.exists()
+    writer.close()
+    assert os.path.exists(str(sentinel))

@@ -487,3 +487,89 @@ def test_heartbeat_window_resets_at_epoch_boundary(tmp_path):
     hb.on_step(1, 1, 0.1)      # beat: mean 100 ms, NOT polluted by the 9 s tail
     writer.close()
     assert locals_sent == [1000.0, 100.0]
+
+
+# ---------------------------------------------------------------------------
+# live telemetry: registry + SLO monitor + flight recorder, end to end
+# ---------------------------------------------------------------------------
+
+
+def test_slo_straggler_alert_preempts_run(tmp_path, monkeypatch):
+    """ISSUE 8's acceptance chain, in-process: a fake straggler appears
+    mid-run (MPT_FAULT_DELAY_STEP_MS after MPT_FAULT_DELAY_AFTER_STEP
+    clean steps), the drift SLO rule fires ONE kind="alert" record, its
+    preempt action writes the sentinel, the watchdog observes it
+    (kind="fault" reason=preempt_file) and stops the run cleanly, the
+    flight recorder dumps schema-clean evidence, and periodic
+    kind="metrics" snapshots land in the stream."""
+    from mpi_pytorch_tpu.train.trainer import train
+
+    sentinel = str(tmp_path / "preempt.sentinel")
+    # The delay must dominate the noisy natural CPU step time so the 2x
+    # drift ratio is unambiguous — the run preempts ~2 delayed steps in,
+    # so the extra wall cost stays at a few seconds.
+    monkeypatch.setenv("MPT_FAULT_DELAY_STEP_MS", "1500")
+    monkeypatch.setenv("MPT_FAULT_DELAY_AFTER_STEP", "4")
+    cfg = _telemetry_cfg(
+        str(tmp_path),
+        num_epochs=8,
+        heartbeat_every_steps=0,
+        slo_rules=(
+            "drift:train/step_ms_last > 2.0 warmup=3 "
+            "action=log,metric,preempt name=straggler_step_drift"
+        ),
+        metrics_every_steps=2,
+        flight_dir=str(tmp_path / "flight"),
+        preempt_file=sentinel,
+    )
+    summary = train(cfg)
+    assert summary.preempted, "the SLO breach never stopped the run"
+    assert os.path.exists(sentinel)
+
+    assert validate_jsonl(cfg.metrics_file) == []
+    records = [json.loads(line) for line in open(cfg.metrics_file)]
+    alerts = [r for r in records if r["kind"] == "alert"]
+    assert [a["rule"] for a in alerts] == ["straggler_step_drift"]
+    assert alerts[0]["value"] > 2.0 and alerts[0]["action"] == "log,metric,preempt"
+    faults = [r for r in records if r["kind"] == "fault"]
+    assert any(f["reason"] == "preempt_file" for f in faults), faults
+    snaps = [r for r in records if r["kind"] == "metrics"]
+    assert snaps, "no kind='metrics' snapshots on the cadence"
+    last = snaps[-1]
+    assert last["counters"]["obs/alerts_fired"] == 1.0
+    assert last["histograms"]["train/step_ms"]["count"] > 0
+    assert last["gauges"]["train/step_ms_last"] > 0
+
+    dumps = sorted(os.listdir(cfg.flight_dir))
+    alert_dumps = [d for d in dumps if "alert_straggler_step_drift" in d]
+    assert alert_dumps, dumps
+    dumped = json.load(open(os.path.join(cfg.flight_dir, alert_dumps[0])))
+    assert dumped["records"][-1]["kind"] == "alert"
+    from mpi_pytorch_tpu.obs.schema import validate_record as _vr
+    for rec in dumped["records"]:
+        assert _vr(rec) == [], rec
+
+    # The report tool renders the new kinds.
+    assert report_run.main([cfg.metrics_file]) == 0
+
+
+def test_registry_snapshots_without_rules(tmp_path):
+    """--metrics-every-steps alone (no SLO rules) still publishes the
+    registry cadence: step-time histograms/gauges with no alert machinery,
+    and the stream stays schema-clean."""
+    from mpi_pytorch_tpu.train.trainer import train
+
+    cfg = _telemetry_cfg(
+        str(tmp_path), metrics_every_steps=2, heartbeat_every_steps=0,
+    )
+    summary = train(cfg)
+    assert summary.epochs_run == 2
+    assert validate_jsonl(cfg.metrics_file) == []
+    records = [json.loads(line) for line in open(cfg.metrics_file)]
+    snaps = [r for r in records if r["kind"] == "metrics"]
+    # 2 steps/epoch x 2 epochs at every-2 cadence = 2 periodic + 1 final.
+    assert len(snaps) == 3
+    for s in snaps:
+        assert set(s["histograms"]) >= {"train/step_ms", "train/data_wait_ms"}
+    assert snaps[-1]["gauges"]["train/images_per_sec"] > 0
+    assert not [r for r in records if r["kind"] == "alert"]

@@ -614,3 +614,263 @@ def test_two_process_serve_replicas(tmp_path):
     ]
     assert len(lines) == 3, (outs, single.stdout)
     assert lines[0] == lines[1] == lines[2], lines
+
+
+# ------------------------------------------------- live telemetry (ISSUE 8)
+
+
+def test_server_obs_endpoints_request_ids_and_idempotent_close(tmp_path):
+    """The serve live-telemetry surface in one server life: /metrics
+    (parseable Prometheus text), /metricsz (JSON snapshot whose flush p99
+    matches the kind="serve" record stream), /healthz, per-request trace
+    ids threaded enqueue→preprocess→dispatch→fetch, the final registry
+    snapshot record, and idempotent close (the satellite fix)."""
+    import dataclasses
+    import re
+    import urllib.request
+
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.obs.schema import load_records, validate_jsonl
+    from mpi_pytorch_tpu.serve import InferenceServer
+
+    cfg = Config(
+        model_name="resnet18", num_classes=32, width=32, height=32,
+        synthetic_data=True, compute_dtype="float32",
+        serve_buckets="1,4", serve_max_wait_ms=2.0, serve_topk=3,
+        metrics_file=str(tmp_path / "m.jsonl"),
+        trace_file=str(tmp_path / "trace.json"),
+        log_file="", eval_log_file="", serve_metrics_port=-1,
+    )
+    cfg.validate_config()
+    server = InferenceServer(cfg, load_checkpoint=False)
+    try:
+        rng = np.random.default_rng(0)
+        images = [
+            rng.integers(0, 256, size=(32, 32, 3)).astype(np.uint8)
+            for _ in range(16)
+        ]
+        server.predict_batch(images, timeout=120)
+
+        port = server.metrics_port
+        assert port and port > 0
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        line_re = re.compile(
+            r'^(# (TYPE|HELP) .*|'
+            r'[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9+.][^ ]*)$'
+        )
+        for line in text.strip().splitlines():
+            assert line_re.match(line), repr(line)
+        assert "mpt_serve_requests_total 16" in text
+        assert 'mpt_serve_flush_ms_bucket{le="+Inf"}' in text
+        snap = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metricsz", timeout=10
+        ).read().decode())
+        health = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ).read().decode())
+        assert health["status"] == "ok"
+        assert health["compiles_after_warmup"] == 0
+    finally:
+        server.close()
+    server.close()  # idempotent: a second close is a no-op, not a crash
+
+    assert validate_jsonl(cfg.metrics_file) == []
+    records = load_records(cfg.metrics_file)
+    serves = [r for r in records if r["kind"] == "serve"]
+    finals = [r for r in records if r["kind"] == "metrics"]
+    assert serves and len(finals) == 1  # the close-time registry snapshot
+    # The scraped histogram saw exactly the flush stream: same count, and
+    # p99 within the sketch's bucket error of the exact stream p99.
+    flush_ms = sorted(r["total_ms"] for r in serves)
+    exact_p99 = flush_ms[max(0, -(-99 * len(flush_ms) // 100) - 1)]
+    scraped = snap["histograms"]["serve/flush_ms"]
+    assert scraped["count"] == len(serves)
+    assert abs(scraped["p99"] - exact_p99) <= 0.10 * max(exact_p99, 1e-9)
+    assert snap["counters"]["serve/requests"] == 16.0
+    assert finals[0]["counters"]["serve/served"] == 16.0
+
+    # Request-id threading across the pipeline phases.
+    trace = json.load(open(cfg.trace_file))
+    events = trace["traceEvents"]
+    enqueued = {e["args"]["req"] for e in events if e["name"] == "serve/enqueue"}
+    assert enqueued == set(range(16))
+    for phase in ("serve/preprocess", "serve/dispatch", "serve/fetch"):
+        seen = {
+            rid for e in events if e["name"] == phase
+            for rid in e.get("args", {}).get("req_ids", [])
+        }
+        assert seen == enqueued, (phase, sorted(seen))
+
+
+def test_close_flushes_sinks_even_when_drain_path_raises(tmp_path):
+    """THE satellite fix pinned: close() used to flush sinks only after a
+    clean drain — a failure mid-shutdown lost the per-process trace and
+    the final snapshot. Now the sink flush is on the finally path."""
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.obs.schema import load_records
+    from mpi_pytorch_tpu.serve import InferenceServer
+
+    cfg = Config(
+        model_name="resnet18", num_classes=32, width=32, height=32,
+        synthetic_data=True, compute_dtype="float32",
+        serve_buckets="4", serve_max_wait_ms=1.0, serve_topk=1,
+        metrics_file=str(tmp_path / "m.jsonl"),
+        trace_file=str(tmp_path / "trace.json"),
+        log_file="", eval_log_file="",
+    )
+    cfg.validate_config()
+    server = InferenceServer(cfg, load_checkpoint=False)
+
+    def exploding_shutdown(wait=True):
+        raise RuntimeError("injected: worker pool wedged mid-drain")
+
+    server._pool.shutdown = exploding_shutdown
+    with pytest.raises(RuntimeError, match="wedged mid-drain"):
+        server.close()
+    # The failure still flushed every obs sink: trace on disk, final
+    # registry snapshot in the stream, and a repeat close() is a no-op.
+    assert json.load(open(cfg.trace_file))["traceEvents"] is not None
+    assert any(
+        r["kind"] == "metrics" for r in load_records(cfg.metrics_file)
+    )
+    server.close()
+
+
+def test_init_failure_flushes_sinks(tmp_path, monkeypatch):
+    """A warmup/build crash inside __init__ must leave the trace and the
+    metrics stream flushed — the aborted startup is exactly the run whose
+    evidence is needed (the trainer failure-path discipline)."""
+    import mpi_pytorch_tpu.serve.server as server_mod
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.serve import InferenceServer
+
+    def exploding_exe(*a, **kw):
+        raise RuntimeError("injected: warmup compile died")
+
+    monkeypatch.setattr(server_mod, "BucketExecutables", exploding_exe)
+    cfg = Config(
+        model_name="resnet18", num_classes=32, width=32, height=32,
+        synthetic_data=True, compute_dtype="float32", serve_buckets="4",
+        metrics_file=str(tmp_path / "m.jsonl"),
+        trace_file=str(tmp_path / "trace.json"),
+        log_file="", eval_log_file="",
+    )
+    cfg.validate_config()
+    with pytest.raises(RuntimeError, match="warmup compile died"):
+        InferenceServer(cfg, load_checkpoint=False)
+    assert (tmp_path / "trace.json").exists()  # tracer flushed on the way out
+
+
+def test_serve_slo_rule_fires_on_latency_breach(tmp_path):
+    """A serve-side SLO rule over the live registry: an absurdly low p99
+    threshold breaches on real traffic, writing a kind="alert" record into
+    the serve stream and dumping the flight ring."""
+    import os as _os
+
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.obs.schema import load_records, validate_jsonl
+    from mpi_pytorch_tpu.serve import InferenceServer
+
+    cfg = Config(
+        model_name="resnet18", num_classes=32, width=32, height=32,
+        synthetic_data=True, compute_dtype="float32",
+        serve_buckets="1,4", serve_max_wait_ms=1.0, serve_topk=1,
+        metrics_file=str(tmp_path / "m.jsonl"),
+        log_file="", eval_log_file="",
+        slo_rules="serve/flush_ms:p99 > 0.001 name=serve_p99 action=log,metric",
+        flight_dir=str(tmp_path / "flight"),
+    )
+    cfg.validate_config()
+    with InferenceServer(cfg, load_checkpoint=False) as server:
+        rng = np.random.default_rng(0)
+        server.predict_batch(
+            [rng.integers(0, 256, size=(32, 32, 3)).astype(np.uint8)
+             for _ in range(6)],
+            timeout=120,
+        )
+    assert validate_jsonl(cfg.metrics_file) == []
+    records = load_records(cfg.metrics_file)
+    alerts = [r for r in records if r["kind"] == "alert"]
+    assert len(alerts) == 1  # latched: one alert, not one per flush
+    assert alerts[0]["rule"] == "serve_p99"
+    finals = [r for r in records if r["kind"] == "metrics"]
+    assert finals and finals[-1]["counters"]["obs/alerts_fired"] == 1.0
+    dumps = _os.listdir(cfg.flight_dir)
+    assert any("alert_serve_p99" in d for d in dumps), dumps
+
+
+def test_slo_evaluation_driven_from_submit_path(tmp_path):
+    """An outage in which no flush ever completes must still evaluate the
+    SLO rules: the submit path drives a throttled evaluation, so a
+    reject-rate rule can fire while the pipeline is wedged."""
+    import types
+
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.serve import InferenceServer
+
+    cfg = Config(
+        model_name="resnet18", num_classes=32, width=32, height=32,
+        synthetic_data=True, compute_dtype="float32", serve_buckets="8",
+        serve_max_wait_ms=50.0, serve_topk=1, serve_queue_depth=2,
+        metrics_file="", log_file="", eval_log_file="",
+    )
+    cfg.validate_config()
+    server = InferenceServer(cfg, load_checkpoint=False)
+    try:
+        calls = []
+        server._monitor = types.SimpleNamespace(
+            evaluate=lambda **kw: calls.append(1)
+        )
+        server._slo_eval_interval = 0.0  # un-throttle for the test
+        img = np.zeros((32, 32, 3), np.uint8)
+        futs = []
+        for _ in range(6):  # queue_depth 2 + long max_wait: some reject
+            try:
+                futs.append(server.submit(img))
+            except Exception:  # noqa: BLE001 — QueueFullError is the point
+                pass
+        assert calls, "submit path never evaluated the SLO rules"
+        for f in futs:
+            f.result(timeout=120)
+    finally:
+        server._monitor = None
+        server.close()
+
+
+def test_init_failure_does_not_orphan_pipeline_threads(tmp_path, monkeypatch):
+    """A construction failure AFTER the worker threads start (an HTTP port
+    bind, here simulated) must tear the pipeline down — a retry loop
+    around a failing bind must not accumulate live serve-batch threads."""
+    import threading
+
+    import mpi_pytorch_tpu.serve.server as server_mod
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.serve import InferenceServer
+
+    def exploding_http(*a, **kw):
+        raise OSError("injected: port already in use")
+
+    monkeypatch.setattr(server_mod, "ObsHTTPServer", exploding_http, raising=False)
+    # The import inside __init__ resolves via the module; patch there too.
+    import mpi_pytorch_tpu.serve.http as http_mod
+
+    monkeypatch.setattr(http_mod, "ObsHTTPServer", exploding_http)
+    cfg = Config(
+        model_name="resnet18", num_classes=32, width=32, height=32,
+        synthetic_data=True, compute_dtype="float32", serve_buckets="4",
+        serve_topk=1, serve_metrics_port=-1,
+        metrics_file="", log_file="", eval_log_file="",
+        trace_file=str(tmp_path / "trace.json"),
+    )
+    cfg.validate_config()
+    before = {t.name for t in threading.enumerate() if t.name.startswith("serve-")}
+    with pytest.raises(OSError, match="port already in use"):
+        InferenceServer(cfg, load_checkpoint=False)
+    leaked = [
+        t for t in threading.enumerate()
+        if t.name.startswith("serve-") and t.name not in before and t.is_alive()
+    ]
+    assert not leaked, leaked
+    assert (tmp_path / "trace.json").exists()  # sinks still flushed

@@ -1,0 +1,200 @@
+"""Perf regression gate over the committed bench history (ISSUE 8).
+
+Two artifact families carry the repo's trend lines:
+
+- ``BENCH_r*.json`` (repo root) — the driver's headline train cell per
+  round: ``{"rc": ..., "parsed": {"metric": ..., "value": img/s, ...}}``.
+- ``docs/serve_bench.json`` — the serve load driver's
+  ``kind="serve_bench"`` rows (p50/p95/p99, img/s per sweep point).
+
+This gate fails (exit 1) when the NEWEST comparable cell regressed more
+than ``--tolerance-pct`` against its predecessor:
+
+- train: ``value`` (img/s) dropped — compared only between rounds whose
+  ``metric`` string is IDENTICAL (the config is baked into the string, so
+  a batch-size change is a new trend line, not a regression);
+- serve: ``p99_ms`` rose or ``images_per_sec`` dropped for the same sweep
+  point (mode × buckets × max_wait × offered_rps × model), compared
+  against a committed baseline snapshot (``--serve-baseline``).
+
+Tolerances for history that CANNOT be compared, by design:
+
+- rounds with ``rc != 0`` (the r02/r05 wedged-backend losses) are skipped;
+- ``parsed``/``value`` null (staged or failed cells) are skipped;
+- no prior round with the same metric string → no pair → pass;
+- a missing serve baseline file → empty history → pass, announced loudly
+  ("serve gate skipped") so the inert half is visible, not silent. The
+  baseline is captured by committing the previous round's snapshot:
+  ``cp docs/serve_bench.json docs/serve_bench_prev.json`` before a round
+  refreshes ``serve_bench.json`` (the BENCH_r* history pattern, one file
+  deep).
+
+Tier-1 wrapper: ``tests/test_regression_gate.py`` (the
+``check_results_artifacts.py`` pattern) — a regression lands as a CI
+failure in the same PR that caused it, not in the next round's postmortem.
+
+Run: ``python tools/check_regression.py [--tolerance-pct 10]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ROUND = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def bench_cells(root: str) -> list[tuple[int, str, float]]:
+    """Comparable (round, metric, value) cells from ``BENCH_r*.json``,
+    round-ordered; rounds with rc != 0 or null parsed/value are dropped
+    (a wedged backend is a lost round, not a zero)."""
+    cells = []
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = _ROUND.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            data = json.load(open(path))
+        except ValueError:
+            continue  # a truncated bench artifact is the artifacts linter's job
+        if data.get("rc") != 0:
+            continue
+        parsed = data.get("parsed")
+        if not isinstance(parsed, dict):
+            continue
+        metric, value = parsed.get("metric"), parsed.get("value")
+        if not isinstance(metric, str) or not isinstance(value, (int, float)):
+            continue
+        cells.append((int(m.group(1)), metric, float(value)))
+    return sorted(cells)
+
+
+def check_bench(root: str, tol_pct: float) -> list[str]:
+    """NEWEST-vs-predecessor comparison per metric string — only the last
+    pair of each trend line is judged: the gate protects the current PR's
+    claim, and a historical dip that later recovered must not fail CI
+    forever (the history is immutable)."""
+    violations = []
+    by_metric: dict[str, list[tuple[int, float]]] = {}
+    for rnd, metric, value in bench_cells(root):
+        by_metric.setdefault(metric, []).append((rnd, value))
+    for metric, cells in by_metric.items():
+        if len(cells) < 2:
+            continue
+        (prev_rnd, prev), (rnd, value) = cells[-2], cells[-1]
+        if value < prev * (1 - tol_pct / 100.0):
+            violations.append(
+                f"BENCH r{rnd:02d}: {metric!r} regressed "
+                f"{value:,.1f} vs r{prev_rnd:02d}'s {prev:,.1f} "
+                f"(-{100.0 * (1 - value / prev):.1f}% > {tol_pct}% tolerance)"
+            )
+    return violations
+
+
+def _serve_key(row: dict) -> tuple:
+    return (
+        row.get("mode"), row.get("buckets"), row.get("max_wait_ms"),
+        row.get("offered_rps"), row.get("model"),
+    )
+
+
+def serve_rows(path: str) -> dict[tuple, dict]:
+    """Sweep-point → newest row for that point (a file may append rows
+    across reruns; the last one is the current claim)."""
+    rows: dict[tuple, dict] = {}
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            row = json.loads(line)
+            if row.get("kind") == "serve_bench":
+                rows[_serve_key(row)] = row
+    return rows
+
+
+def check_serve(new_path: str, baseline_path: str, tol_pct: float) -> list[str]:
+    """p99 rise / img/s drop per sweep point vs the committed baseline.
+    Either file missing = empty history = nothing to compare; null cells
+    (staged chip rows) skip that comparison only."""
+    if not (os.path.isfile(new_path) and os.path.isfile(baseline_path)):
+        return []
+    violations = []
+    base = serve_rows(baseline_path)
+    for key, row in serve_rows(new_path).items():
+        prev = base.get(key)
+        if prev is None:
+            continue
+        point = " ".join(str(k) for k in key if k is not None)
+        p99, p99_0 = row.get("p99_ms"), prev.get("p99_ms")
+        if (
+            isinstance(p99, (int, float)) and isinstance(p99_0, (int, float))
+            and p99_0 > 0 and p99 > p99_0 * (1 + tol_pct / 100.0)
+        ):
+            violations.append(
+                f"serve [{point}]: p99 {p99:.1f} ms vs baseline {p99_0:.1f} ms "
+                f"(+{100.0 * (p99 / p99_0 - 1):.1f}% > {tol_pct}% tolerance)"
+            )
+        ips, ips_0 = row.get("images_per_sec"), prev.get("images_per_sec")
+        if (
+            isinstance(ips, (int, float)) and isinstance(ips_0, (int, float))
+            and ips_0 > 0 and ips < ips_0 * (1 - tol_pct / 100.0)
+        ):
+            violations.append(
+                f"serve [{point}]: {ips:,.1f} img/s vs baseline {ips_0:,.1f} "
+                f"(-{100.0 * (1 - ips / ips_0):.1f}% > {tol_pct}% tolerance)"
+            )
+    return violations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=REPO, help="repo root (BENCH_r*.json)")
+    ap.add_argument(
+        "--tolerance-pct", type=float, default=10.0,
+        help="allowed regression before failing (CPU-relay noise floor)",
+    )
+    ap.add_argument(
+        "--serve", default=os.path.join(REPO, "docs", "serve_bench.json")
+    )
+    ap.add_argument(
+        "--serve-baseline",
+        default=os.path.join(REPO, "docs", "serve_bench_prev.json"),
+        help="prior round's serve snapshot; absent = empty history = pass",
+    )
+    args = ap.parse_args(argv)
+    violations = check_bench(args.root, args.tolerance_pct)
+    violations += check_serve(args.serve, args.serve_baseline, args.tolerance_pct)
+    if violations:
+        print(f"{len(violations)} perf regression(s) beyond "
+              f"{args.tolerance_pct}% tolerance:")
+        for v in violations:
+            print(" -", v)
+        return 1
+    cells = bench_cells(args.root)
+    if os.path.isfile(args.serve_baseline):
+        serve_note = " and the serve baseline pairs"
+    else:
+        # Inert halves must be VISIBLE: a silently-skipped serve gate
+        # reads as "serve is covered" when it is not.
+        serve_note = ""
+        print(
+            f"note: serve baseline {args.serve_baseline} absent — serve "
+            "p99/img-s gate skipped (capture one with "
+            "`cp docs/serve_bench.json docs/serve_bench_prev.json` before "
+            "refreshing the snapshot)"
+        )
+    print(
+        f"ok: no perf regression beyond {args.tolerance_pct}% across "
+        f"{len(cells)} comparable BENCH cell(s)" + serve_note
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

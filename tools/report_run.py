@@ -12,7 +12,9 @@ run recorded that kind:
 - validation/eval rows and anomaly records;
 - serving flush/bench summaries;
 - elastic-resume lines (topology from → to, ZeRO re-chunking, corrupt
-  checkpoints skipped) and fault/preemption signals.
+  checkpoints skipped) and fault/preemption signals;
+- SLO alert lines (rule, value vs threshold, actions) and the final live
+  metrics-registry snapshot (counters + histogram p50/p95/p99).
 
 Every record is validated against the shared schema
 (``mpi_pytorch_tpu/obs/schema.py``) first: malformed records are listed and
@@ -239,6 +241,30 @@ def summarize(records: list[dict]) -> dict:
             {k: f.get(k) for k in ("reason", "epoch", "step", "detail", "streak")}
             for f in faults
         ]
+    alerts = by_kind.get("alert", [])
+    if alerts:
+        summary["alerts"] = [
+            {k: a.get(k) for k in (
+                "rule", "severity", "metric", "value", "threshold", "streak",
+                "action", "epoch", "step",
+            )}
+            for a in alerts
+        ]
+    snaps = by_kind.get("metrics", [])
+    if snaps:
+        last = snaps[-1]
+        # The LAST snapshot is the run's final aggregate — histograms and
+        # counters are cumulative, so it subsumes the earlier ones.
+        summary["metrics_snapshots"] = {
+            "count": len(snaps),
+            "last_counters": last.get("counters", {}),
+            "last_gauges": last.get("gauges", {}),
+            "last_histograms": {
+                name: {k: h.get(k) for k in ("count", "p50", "p95", "p99")}
+                for name, h in last.get("histograms", {}).items()
+                if isinstance(h, dict) and h.get("count")
+            },
+        }
     return summary
 
 
@@ -384,6 +410,32 @@ def render(path: str, records: list[dict], summary: dict) -> str:
             + ("" if f.get("step") is None else f" step {f['step']}")
             + ("" if not f.get("detail") else f" — {f['detail']}")
         )]
+    for a in summary.get("alerts", []):
+        out += ["", (
+            f"ALERT [{a.get('severity')}]: {a['rule']} — "
+            f"{a.get('metric')} = {_fmt(a.get('value'), 4)} breaches "
+            f"{_fmt(a.get('threshold'), 4)} (streak {a.get('streak')}; "
+            f"actions: {a.get('action')})"
+            + ("" if a.get("epoch") is None else f" at epoch {a['epoch']}")
+            + ("" if a.get("step") is None else f" step {a['step']}")
+        )]
+    if "metrics_snapshots" in summary:
+        ms = summary["metrics_snapshots"]
+        out += ["", (
+            f"live metrics: {ms['count']} snapshot(s); final aggregate "
+            f"({len(ms['last_counters'])} counter(s), "
+            f"{len(ms['last_gauges'])} gauge(s), "
+            f"{len(ms['last_histograms'])} histogram(s)):"
+        )]
+        hist_rows = [
+            [name, h.get("count"), h.get("p50"), h.get("p95"), h.get("p99")]
+            for name, h in sorted(ms["last_histograms"].items())
+        ]
+        if hist_rows:
+            out.append(table(["histogram", "count", "p50", "p95", "p99"], hist_rows))
+        counter_rows = [[k, v] for k, v in sorted(ms["last_counters"].items())]
+        if counter_rows:
+            out.append(table(["counter", "value"], counter_rows))
     for a in summary.get("anomalies", []):
         out += ["", (
             f"ANOMALY: {a['reason']} at epoch {a['epoch']}"
