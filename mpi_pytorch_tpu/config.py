@@ -288,6 +288,35 @@ class Config:
     # so the fused server serves k=1 (warned, not silent).
     serve_topk: int = 5
 
+    # --- fleet serving (mpi_pytorch_tpu/serve/fleet/, ISSUE 9) ---
+    # N > 0 builds an in-process N-host fleet (FleetServer: N InferenceServer
+    # replicas sharing one warmed executable set, fronted by the load-aware
+    # router) — the bench/CI harness shape. 0 = plain single-host serving.
+    # In production each host is its own process; the router talks the same
+    # HostHandle surface either way.
+    serve_fleet_hosts: int = 0
+    # Also build one warm STANDBY host: it receives warmup traffic only and
+    # is promoted into rotation when a live host is drained (failover).
+    serve_fleet_spare: bool = False
+    # Router health/score probe cadence: each tick snapshots every host's
+    # live metrics registry (the EWMA dispatch score) and probes liveness;
+    # a host failing serve_fail_probes CONSECUTIVE probes (or dispatches)
+    # is drained and its in-flight requests re-dispatched.
+    serve_probe_interval_ms: float = 200.0
+    serve_fail_probes: int = 3
+    # Cross-host admission budget: fleet-wide in-flight requests beyond
+    # this are rejected AT THE FRONT DOOR with a typed QueueFullError
+    # carrying a retry_after_ms hint. 0 = auto (the sum of every active
+    # host's serve_queue_depth).
+    serve_admission_tokens: int = 0
+    # > 0 starts the live autotuning controller against this p99 target
+    # (ms): per host, max_wait_ms halves while p99 breaches (then the
+    # largest active bucket deactivates), and recovers when there is
+    # latency headroom and fill is poor. Retunes only ever activate
+    # pre-compiled executables. 0 = controller off.
+    serve_target_p99_ms: float = 0.0
+    serve_retune_interval_s: float = 2.0
+
     # --- validation semantics (main.py:104-112 validates on the TRAIN split) ---
     val_on_train: bool = True
 
@@ -549,6 +578,50 @@ class Config:
         if self.serve_queue_depth < 1:
             raise ValueError(
                 f"serve_queue_depth must be >= 1, got {self.serve_queue_depth}"
+            )
+        if self.serve_fleet_hosts < 0:
+            raise ValueError(
+                f"serve_fleet_hosts must be >= 0 (0 = single-host serving), "
+                f"got {self.serve_fleet_hosts}"
+            )
+        # The silently-ignored-combination rule: every fleet knob below is
+        # only read by FleetServer, so setting one without a fleet would
+        # quietly do nothing.
+        if self.serve_fleet_hosts == 0:
+            for knob in (
+                "serve_fleet_spare", "serve_target_p99_ms",
+                "serve_admission_tokens",
+            ):
+                if getattr(self, knob):
+                    raise ValueError(
+                        f"{knob} configures the serve fleet and needs "
+                        "serve_fleet_hosts > 0 (it is read by FleetServer "
+                        "only — without a fleet it would be silently "
+                        "ignored)"
+                    )
+        if self.serve_probe_interval_ms <= 0:
+            raise ValueError(
+                f"serve_probe_interval_ms must be > 0, "
+                f"got {self.serve_probe_interval_ms}"
+            )
+        if self.serve_fail_probes < 1:
+            raise ValueError(
+                f"serve_fail_probes must be >= 1, got {self.serve_fail_probes}"
+            )
+        if self.serve_admission_tokens < 0:
+            raise ValueError(
+                f"serve_admission_tokens must be >= 0 (0 = auto), "
+                f"got {self.serve_admission_tokens}"
+            )
+        if self.serve_target_p99_ms < 0:
+            raise ValueError(
+                f"serve_target_p99_ms must be >= 0 (0 = controller off), "
+                f"got {self.serve_target_p99_ms}"
+            )
+        if self.serve_retune_interval_s <= 0:
+            raise ValueError(
+                f"serve_retune_interval_s must be > 0, "
+                f"got {self.serve_retune_interval_s}"
             )
         if self.resume_retries < 0:
             raise ValueError(

@@ -25,6 +25,8 @@ Record kinds (every record also carries ``ts``, the epoch-seconds stamp
 | fault     | reason                                              | epoch, step, detail, streak |
 | metrics   | counters, gauges, histograms                        | merged_hosts |
 | alert     | rule, severity                                      | metric, value, threshold, streak, action, detail, epoch, step |
+| route     | host, requests                                      | share, score, queue_depth, inflight, window_s |
+| fleet     | event                                               | host, detail, redispatched, spare, max_wait_ms_from/to, buckets_from/to, p99_ms, target_p99_ms, compiles_after_warmup |
 
 ``serve`` is the per-flush record the online inference server writes
 (serve/server.py: one coalesced batch dispatched to a bucket executable);
@@ -67,7 +69,16 @@ from typing import Any, Mapping
 #      and ``alert`` (one SLO-rule breach from the monitor,
 #      ``obs/monitor.py``: the rule that fired, the observed value vs its
 #      threshold, and the action(s) taken) — ISSUE 8.
-SCHEMA_VERSION = 4
+#   5: the fleet-serving kinds ``route`` (one per-host routing window from
+#      the fleet router, ``serve/fleet/router.py``: requests dispatched to
+#      that host in the window, its EWMA load score, queue depth) and
+#      ``fleet`` (one fleet lifecycle event: a failover — host drained,
+#      in-flight requests re-dispatched, warm spare promoted — or a
+#      controller retune of ``max_wait_ms`` / the active bucket set,
+#      ``serve/fleet/controller.py``), plus the ``serve_bench`` row's
+#      optional ``fleet_hosts`` / ``per_host`` breakdown
+#      (``tools/bench_serve.py --fleet N``) — ISSUE 9 / ROADMAP item 1.
+SCHEMA_VERSION = 5
 
 _NUM = (int, float)
 _INT = (int,)
@@ -101,6 +112,10 @@ REQUIRED: dict[str, dict[str, tuple]] = {
     # name → value/summary object) and SLO alerts.
     "metrics": {"counters": (dict,), "gauges": (dict,), "histograms": (dict,)},
     "alert": {"rule": (str,), "severity": (str,)},
+    # v5: fleet serving — one routing window per host (router) and one
+    # lifecycle event (failover/retune/…) per occurrence.
+    "route": {"host": (str,), "requests": _INT},
+    "fleet": {"event": (str,)},
 }
 
 OPTIONAL: dict[str, dict[str, tuple]] = {
@@ -126,6 +141,11 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
     "serve_bench": {
         "model": (str,), "offered_rps": _NUM, "rejected": _INT,
         "mean_fill_ratio": _NUM, "compiles_after_warmup": _INT, "chips": _INT,
+        # v5: rows from the --fleet N mode — how many serving hosts the
+        # router spread the sweep over, and the per-host breakdown (host
+        # name → {requests, fill_pct, mean_ms}, all deltas over THIS
+        # sweep point; per-point tail percentiles live on the row itself).
+        "fleet_hosts": _INT, "per_host": (dict,),
     },
     "resume": {
         "from_devices": _INT, "from_mesh": (str,), "to_mesh": (str,),
@@ -133,6 +153,23 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         "corrupt_skipped": _INT, "strategy": (str,),
     },
     "fault": {"epoch": _INT, "step": _INT, "detail": (str,), "streak": _INT},
+    # v5: fleet routing/lifecycle fields. ``route`` is a per-host window:
+    # requests dispatched there since the last record, the router's
+    # smoothed load score and the host's queue/in-flight state when the
+    # window closed. ``fleet`` events: "failover" carries the drained
+    # host, how many in-flight requests were re-dispatched, and the
+    # promoted spare; "retune" carries the controller's max_wait/bucket
+    # change and the p99-vs-target evidence it acted on.
+    "route": {
+        "share": _NUM, "score": _NUM, "queue_depth": _INT, "inflight": _INT,
+        "window_s": _NUM,
+    },
+    "fleet": {
+        "host": (str,), "detail": (str,), "redispatched": _INT,
+        "spare": (str,), "max_wait_ms_from": _NUM, "max_wait_ms_to": _NUM,
+        "buckets_from": (str,), "buckets_to": (str,), "p99_ms": _NUM,
+        "target_p99_ms": _NUM, "compiles_after_warmup": _INT,
+    },
     "metrics": {
         # How many hosts' registries were merged into this snapshot
         # (absent on single-host runs — the local registry IS the merge).

@@ -6,12 +6,17 @@ AOT-compiled predict executables — the serving half of the north star.
 - ``executables.py``: one ``jit(...).lower().compile()`` predict
   executable per bucket, warmed before traffic; steady state performs
   ZERO XLA compiles, asserted via the obs backend-compile counter.
-- ``server.py``: the request path — preprocess worker pool, batch loop,
-  double-buffered dispatch/fetch, ``kind="serve"`` telemetry, per-phase
-  tracer spans, per-host replicas on multi-process worlds.
+- ``server.py``: the request path — preprocess worker pool, batch loop
+  with continuous batching (late arrivals top the next flush up while
+  the current one is on-device), double-buffered dispatch/fetch,
+  ``kind="serve"`` telemetry, per-phase tracer spans, per-host replicas
+  on multi-process worlds.
+- ``fleet/``: the multi-host layer — load-aware router with cross-host
+  admission control and warm-spare failover, plus the live autotuning
+  controller (ISSUE 9 / ROADMAP item 1).
 
-Load-drive it with ``tools/bench_serve.py``; tune it with
-``docs/SERVING.md``.
+Load-drive it with ``tools/bench_serve.py`` (``--fleet N`` for the fleet
+path); tune it with ``docs/SERVING.md``.
 """
 
 from mpi_pytorch_tpu.serve.batcher import (
@@ -26,11 +31,23 @@ from mpi_pytorch_tpu.serve.batcher import (
 )
 from mpi_pytorch_tpu.serve.executables import BucketExecutables
 from mpi_pytorch_tpu.serve.server import InferenceServer, local_replica_mesh
+from mpi_pytorch_tpu.serve.fleet import (
+    FleetController,
+    FleetRouter,
+    FleetServer,
+    LocalHost,
+    NoLiveHostError,
+)
 
 __all__ = [
     "BucketExecutables",
     "DynamicBatcher",
+    "FleetController",
+    "FleetRouter",
+    "FleetServer",
     "InferenceServer",
+    "LocalHost",
+    "NoLiveHostError",
     "PendingRequest",
     "PreprocessError",
     "QueueFullError",

@@ -41,7 +41,18 @@ class ServeError(RuntimeError):
 
 class QueueFullError(ServeError):
     """Backpressure: the bounded request queue is full — retry later or
-    shed the request (the typed rejection, never a silent drop)."""
+    shed the request (the typed rejection, never a silent drop).
+
+    ``retry_after_ms`` is the actionable half of the rejection (ISSUE 9
+    satellite): an estimate, from the queue's current drain rate, of how
+    long until the backlog has room again. Clients back off by it instead
+    of hammering; the fleet router's admission control threads the hint
+    through its own front-door rejections. None when no drain has been
+    observed yet (a hint would be a guess, not a measurement)."""
+
+    def __init__(self, message: str, retry_after_ms: float | None = None):
+        super().__init__(message)
+        self.retry_after_ms = retry_after_ms
 
 
 class ServerClosedError(ServeError):
@@ -101,14 +112,62 @@ class DynamicBatcher:
         poll_s: float = 0.05,
     ):
         self.buckets = parse_buckets(buckets)
+        # The ACTIVE subset the flush policy targets — the fleet
+        # controller's live bucket-set lever. Always a subset of the
+        # compiled set (set_active_buckets enforces it), so a retune can
+        # only ever select executables that already exist: the
+        # zero-steady-state-compile invariant survives retuning by
+        # construction.
+        self.active_buckets = self.buckets
         self.max_wait_s = float(max_wait_s)
         # poll cap so close() is noticed promptly even on an idle queue.
         self._poll_s = poll_s
         self._q: queue.Queue = queue.Queue(maxsize=max_queue)
         self._closed = False
+        # Requests a shrink-mid-wait retune displaced from the last flush
+        # (next_flush caps its return at the CURRENT largest active
+        # bucket; the remainder leads the next flush, oldest-first).
+        self._carry: list[PendingRequest] = []
+        # Drain-rate EWMA (requests leaving the queue per second) — the
+        # denominator of the retry_after_ms backpressure hint.
+        self._drain_rate: float | None = None
+        self._drain_t: float | None = None
 
     def qsize(self) -> int:
         return self._q.qsize()
+
+    def set_active_buckets(self, buckets: Sequence[int]) -> None:
+        """Retarget the flush policy at a subset of the COMPILED buckets.
+        Rejects anything outside the construction-time set: activating a
+        bucket with no executable would be the mid-request compile this
+        subsystem exists to make impossible."""
+        active = parse_buckets(buckets)
+        if not set(active) <= set(self.buckets):
+            raise ValueError(
+                f"active buckets {sorted(set(active) - set(self.buckets))} "
+                f"were never compiled (compiled set: {list(self.buckets)})"
+            )
+        self.active_buckets = active
+
+    def _note_drain(self, n: int) -> None:
+        """Blend ``n`` requests leaving the queue into the drain-rate EWMA."""
+        now = time.monotonic()
+        if self._drain_t is not None:
+            inst = n / max(now - self._drain_t, 1e-6)
+            self._drain_rate = (
+                inst if self._drain_rate is None
+                else 0.7 * self._drain_rate + 0.3 * inst
+            )
+        self._drain_t = now
+
+    def retry_after_ms(self) -> float:
+        """How long until the current backlog has drained at the observed
+        rate — the ``QueueFullError`` hint. Falls back to twice the flush
+        deadline before any drain has been observed (cold server)."""
+        backlog = self._q.qsize() + 1
+        if not self._drain_rate or self._drain_rate <= 0:
+            return max(10.0, 2.0 * self.max_wait_s * 1e3)
+        return round(min(max(1e3 * backlog / self._drain_rate, 1.0), 6e4), 3)
 
     @property
     def closed(self) -> bool:
@@ -122,7 +181,8 @@ class DynamicBatcher:
             self._q.put_nowait(item)
         except queue.Full:
             raise QueueFullError(
-                f"request queue full ({self._q.maxsize}); shed or retry"
+                f"request queue full ({self._q.maxsize}); shed or retry",
+                retry_after_ms=self.retry_after_ms(),
             ) from None
 
     def close(self) -> None:
@@ -131,16 +191,48 @@ class DynamicBatcher:
         then returns None."""
         self._closed = True
 
+    def drain_ready(self, limit: int) -> list[PendingRequest]:
+        """Up to ``limit`` already-queued requests, without waiting — the
+        continuous-batching top-up: the server calls this right before
+        dispatching a flush, so requests that arrived while the flush was
+        being preprocessed (i.e. while the PREVIOUS flush is on-device)
+        ride NOW instead of sitting out another deadline. This is what
+        keeps the fill ratio from collapsing at high offered load."""
+        out: list[PendingRequest] = []
+        while len(out) < limit:
+            try:
+                out.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        if out:
+            self._note_drain(len(out))
+        return out
+
     def next_flush(self) -> list[PendingRequest] | None:
         """Block until the next flush-worth of requests is due and return
         them (1..largest-bucket items), or None once closed AND drained.
 
-        Flush when: the largest bucket is filled, the oldest pending
-        request is past ``max_wait_s``, or the batcher is closed and the
-        queue ran dry (drain — whatever is pending goes out now)."""
-        pending: list[PendingRequest] = []
-        max_b = self.buckets[-1]
+        Flush when: the largest ACTIVE bucket is filled, the oldest
+        pending request is past ``max_wait_s``, or the batcher is closed
+        and the queue ran dry (drain — whatever is pending goes out now).
+
+        A flush never exceeds the largest bucket active AT RETURN TIME:
+        a retune that shrinks the active set while requests were
+        accumulating would otherwise hand the server more rows than any
+        active executable's shape — the excess carries over and LEADS
+        the next flush instead."""
+        pending: list[PendingRequest] = self._carry
+        self._carry = []
         while True:
+            max_b = self.active_buckets[-1]  # re-read: retuned live
+
+            def flush_capped() -> list[PendingRequest]:
+                cap = self.active_buckets[-1]
+                if len(pending) > cap:
+                    self._carry = pending[cap:]
+                self._note_drain(len(pending) - len(self._carry))
+                return pending[:cap]
+
             # Greedy drain FIRST: everything already queued joins this flush
             # (up to the largest bucket) before any deadline decision. Under
             # backlog the oldest item is past its deadline the moment it is
@@ -156,9 +248,9 @@ class DynamicBatcher:
             if pending:
                 deadline = pending[0].t_submit + self.max_wait_s
                 if len(pending) >= max_b or now >= deadline:
-                    return pending
+                    return flush_capped()
                 if self._closed:
-                    return pending  # drain: don't sit out the deadline
+                    return flush_capped()  # drain: don't sit out the deadline
                 timeout = min(deadline - now, self._poll_s)
             else:
                 if self._closed:

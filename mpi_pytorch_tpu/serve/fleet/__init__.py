@@ -1,0 +1,34 @@
+"""Fleet serving (ISSUE 9 / ROADMAP item 1): a multi-host layer in front
+of N ``serve.InferenceServer`` replicas — the millions-of-users path.
+
+- ``router.py``: the front door — load-aware dispatch (EWMA-scored
+  registry snapshots, power-of-two-choices when stale), cross-host
+  admission control (global token budget, typed front-door
+  ``QueueFullError`` with a ``retry_after_ms`` hint), and warm-spare
+  failover (drain on K failed probes/dispatches, exactly-once
+  re-dispatch of in-flight requests, spare promotion).
+- ``controller.py``: the live autotuner — retunes ``max_wait_ms`` and
+  the ACTIVE bucket set per host from registry p99 vs a target SLO,
+  only ever activating pre-compiled executables (the zero-steady-state-
+  compile invariant holds through every retune, asserted).
+- ``server.py``: ``FleetServer`` — the in-process N-host harness
+  (threads, shared executable set) the bench/CI/tests drive.
+
+Telemetry: ``kind="route"`` / ``kind="fleet"`` records (schema v5).
+"""
+
+from mpi_pytorch_tpu.serve.fleet.controller import FleetController
+from mpi_pytorch_tpu.serve.fleet.router import (
+    FleetRouter,
+    LocalHost,
+    NoLiveHostError,
+)
+from mpi_pytorch_tpu.serve.fleet.server import FleetServer
+
+__all__ = [
+    "FleetController",
+    "FleetRouter",
+    "FleetServer",
+    "LocalHost",
+    "NoLiveHostError",
+]

@@ -1,0 +1,201 @@
+"""Live autotuning controller: p99-vs-SLO retunes of the serving knobs.
+
+The two levers ``docs/SERVING.md`` tells a human to sweep offline —
+``max_wait_ms`` (latency/throughput) and the bucket set (padding
+waste/flush size) — retuned automatically from the telemetry the serve
+replica already publishes (the ``kind="serve"`` stream's live aggregate:
+the metrics-registry snapshot PR 8 built as ROADMAP item 1's read path).
+
+Policy, per host per tick (AIMD-shaped — halve on breach, grow gently):
+
+- **p99 above target** → halve ``max_wait_ms`` (clamped to
+  ``min_wait_ms``): the flush deadline is the additive queueing term of
+  request latency. Already at the floor → DEACTIVATE the largest active
+  bucket: a smaller largest bucket caps per-flush service time (the
+  multiplicative term). The full compiled set stays warm; only the flush
+  policy's target set shrinks.
+- **p99 under half the target** → restore the next compiled bucket if
+  any were deactivated (the emergency is over; and a bucket-capped host
+  reports artificially perfect fill, so restoration is NOT fill-gated);
+  once the full set is active, grow ``max_wait_ms`` 1.5× (clamped to
+  ``max_wait_ms_cap``) when fill sits below ``fill_low_pct`` — latency
+  headroom is being wasted on padded flushes.
+
+Every retune only ever ACTIVATES pre-compiled executables
+(``server.set_active_buckets`` rejects anything else) and re-reads the
+host's compile counter afterwards — the zero-steady-state-compile
+invariant is asserted through every retune, not assumed, and stamped on
+the ``kind="fleet"`` ``event="retune"`` record (schema v5).
+
+The percentiles are the registry sketch's cumulative p99 (within ~2.2%
+relative by construction, ``obs/metrics.py``): the controller converges
+on the steady-state tail, deliberately damped against transients — the
+EWMA-smoothed router handles instantaneous load, this loop handles the
+operating point. A tick with no new observations since the last one is
+skipped (nothing was learned).
+
+Drive it with ``tick()`` (tests, colocated control planes) or
+``start()``/``stop()`` for the background loop ``FleetServer`` wires.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from mpi_pytorch_tpu.serve.batcher import ServeError
+
+
+class FleetController:
+    """Retune max_wait_ms + the active bucket set against a p99 target."""
+
+    def __init__(
+        self,
+        hosts_fn,
+        *,
+        target_p99_ms: float,
+        metrics=None,
+        interval_s: float = 2.0,
+        min_wait_ms: float = 0.0,
+        max_wait_ms_cap: float = 100.0,
+        fill_low_pct: float = 50.0,
+        latency_metric: str = "serve/request_latency_ms",
+        logger=None,
+    ):
+        if target_p99_ms <= 0:
+            raise ValueError(
+                f"target_p99_ms must be > 0, got {target_p99_ms}"
+            )
+        from mpi_pytorch_tpu.utils.logging import run_logger
+
+        # hosts_fn (not a static list) so a failover mid-run retargets the
+        # loop at the surviving hosts automatically (router.active_hosts).
+        self._hosts_fn = hosts_fn
+        self.target_p99_ms = float(target_p99_ms)
+        self._metrics = metrics
+        self._interval_s = float(interval_s)
+        self._min_wait_ms = float(min_wait_ms)
+        self._max_wait_ms_cap = float(max_wait_ms_cap)
+        self._fill_low_pct = float(fill_low_pct)
+        self._latency_metric = latency_metric
+        self._logger = logger or run_logger()
+        self._seen_counts: dict[str, int] = {}
+        self.retunes = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ----------------------------------------------------------- the loop
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-controller", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — tuning must not kill serving
+                self._logger.warning("fleet controller tick failed: %s", e)
+
+    # ----------------------------------------------------------- one tick
+
+    def tick(self) -> int:
+        """Evaluate every live host once; returns how many were retuned."""
+        retuned = 0
+        for host in list(self._hosts_fn()):
+            try:
+                if self._tick_host(host):
+                    retuned += 1
+            except ServeError as e:
+                self._logger.warning(
+                    "fleet controller: host %s retune failed: %s",
+                    host.name, e,
+                )
+        return retuned
+
+    def _tick_host(self, host) -> bool:
+        snap = host.snapshot()
+        hist = snap.get("histograms", {}).get(self._latency_metric)
+        if not hist or not hist.get("count"):
+            return False
+        if hist["count"] == self._seen_counts.get(host.name):
+            return False  # no new observations since the last decision
+        self._seen_counts[host.name] = hist["count"]
+        p99 = hist["p99"]
+        fill_hist = snap.get("histograms", {}).get("serve/fill_pct") or {}
+        fill = (
+            fill_hist["sum"] / fill_hist["count"]
+            if fill_hist.get("count") else None
+        )
+
+        wait_from = host.max_wait_ms
+        active_from = tuple(host.active_buckets)
+        wait_to, active_to = wait_from, active_from
+        if p99 > self.target_p99_ms:
+            wait_to = wait_from / 2.0
+            if wait_to < max(self._min_wait_ms, 0.25):
+                wait_to = self._min_wait_ms  # snap to the floor, don't asymptote
+            if wait_to == wait_from and len(active_from) > 1:
+                active_to = active_from[:-1]  # cap per-flush service time
+        elif p99 < 0.5 * self.target_p99_ms:
+            compiled = tuple(host.buckets)
+            if active_from != compiled:
+                # Latency headroom: restore the next compiled bucket
+                # first — deactivation was an emergency measure, and a
+                # bucket-capped host reports artificially perfect fill,
+                # so this branch must not be gated on the fill signal.
+                active_to = compiled[: len(active_from) + 1]
+            elif fill is not None and fill < self._fill_low_pct:
+                wait_to = min(
+                    self._max_wait_ms_cap, max(wait_from * 1.5, 1.0)
+                )
+        if wait_to == wait_from and active_to == active_from:
+            return False
+
+        if wait_to != wait_from:
+            host.set_max_wait_ms(wait_to)
+        if active_to != active_from:
+            # Only ever a subset of the compiled set — set_active_buckets
+            # raises on anything that would need a fresh executable.
+            host.set_active_buckets(active_to)
+        compiles = host.compiles_after_warmup()
+        if compiles != 0:
+            # The invariant this subsystem is built on broke — say so
+            # loudly; the retune record below carries the evidence.
+            self._logger.error(
+                "fleet controller: host %s shows %d steady-state "
+                "compile(s) after a retune — the zero-compile invariant "
+                "is broken", host.name, compiles,
+            )
+        self.retunes += 1
+        self._logger.info(
+            "fleet controller: retuned %s — max_wait %.2f→%.2f ms, "
+            "buckets %s→%s (p99 %.1f ms vs target %.1f, fill %s)",
+            host.name, wait_from, wait_to, list(active_from),
+            list(active_to), p99, self.target_p99_ms,
+            "-" if fill is None else f"{fill:.0f}%",
+        )
+        if self._metrics is not None:
+            self._metrics.write({
+                "kind": "fleet",
+                "event": "retune",
+                "host": host.name,
+                "max_wait_ms_from": round(wait_from, 3),
+                "max_wait_ms_to": round(wait_to, 3),
+                "buckets_from": ",".join(str(b) for b in active_from),
+                "buckets_to": ",".join(str(b) for b in active_to),
+                "p99_ms": round(p99, 3),
+                "target_p99_ms": self.target_p99_ms,
+                "compiles_after_warmup": compiles,
+            })
+        return True

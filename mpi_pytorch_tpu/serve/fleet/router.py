@@ -1,0 +1,723 @@
+"""Fleet front door: load-aware dispatch over N serving hosts (ISSUE 9).
+
+The reference's inference half routes each image to a RANDOM predictor
+rank (``evaluation_pipeline.py:178``) — static placement, no notion of a
+slow or dead predictor ("Distributed TensorFlow with MPI", arXiv
+1603.02339, is the same lineage). ``serve/`` generalized the predictor
+rank into a dynamic-batching host; this module generalizes the random
+routing into a fleet layer — the millions-of-users path of ROADMAP
+item 1:
+
+- **Load-aware dispatch.** A probe thread snapshots every host's live
+  metrics registry (the ``/metricsz`` contract PR 8 built for exactly
+  this consumer) and scores it: ``queue depth + in-flight fill``,
+  EWMA-smoothed so one noisy probe doesn't flap routing. ``submit``
+  picks the lowest score; when snapshots are STALE (probe thread behind,
+  or a remote host not answering) the router falls back to
+  power-of-two-choices over its own per-host outstanding counts — the
+  classic load-balancing result that two random choices beat one by an
+  exponential factor, without requiring fresh global state.
+- **Cross-host admission control.** A global token budget (default: the
+  sum of every active host's queue capacity) bounds fleet-wide
+  in-flight requests. When it is exhausted the FRONT DOOR rejects with
+  the typed ``QueueFullError`` — carrying the ``retry_after_ms`` hint
+  from the observed completion rate — instead of letting one hot host's
+  per-host rejection surface to a client that could have been served by
+  a cold one.
+- **Warm-spare failover.** A standby host receives warmup traffic only
+  (one synthetic request per probe tick keeps its executables hot and
+  proves it healthy). A host failing ``fail_probes`` consecutive health
+  probes or dispatches is DRAINED: removed from rotation, its in-flight
+  requests re-dispatched by ``req_id`` (exactly once each — claims are
+  serialized under the router lock), and the spare promoted into the
+  active set. No accepted request is lost; at worst a request is
+  computed twice (old host finished after the drain decision), in which
+  case the first completion wins.
+
+Telemetry: ``kind="route"`` records (per-host dispatch windows) and
+``kind="fleet"`` records (failover events) land in the shared metrics
+stream — schema v5, rendered by ``tools/report_run.py``.
+
+Chaos: the registered serve fault gates (``utils/env.py FAULT_GATES``)
+drive the deterministic kill-one-host drill — ``MPT_FAULT_SERVE_KILL_HOST``
+names a host index and ``MPT_FAULT_SERVE_KILL_AFTER`` the dispatch count
+after which the router hard-kills it mid-traffic (the ``_dryrun_fleet``
+CI leg and ``tests/test_fleet.py``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+from mpi_pytorch_tpu.serve.batcher import (
+    QueueFullError,
+    ServeError,
+    ServerClosedError,
+)
+
+
+class NoLiveHostError(ServeError):
+    """Every serving host (and the spare) is drained/dead — the fleet has
+    no capacity at all. Distinct from backpressure: retrying will not
+    help until a host comes back."""
+
+
+@dataclass
+class _HostState:
+    """Router-side bookkeeping for one host (all mutations under the
+    router lock)."""
+
+    score: float | None = None  # EWMA of queue_depth + in-flight
+    snapshot_t: float = -1.0  # monotonic time of the last good snapshot
+    probe_fails: int = 0  # consecutive probe failures
+    dispatch_fails: int = 0  # consecutive dispatch/completion failures
+    outstanding: int = 0  # router-tracked in-flight (po2 fallback input)
+    dispatched_total: int = 0
+    window_requests: int = 0  # dispatches since the last route record
+
+
+@dataclass
+class _Flight:
+    """One accepted request, tracked until its future resolves — the
+    re-dispatch unit of the failover path."""
+
+    fid: int
+    payload: object
+    future: Future
+    host: str | None = None  # current assignment (None while re-dispatching)
+    redispatches: int = 0
+    # True between a re-dispatch CLAIM and the new host assignment — the
+    # claim marker that keeps a probe-driven drain and a concurrent
+    # failure callback from both re-dispatching this flight (entry.host
+    # is None in that window, which alone cannot distinguish "claimed,
+    # in transit" from "never assigned").
+    redispatching: bool = False
+    finished: bool = False
+    t_submit: float = field(default_factory=time.monotonic)
+
+
+class LocalHost:
+    """HostHandle over an in-process ``InferenceServer`` — the concrete
+    transport of the local N-host fleet (threads, one process). A remote
+    transport would implement the same surface over HTTP: ``snapshot``
+    is ``/metricsz``, ``alive`` is ``/healthz``, ``submit`` the request
+    endpoint. The router only ever talks through this interface."""
+
+    def __init__(self, server):
+        self.server = server
+        self.name = server.name
+        self.index = server.host_index
+
+    # -- request path -------------------------------------------------
+    def submit(self, image) -> Future:
+        return self.server.submit(image)
+
+    # -- telemetry / control ------------------------------------------
+    def snapshot(self) -> dict:
+        return self.server.registry_snapshot()
+
+    def alive(self) -> bool:
+        return not self.server._batcher.closed
+
+    def qsize(self) -> int:
+        return self.server._batcher.qsize()
+
+    @property
+    def queue_capacity(self) -> int:
+        return self.server.cfg.serve_queue_depth
+
+    @property
+    def buckets(self) -> tuple[int, ...]:
+        return self.server.buckets
+
+    @property
+    def active_buckets(self) -> tuple[int, ...]:
+        return self.server.active_buckets
+
+    @property
+    def max_wait_ms(self) -> float:
+        return self.server.max_wait_ms
+
+    def set_max_wait_ms(self, v: float) -> None:
+        self.server.set_max_wait_ms(v)
+
+    def set_active_buckets(self, buckets) -> None:
+        self.server.set_active_buckets(buckets)
+
+    def compiles_after_warmup(self) -> int:
+        return self.server._exe.compiles_since_warmup()
+
+    def stats(self) -> dict:
+        return self.server.stats()
+
+    # -- lifecycle ----------------------------------------------------
+    def close(self, drain: bool = True) -> None:
+        self.server.close(drain=drain)
+
+    def kill(self) -> None:
+        """The hard-death path: no drain — queued requests fail with
+        ``ServerClosedError`` (which the router converts into
+        re-dispatches), the dispatched batch finishes or dies with the
+        device."""
+        self.server.close(drain=False)
+
+
+class FleetRouter:
+    """Load-aware front door over a set of ``HostHandle``-shaped hosts."""
+
+    def __init__(
+        self,
+        hosts,
+        spare=None,
+        *,
+        metrics=None,
+        admission_tokens: int = 0,
+        probe_interval_s: float = 0.2,
+        fail_probes: int = 3,
+        ewma_alpha: float = 0.3,
+        stale_after_s: float = 1.0,
+        route_record_every: int = 5,
+        max_redispatches: int = 2,
+        warmup_payload=None,
+        logger=None,
+        seed: int = 0,
+    ):
+        if not hosts:
+            raise ValueError("a fleet needs at least one serving host")
+        from mpi_pytorch_tpu.utils.logging import run_logger
+
+        self._logger = logger or run_logger()
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._active = list(hosts)
+        self._spare = spare
+        self._dead: set[str] = set()
+        self._state = {h.name: _HostState() for h in self._active}
+        if spare is not None:
+            self._state[spare.name] = _HostState()
+        self._inflight: dict[int, _Flight] = {}
+        self._ids = itertools.count()
+        self._alpha = float(ewma_alpha)
+        self._stale_after_s = float(stale_after_s)
+        self._fail_probes = int(fail_probes)
+        self._max_redispatches = int(max_redispatches)
+        self._route_record_every = int(route_record_every)
+        self._warmup_payload = warmup_payload
+        self._rng = random.Random(seed)
+        self._closed = False
+        self.budget = int(admission_tokens) or sum(
+            h.queue_capacity for h in self._active
+        )
+        self._tokens = self.budget
+        self.front_door_rejections = 0
+        self.redispatch_log: list[int] = []  # flight ids, append-only
+        self.failovers: list[str] = []  # drained host names
+        self._spare_warmups = 0
+        # Completion-rate EWMA (requests/s) → the front-door retry hint.
+        self._done_rate: float | None = None
+        self._done_t: float | None = None
+        self._probe_interval_s = float(probe_interval_s)
+        self._probe_ticks = 0
+        self._window_t = time.monotonic()
+        self._probe_stop = threading.Event()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="fleet-probe", daemon=True
+        )
+        self._probe_thread.start()
+
+    # ------------------------------------------------------------ admission
+
+    def submit(self, image) -> Future:
+        """Admit one request fleet-wide, or reject at the front door.
+
+        Raises ``QueueFullError`` (with ``retry_after_ms``) when the
+        global token budget is exhausted — one hot host's backpressure
+        becomes a fleet-level signal here, before any per-host queue can
+        overflow — and ``NoLiveHostError`` when every host is drained."""
+        if self._closed:
+            raise ServerClosedError("fleet router is shut down")
+        with self._lock:
+            if self._tokens <= 0:
+                self.front_door_rejections += 1
+                raise QueueFullError(
+                    f"fleet admission budget exhausted ({self.budget} "
+                    "in flight); retry later",
+                    retry_after_ms=self._retry_hint_locked(),
+                )
+            self._tokens -= 1
+            entry = _Flight(next(self._ids), image, Future())
+            self._inflight[entry.fid] = entry
+        try:
+            self._dispatch(entry)
+        except BaseException:
+            with self._lock:
+                if not entry.finished:
+                    entry.finished = True
+                    self._inflight.pop(entry.fid, None)
+                    self._tokens += 1
+            raise
+        return entry.future
+
+    def predict_batch(self, images, timeout: float | None = None):
+        import numpy as np
+
+        futs = [self.submit(im) for im in images]
+        return np.stack([f.result(timeout=timeout) for f in futs])
+
+    def _retry_hint_locked(self) -> float:
+        if not self._done_rate or self._done_rate <= 0:
+            return 50.0
+        backlog = len(self._inflight) + 1
+        return round(min(max(1e3 * backlog / self._done_rate, 1.0), 6e4), 3)
+
+    # ------------------------------------------------------------- dispatch
+
+    def _dispatch(self, entry: _Flight, exclude: frozenset = frozenset()):
+        """Assign ``entry`` to the best host and hand it over. Host-level
+        backpressure or a dead host falls through to the next-best choice;
+        only when EVERY live host rejects does the failure reach the
+        caller (sync path) or the entry's future (re-dispatch path)."""
+        while True:
+            host = self._pick(exclude)
+            if host is None:
+                raise NoLiveHostError("no live serving hosts in the fleet")
+            with self._lock:
+                entry.host = host.name
+                entry.redispatching = False  # claim fulfilled: assigned
+                st = self._state[host.name]
+                st.outstanding += 1
+                st.dispatched_total += 1
+                st.window_requests += 1
+                dispatched_total = st.dispatched_total
+            self._maybe_kill_gate(host, dispatched_total)
+            try:
+                hfut = host.submit(entry.payload)
+            except BaseException as e:  # noqa: BLE001 — per-host trouble
+                with self._lock:
+                    self._state[host.name].outstanding -= 1
+                    entry.host = None
+                if isinstance(e, QueueFullError):
+                    # Host-level backpressure despite scoring (burst);
+                    # spill to the next-best host, give up only when
+                    # every live host is saturated.
+                    exclude = exclude | {host.name}
+                    if any(
+                        h.name not in exclude and h.name not in self._dead
+                        for h in self._active
+                    ):
+                        continue
+                    raise
+                # A dead/closing host: count it, maybe drain, try others.
+                self._note_dispatch_failure(host)
+                exclude = exclude | {host.name}
+                if any(
+                    h.name not in exclude and h.name not in self._dead
+                    for h in self._active
+                ):
+                    continue
+                raise
+            hfut.add_done_callback(
+                lambda f, h=host: self._on_host_done(entry, h, f)
+            )
+            return
+
+    def _pick(self, exclude: frozenset = frozenset()):
+        """Lowest EWMA score among hosts with a FRESH snapshot; stale →
+        power-of-two-choices over router-tracked outstanding counts."""
+        now = time.monotonic()
+        with self._lock:
+            live = [
+                h for h in self._active
+                if h.name not in self._dead and h.name not in exclude
+            ]
+            if not live:
+                return None
+            fresh = [
+                h for h in live
+                if now - self._state[h.name].snapshot_t <= self._stale_after_s
+                and self._state[h.name].score is not None
+            ]
+            if fresh:
+                # EWMA snapshot score PLUS the router's own live
+                # outstanding count: a snapshot can be a whole probe
+                # interval old, and a burst shorter than that would
+                # otherwise land entirely on whichever host's frozen
+                # score happened to be lowest (observed in the bench's
+                # 120 ms open-loop burst before this term existed).
+                return min(
+                    fresh,
+                    key=lambda h: (
+                        self._state[h.name].score
+                        + self._state[h.name].outstanding
+                    ),
+                )
+            # Stale snapshots: two random choices, pick the one with
+            # fewer router-tracked outstanding requests.
+            if len(live) == 1:
+                return live[0]
+            a, b = self._rng.sample(live, 2)
+            return min(
+                (a, b), key=lambda h: self._state[h.name].outstanding
+            )
+
+    def _on_host_done(self, entry: _Flight, host, fut) -> None:
+        exc = fut.exception()
+        with self._lock:
+            st = self._state.get(host.name)
+            if st is not None:
+                st.outstanding = max(0, st.outstanding - 1)
+        if exc is None:
+            with self._lock:
+                if self._state.get(host.name) is not None:
+                    self._state[host.name].dispatch_fails = 0
+            self._finish(entry, result=fut.result())
+            return
+        if isinstance(exc, ServeError) and not isinstance(
+            exc, (ServerClosedError, QueueFullError)
+        ):
+            # The REQUEST's own fault (bad shape, preprocess crash on its
+            # payload): propagate — re-dispatching a poison request would
+            # just poison another host's flush.
+            self._finish(entry, error=exc)
+            return
+        # Host-shaped failure (closed mid-flight, device error): count it
+        # against the host and re-dispatch the request — the no-accepted-
+        # request-lost contract.
+        self._note_dispatch_failure(host)
+        self._redispatch(entry, came_from=host.name)
+
+    def _finish(self, entry: _Flight, result=None, error=None) -> None:
+        with self._lock:
+            if entry.finished:
+                return  # duplicate completion (old host outlived a drain)
+            entry.finished = True
+            self._inflight.pop(entry.fid, None)
+            self._tokens += 1
+            now = time.monotonic()
+            if self._done_t is not None:
+                inst = 1.0 / max(now - self._done_t, 1e-6)
+                self._done_rate = (
+                    inst if self._done_rate is None
+                    else 0.9 * self._done_rate + 0.1 * inst
+                )
+            self._done_t = now
+        if error is not None:
+            entry.future.set_exception(error)
+        else:
+            entry.future.set_result(result)
+
+    def _redispatch(self, entry: _Flight, came_from: str) -> None:
+        """Exactly-once re-dispatch: the caller must have observed the
+        failure of ``came_from`` — the claim (entry.host reset + log
+        append) happens under the lock, so a probe-driven drain and a
+        future-callback failure can never both re-dispatch one entry."""
+        with self._lock:
+            if (
+                entry.finished
+                or entry.redispatching  # claimed, new host not assigned yet
+                or entry.host != came_from  # completed/claimed elsewhere
+            ):
+                return
+            if entry.redispatches >= self._max_redispatches:
+                claimed = False
+            else:
+                entry.host = None
+                entry.redispatching = True
+                entry.redispatches += 1
+                self.redispatch_log.append(entry.fid)
+                claimed = True
+        if not claimed:
+            self._finish(
+                entry,
+                error=ServeError(
+                    f"request failed on {entry.redispatches + 1} host(s)"
+                ),
+            )
+            return
+        # Bounded retry: the surviving hosts may be momentarily full
+        # right after a failover (they just inherited a host's load).
+        for attempt in range(3):
+            try:
+                self._dispatch(entry, exclude=frozenset({came_from}))
+                return
+            except QueueFullError:
+                time.sleep(0.05 * (attempt + 1))
+            except BaseException as e:  # noqa: BLE001
+                self._finish(entry, error=e)
+                return
+        self._finish(
+            entry,
+            error=QueueFullError(
+                "fleet saturated during failover re-dispatch",
+                retry_after_ms=self._retry_hint_locked(),
+            ),
+        )
+
+    # ------------------------------------------------------------- failover
+
+    def _note_dispatch_failure(self, host) -> None:
+        with self._lock:
+            st = self._state.get(host.name)
+            if st is None or host.name in self._dead:
+                return
+            st.dispatch_fails += 1
+            trip = st.dispatch_fails >= self._fail_probes or not host.alive()
+        if trip:
+            self._fail_host(host, reason="dispatch failures")
+
+    def _fail_host(self, host, reason: str) -> None:
+        """Drain ``host``: out of rotation, in-flight re-dispatched
+        (exactly once each), spare promoted. Idempotent per host."""
+        with self._lock:
+            if host.name in self._dead or self._closed:
+                return
+            self._dead.add(host.name)
+            self._active = [h for h in self._active if h.name != host.name]
+            claimed = [
+                e for e in self._inflight.values()
+                if e.host == host.name and not e.finished
+            ]
+            promoted = self._spare
+            if promoted is not None:
+                self._active.append(promoted)
+                self._spare = None
+        self._logger.warning(
+            "fleet: draining host %s (%s) — re-dispatching %d in-flight "
+            "request(s)%s",
+            host.name, reason, len(claimed),
+            f", promoting spare {promoted.name}" if promoted else
+            ", NO spare left",
+        )
+        self.failovers.append(host.name)
+        if self._metrics is not None:
+            self._metrics.write({
+                "kind": "fleet",
+                "event": "failover",
+                "host": host.name,
+                "detail": reason,
+                "redispatched": len(claimed),
+                "spare": promoted.name if promoted else None,
+            })
+        # Kill the drained host OFF this thread: close() joins its worker
+        # threads, and the drain decision may be running on a callback.
+        threading.Thread(
+            target=self._safe_kill, args=(host,), name="fleet-drain",
+            daemon=True,
+        ).start()
+        for entry in claimed:
+            self._redispatch(entry, came_from=host.name)
+
+    def _safe_kill(self, host) -> None:
+        try:
+            host.kill()
+        except Exception as e:  # noqa: BLE001 — it is already dead to us
+            self._logger.warning("fleet: drained-host close failed: %s", e)
+
+    def _maybe_kill_gate(self, host, dispatched_total: int) -> None:
+        """Deterministic chaos (registered serve fault gates): hard-kill
+        the targeted host after its Nth dispatched request, announcing
+        with a ``kind="fault"`` record first — the inject_faults.py
+        discipline (a gate never strikes silently)."""
+        from mpi_pytorch_tpu.utils.env import env_int
+
+        after = env_int("MPT_FAULT_SERVE_KILL_AFTER", 0)
+        if after <= 0 or dispatched_total != after:
+            return
+        if env_int("MPT_FAULT_SERVE_KILL_HOST", -1) != host.index:
+            return
+        if self._metrics is not None:
+            self._metrics.write({
+                "kind": "fault",
+                "reason": "injected_host_kill",
+                "detail": f"host {host.name} after {after} dispatches",
+            })
+        threading.Thread(
+            target=self._safe_kill, args=(host,), name="fleet-kill-gate",
+            daemon=True,
+        ).start()
+
+    # --------------------------------------------------------------- probes
+
+    def _probe_loop(self) -> None:
+        while not self._probe_stop.wait(self._probe_interval_s):
+            try:
+                self._probe_once()
+            except Exception as e:  # noqa: BLE001 — probing must not die
+                self._logger.warning("fleet probe error: %s", e)
+
+    def _probe_once(self) -> None:
+        self._probe_ticks += 1
+        with self._lock:
+            hosts = [
+                h for h in self._active if h.name not in self._dead
+            ]
+            spare = self._spare
+        for host in hosts:
+            ok = False
+            try:
+                if host.alive():
+                    snap = host.snapshot()
+                    self._score_from_snapshot(host, snap)
+                    ok = True
+            except Exception:  # noqa: BLE001 — an unreachable host
+                ok = False
+            trip = False
+            with self._lock:
+                st = self._state[host.name]
+                if ok:
+                    st.probe_fails = 0
+                else:
+                    st.probe_fails += 1
+                    trip = st.probe_fails >= self._fail_probes
+            if trip:
+                self._fail_host(host, reason="health-probe failures")
+        if spare is not None:
+            self._warm_spare(spare)
+        if self._probe_ticks % self._route_record_every == 0:
+            self._write_route_records()
+
+    def _score_from_snapshot(self, host, snap: dict) -> None:
+        """snapshot → EWMA score: queue depth + in-flight fill, the load
+        the next request would queue behind."""
+        gauges = snap.get("gauges", {})
+        counters = snap.get("counters", {})
+        qd = gauges.get("serve/queue_depth") or 0.0
+        # Every admitted request leaves the pipeline exactly one of three
+        # ways (served / rejected / failed) — subtracting all three keeps
+        # a past failure burst from reading as phantom in-flight load.
+        outstanding = max(
+            0.0,
+            counters.get("serve/requests", 0.0)
+            - counters.get("serve/served", 0.0)
+            - counters.get("serve/rejected", 0.0)
+            - counters.get("serve/failed", 0.0),
+        )
+        in_flight = max(0.0, outstanding - qd)
+        raw = qd + in_flight
+        with self._lock:
+            st = self._state[host.name]
+            st.score = (
+                raw if st.score is None
+                else (1 - self._alpha) * st.score + self._alpha * raw
+            )
+            st.snapshot_t = time.monotonic()
+
+    def _warm_spare(self, spare) -> None:
+        """The standby's only traffic: one synthetic request per probe
+        tick — keeps its executables hot and doubles as its health
+        probe (a spare that cannot serve warmup traffic is not a spare)."""
+        if self._warmup_payload is None:
+            return
+        trip = False
+        try:
+            fut = spare.submit(self._warmup_payload)
+
+            def _done(f):
+                if f.exception() is None:
+                    self._spare_warmups += 1
+
+            fut.add_done_callback(_done)
+            with self._lock:
+                self._state[spare.name].probe_fails = 0
+        except Exception:  # noqa: BLE001 — the spare itself is sick
+            with self._lock:
+                st = self._state[spare.name]
+                st.probe_fails += 1
+                trip = st.probe_fails >= self._fail_probes
+        if trip:
+            with self._lock:
+                if self._spare is spare:
+                    self._spare = None
+                    self._dead.add(spare.name)
+            self._logger.warning(
+                "fleet: warm spare %s failed %d warmup probes — retired",
+                spare.name, self._fail_probes,
+            )
+
+    def _write_route_records(self, force: bool = False) -> None:
+        if self._metrics is None:
+            return
+        now = time.monotonic()
+        window_s = now - self._window_t
+        with self._lock:
+            hosts = list(self._active)
+            rows = []
+            total = sum(
+                self._state[h.name].window_requests for h in hosts
+            ) or 1
+            for h in hosts:
+                st = self._state[h.name]
+                if st.window_requests == 0 and not force:
+                    continue
+                rows.append({
+                    "kind": "route",
+                    "host": h.name,
+                    "requests": st.window_requests,
+                    "share": round(st.window_requests / total, 4),
+                    "score": None if st.score is None
+                    else round(st.score, 3),
+                    "queue_depth": h.qsize(),
+                    "inflight": st.outstanding,
+                    "window_s": round(window_s, 3),
+                })
+                st.window_requests = 0
+            self._window_t = now
+        for row in rows:
+            self._metrics.write(row)
+
+    # ------------------------------------------------------------ inspection
+
+    def active_hosts(self) -> list:
+        with self._lock:
+            return [h for h in self._active if h.name not in self._dead]
+
+    def spare_host(self):
+        with self._lock:
+            return self._spare
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hosts": [h.name for h in self._active
+                          if h.name not in self._dead],
+                "dead": sorted(self._dead),
+                "spare": self._spare.name if self._spare else None,
+                "budget": self.budget,
+                "tokens_free": self._tokens,
+                "inflight": len(self._inflight),
+                "front_door_rejections": self.front_door_rejections,
+                "redispatched": len(self.redispatch_log),
+                "failovers": list(self.failovers),
+                "spare_warmups": self._spare_warmups,
+                "dispatched_by_host": {
+                    name: st.dispatched_total
+                    for name, st in sorted(self._state.items())
+                },
+            }
+
+    # -------------------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        """Stop probing, flush the last routing window, close every host
+        (spare included). Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._probe_stop.set()
+        self._probe_thread.join(timeout=10)
+        self._write_route_records(force=True)
+        with self._lock:
+            hosts = list(self._active)
+            if self._spare is not None:
+                hosts.append(self._spare)
+        for h in hosts:
+            try:
+                h.close()
+            except Exception as e:  # noqa: BLE001
+                self._logger.warning("fleet host close failed: %s", e)

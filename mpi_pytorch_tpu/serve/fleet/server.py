@@ -1,0 +1,213 @@
+"""FleetServer: N dynamic-batching hosts behind the router, one handle.
+
+The in-process fleet harness — N ``InferenceServer`` replicas (threads)
+plus an optional warm spare, fronted by ``FleetRouter`` and optionally
+retuned by ``FleetController``. This is the shape ``tools/bench_serve.py
+--fleet N``, the ``_dryrun_fleet`` CI leg, and the tests drive; in a real
+deployment each host is its own PROCESS over its own chips
+(``serve.local_replica_mesh()``) and the router talks the same
+``HostHandle`` surface over HTTP (``/metricsz`` is already served,
+``serve/http.py``) — the router and controller never know the
+difference, that is the point of the handle.
+
+Cost model: all hosts share ONE ``BucketExecutables`` (and the placed
+params behind it — predict is read-only), so an N-host local fleet pays
+one warmup compile set, not N. Per-host state is the part that matters
+for routing: each host has its own bounded queue, batcher, preprocess
+pool, and metrics registry.
+
+All hosts, the router, and the controller write into one shared metrics
+stream (``cfg.metrics_file``): ``kind="serve"`` flushes tagged per host
+by the registry snapshots, ``kind="route"`` windows, ``kind="fleet"``
+failover/retune events — ``tools/report_run.py`` renders the lot.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpi_pytorch_tpu.serve.batcher import ServeError
+from mpi_pytorch_tpu.serve.fleet.controller import FleetController
+from mpi_pytorch_tpu.serve.fleet.router import FleetRouter, LocalHost
+
+
+class FleetServer:
+    """N serving hosts + router (+ spare, + controller) as one server."""
+
+    def __init__(
+        self,
+        cfg,
+        *,
+        n_hosts: int | None = None,
+        spare: bool | None = None,
+        load_checkpoint: bool = True,
+        state=None,
+        mesh=None,
+        executables=None,
+    ):
+        from mpi_pytorch_tpu.serve.executables import BucketExecutables
+        from mpi_pytorch_tpu.serve.server import InferenceServer
+        from mpi_pytorch_tpu.utils.logging import MetricsWriter, run_logger
+
+        n = int(n_hosts if n_hosts is not None else cfg.serve_fleet_hosts)
+        if n < 1:
+            raise ServeError(
+                f"a fleet needs at least one host, got n_hosts={n} "
+                "(set --serve-fleet-hosts or pass n_hosts)"
+            )
+        want_spare = bool(
+            cfg.serve_fleet_spare if spare is None else spare
+        )
+        if cfg.serve_metrics_port > 0 and n + want_spare > 1:
+            raise ServeError(
+                "a fixed --serve-metrics-port cannot be shared by "
+                f"{n + want_spare} in-process hosts; use -1 (ephemeral "
+                "per host) or 0 (off)"
+            )
+        self.cfg = cfg
+        self._logger = run_logger()
+
+        if executables is None:
+            import jax
+
+            if mesh is None:
+                if jax.process_count() > 1:
+                    raise ServeError(
+                        "the in-process fleet harness is single-process; "
+                        "on a multi-process world run one fleet host per "
+                        "process over serve.local_replica_mesh() and front "
+                        "them with FleetRouter directly"
+                    )
+                from mpi_pytorch_tpu.parallel.mesh import create_mesh
+
+                mesh = create_mesh(cfg.mesh)
+            if state is None:
+                state = InferenceServer._build_state(
+                    cfg, mesh, load_checkpoint
+                )
+            from mpi_pytorch_tpu.train.step import place_state_on_mesh
+
+            state = place_state_on_mesh(state, mesh)
+            executables = BucketExecutables(
+                cfg, state, mesh, logger=self._logger
+            )
+            executables.warmup()
+        self._exe = executables
+
+        self._metrics = MetricsWriter(cfg.metrics_file)
+        total = n + (1 if want_spare else 0)
+        servers = []
+        try:
+            for i in range(total):
+                servers.append(InferenceServer(
+                    cfg, executables=executables, metrics=self._metrics,
+                    host_index=i,
+                ))
+        except BaseException:
+            for s in servers:
+                s.close(drain=False)
+            self._metrics.close()
+            raise
+        self._servers = servers
+        hosts = [LocalHost(s) for s in servers[:n]]
+        spare_host = LocalHost(servers[n]) if want_spare else None
+
+        # Warmup payload for the spare's keep-warm traffic: a filler
+        # request in the loader contract's raw-pixels form.
+        warmup_payload = np.zeros((*cfg.image_size, 3), np.uint8)
+        self.router = FleetRouter(
+            hosts, spare_host,
+            metrics=self._metrics,
+            admission_tokens=cfg.serve_admission_tokens,
+            probe_interval_s=cfg.serve_probe_interval_ms / 1e3,
+            fail_probes=cfg.serve_fail_probes,
+            warmup_payload=warmup_payload,
+            logger=self._logger,
+        )
+        self.controller = None
+        if cfg.serve_target_p99_ms > 0:
+            self.controller = FleetController(
+                self.router.active_hosts,
+                target_p99_ms=cfg.serve_target_p99_ms,
+                metrics=self._metrics,
+                interval_s=cfg.serve_retune_interval_s,
+                max_wait_ms_cap=max(
+                    cfg.serve_max_wait_ms * 4.0, cfg.serve_max_wait_ms + 1.0
+                ),
+                logger=self._logger,
+            )
+            self.controller.start()
+        self._closed = False
+        self._logger.info(
+            "fleet: %d host(s)%s behind the router (budget %d, probe "
+            "every %.0f ms, controller %s)",
+            n, " + warm spare" if want_spare else "", self.router.budget,
+            cfg.serve_probe_interval_ms,
+            "off" if self.controller is None
+            else f"targeting p99 {cfg.serve_target_p99_ms} ms",
+        )
+
+    # ------------------------------------------------------------ requests
+
+    def submit(self, image):
+        return self.router.submit(image)
+
+    def predict_batch(self, images, timeout: float | None = None):
+        return self.router.predict_batch(images, timeout=timeout)
+
+    # ----------------------------------------------------------- inspection
+
+    def hosts(self) -> list:
+        return self.router.active_hosts()
+
+    def set_max_wait_ms(self, max_wait_ms: float) -> None:
+        """Retune every live host's flush deadline (the bench sweep lever;
+        the controller does this per host with its own policy)."""
+        for h in self.router.active_hosts():
+            h.set_max_wait_ms(max_wait_ms)
+        spare = self.router.spare_host()
+        if spare is not None:
+            spare.set_max_wait_ms(max_wait_ms)
+
+    def host_snapshots(self) -> dict:
+        """name → live registry snapshot, for every host still serving —
+        the per-host breakdown ``bench_serve --fleet`` reports."""
+        return {h.name: h.snapshot() for h in self.router.active_hosts()}
+
+    def stats(self) -> dict:
+        """Fleet-level counters. Top-level ``served``/``padded_rows``/
+        ``rejected``/``compiles_after_warmup`` aggregate over the LIVE
+        hosts so single-server drivers (``bench_serve.run_point``) work
+        against a fleet unchanged."""
+        hosts = {h.name: h.stats() for h in self.router.active_hosts()}
+        out = {
+            "hosts": hosts,
+            "router": self.router.stats(),
+            "served": sum(s["served"] for s in hosts.values()),
+            "rejected": sum(s["rejected"] for s in hosts.values()),
+            "padded_rows": sum(s["padded_rows"] for s in hosts.values()),
+            "compiles_after_warmup": max(
+                (s["compiles_after_warmup"] for s in hosts.values()),
+                default=0,
+            ),
+        }
+        return out
+
+    # ------------------------------------------------------------ lifecycle
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.controller is not None:
+            self.controller.stop()
+        # Router close drains every host (spare included); each host
+        # flushes its final registry snapshot into the shared stream.
+        self.router.close()
+        self._metrics.close()
+
+    def __enter__(self) -> "FleetServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -98,6 +98,8 @@ class InferenceServer:
         mesh=None,
         load_checkpoint: bool = True,
         metrics=None,
+        executables=None,
+        host_index: int | None = None,
     ):
         import jax
 
@@ -114,30 +116,43 @@ class InferenceServer:
         apply_runtime_flags(cfg)
         self.cfg = cfg
         self._logger = run_logger()
-        if mesh is None:
-            if jax.process_count() > 1:
+        # Fleet identity (serve/fleet/): the in-process N-host harness
+        # tags each replica with its host index — the analogue of a
+        # process index for the per-host fault gates — and a stable name
+        # for route/fleet records. None = plain single-host serving.
+        self.host_index = host_index
+        self.name = "serve" if host_index is None else f"h{host_index}"
+        if executables is not None:
+            # A pre-built (shared) executable set: the fleet harness
+            # compiles ONE BucketExecutables and hands it to every host,
+            # so an N-host local fleet costs one warmup compile set, not
+            # N. State/mesh building is the executable owner's job.
+            self.mesh = mesh if mesh is not None else executables._mesh
+        else:
+            if mesh is None:
+                if jax.process_count() > 1:
+                    raise ServeError(
+                        "multi-process serving runs one replica per host: pass "
+                        "mesh=serve.local_replica_mesh() (a global mesh would "
+                        "turn every flush into a pod-wide collective)"
+                    )
+                from mpi_pytorch_tpu.parallel.mesh import create_mesh
+
+                mesh = create_mesh(cfg.mesh)
+            if any(
+                d.process_index != jax.process_index() for d in mesh.devices.flat
+            ):
                 raise ServeError(
-                    "multi-process serving runs one replica per host: pass "
-                    "mesh=serve.local_replica_mesh() (a global mesh would "
-                    "turn every flush into a pod-wide collective)"
+                    "the serve mesh must be fully addressable by this process "
+                    "(use serve.local_replica_mesh() on multi-host)"
                 )
-            from mpi_pytorch_tpu.parallel.mesh import create_mesh
+            self.mesh = mesh
 
-            mesh = create_mesh(cfg.mesh)
-        if any(
-            d.process_index != jax.process_index() for d in mesh.devices.flat
-        ):
-            raise ServeError(
-                "the serve mesh must be fully addressable by this process "
-                "(use serve.local_replica_mesh() on multi-host)"
-            )
-        self.mesh = mesh
+            if state is None:
+                state = self._build_state(cfg, mesh, load_checkpoint)
+            from mpi_pytorch_tpu.train.step import place_state_on_mesh
 
-        if state is None:
-            state = self._build_state(cfg, mesh, load_checkpoint)
-        from mpi_pytorch_tpu.train.step import place_state_on_mesh
-
-        state = place_state_on_mesh(state, mesh)
+            state = place_state_on_mesh(state, mesh)
 
         # metrics=None → the cfg's stream (kind="serve" records); pass an
         # explicit MetricsWriter to share a stream, or one over "" to mute.
@@ -162,6 +177,11 @@ class InferenceServer:
         self._m_requests = self._registry.counter("serve/requests")
         self._m_rejected = self._registry.counter("serve/rejected")
         self._m_served = self._registry.counter("serve/served")
+        # Failed requests (preprocess crash, flush error, abandoned on
+        # close) — without this counter, requests − served − rejected
+        # over-counts a host's in-flight load forever after any failure
+        # (the fleet router's score reads exactly that difference).
+        self._m_failed = self._registry.counter("serve/failed")
         self._m_flush_ms = self._registry.histogram("serve/flush_ms")
         self._m_req_ms = self._registry.histogram("serve/request_latency_ms")
         self._m_qwait_ms = self._registry.histogram("serve/queue_wait_ms")
@@ -194,10 +214,17 @@ class InferenceServer:
         # the aborted startup is exactly the one whose trace is needed
         # (the trainer's failure-path discipline).
         try:
-            self._exe = BucketExecutables(cfg, state, mesh, logger=self._logger)
+            if executables is not None:
+                self._exe = executables
+                if not self._exe.warm:
+                    self._exe.warmup()
+            else:
+                self._exe = BucketExecutables(
+                    cfg, state, self.mesh, logger=self._logger
+                )
+                self._exe.warmup()  # zero steady-state compiles from here on
             self.buckets = self._exe.buckets
             self.topk = self._exe.topk
-            self._exe.warmup()  # zero steady-state compiles from here on
 
             self._batcher = DynamicBatcher(
                 self.buckets, cfg.serve_max_wait_ms / 1e3, cfg.serve_queue_depth
@@ -435,16 +462,16 @@ class InferenceServer:
             if self._abandon:
                 self._fail(flush, ServerClosedError("server closed without drain"))
                 continue
+            members = list(flush)  # everyone riding this flush (incl. top-up)
             try:
                 # Resolve the pool's preprocess futures (usually already
                 # done — they started at submit time). A bad request fails
                 # its own future only; the batch goes on without it.
                 rows, good, prep_failures = [], [], 0
-                prep_args = {"n": len(flush)}
-                if self._tracer.enabled:
-                    prep_args["req_ids"] = [r.req_id for r in flush]
-                with self._tracer.span("serve/preprocess", args=prep_args):
-                    for req in flush:
+
+                def resolve(reqs) -> None:
+                    nonlocal prep_failures
+                    for req in reqs:
                         try:
                             rows.append(req.payload.result())
                             good.append(req)
@@ -459,6 +486,31 @@ class InferenceServer:
                                 )
                             prep_failures += 1
                             self._fail([req], e)
+
+                prep_args = {"n": len(flush)}
+                if self._tracer.enabled:
+                    prep_args["req_ids"] = [r.req_id for r in flush]
+                with self._tracer.span("serve/preprocess", args=prep_args):
+                    resolve(flush)
+                # Continuous batching (ISSUE 9): while this flush was being
+                # formed and preprocessed, flush n-1 is on-device and the
+                # queue kept admitting — top up to the largest ACTIVE
+                # bucket with whatever has arrived since next_flush()
+                # returned, so late arrivals ride NOW instead of waiting
+                # out another deadline. Their payloads preprocess on the
+                # pool like everyone else's (started at submit), and they
+                # get their own preprocess span so per-request trace ids
+                # still thread every phase.
+                extra = self._batcher.drain_ready(
+                    self._batcher.active_buckets[-1] - len(good)
+                )
+                if extra:
+                    members += extra
+                    topup_args = {"n": len(extra), "topup": True}
+                    if self._tracer.enabled:
+                        topup_args["req_ids"] = [r.req_id for r in extra]
+                    with self._tracer.span("serve/preprocess", args=topup_args):
+                        resolve(extra)
                 if prep_failures:
                     with self._lock:
                         self._stats["preprocess_failures"] += prep_failures
@@ -477,7 +529,8 @@ class InferenceServer:
                     )
                     continue
                 t_prep = time.monotonic()
-                bucket = pick_bucket(len(good), self.buckets)
+                self._maybe_fault_delay()
+                bucket = pick_bucket(len(good), self._batcher.active_buckets)
                 labels = np.full((len(good),), -1, np.int32)
                 images, labels = pad_batch(np.stack(rows), labels, bucket)
                 dispatch_args = {"bucket": bucket, "requests": len(good)}
@@ -501,7 +554,24 @@ class InferenceServer:
                 )
             except BaseException as e:  # noqa: BLE001 — keep serving
                 self._logger.error("serve batch loop error: %s", e)
-                self._fail(flush, e)
+                self._fail(members, e)
+
+    def _maybe_fault_delay(self) -> None:
+        """The fake-slow-host gate for FLEET hosts only (host_index set):
+        MPT_FAULT_DELAY_STEP_MS sleeps inside the batch loop before every
+        dispatch — throughput drops, the queue builds, and the router's
+        load-aware dispatch must observe it and route around this host.
+        MPT_FAULT_DELAY_PROCESS restricts the delay to one host index."""
+        if self.host_index is None:
+            return
+        from mpi_pytorch_tpu.utils.env import env_int
+
+        delay_ms = env_int("MPT_FAULT_DELAY_STEP_MS", 0)
+        if delay_ms <= 0:
+            return
+        target = env_int("MPT_FAULT_DELAY_PROCESS", -1)
+        if target < 0 or target == self.host_index:
+            time.sleep(delay_ms / 1e3)
 
     def _completion_loop(self) -> None:
         import jax
@@ -576,6 +646,7 @@ class InferenceServer:
     def _fail(self, requests, exc) -> None:
         with self._lock:
             self._stats["failed"] += len(requests)
+        self._m_failed.inc(len(requests))
         for req in requests:
             if not req.future.done():
                 req.future.set_exception(exc)
@@ -587,6 +658,26 @@ class InferenceServer:
         flush) — lets ``tools/bench_serve.py`` sweep the latency lever
         without rebuilding (and recompiling) the server."""
         self._batcher.max_wait_s = float(max_wait_ms) / 1e3
+
+    @property
+    def max_wait_ms(self) -> float:
+        return self._batcher.max_wait_s * 1e3
+
+    @property
+    def active_buckets(self) -> tuple[int, ...]:
+        """The bucket subset the flush policy currently targets (always ⊆
+        the compiled set)."""
+        return self._batcher.active_buckets
+
+    def set_active_buckets(self, buckets) -> None:
+        """Retarget the batcher at a subset of the COMPILED bucket set —
+        the fleet controller's live bucket lever. A bucket outside the
+        construction-time set is a typed error: a retune can only ever
+        ACTIVATE pre-compiled executables, never cause a compile."""
+        try:
+            self._batcher.set_active_buckets(buckets)
+        except ValueError as e:
+            raise ServeError(str(e)) from None
 
     def stats(self) -> dict:
         """Counters + the steady-state compile assertion surface."""
@@ -600,7 +691,13 @@ class InferenceServer:
 
     def registry_snapshot(self) -> dict:
         """The live registry's snapshot — the in-process read a colocated
-        controller uses (the HTTP /metricsz endpoint serves the same)."""
+        controller uses (the HTTP /metricsz endpoint serves the same).
+        The queue-depth and compile gauges are refreshed first: they are
+        otherwise only stamped per flush (completion loop), and the fleet
+        router scores hosts off exactly this snapshot — a busy host whose
+        completion loop is behind must not look idle."""
+        self._g_qdepth.set(self._batcher.qsize())
+        self._g_compiles.set(self._exe.compiles_since_warmup())
         return self._registry.snapshot()
 
     @property

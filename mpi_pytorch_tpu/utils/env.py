@@ -57,11 +57,15 @@ FAULT_GATES: dict[str, str] = {
     ),
     "MPT_FAULT_DELAY_STEP_MS": (
         "sleep this many ms inside every timed train step — fakes a "
-        "straggler host for the heartbeat/watchdog path"
+        "straggler host for the heartbeat/watchdog path. On a serve FLEET "
+        "host (serve/fleet/) the same gate delays every dispatched flush "
+        "instead, faking a slow serving host for the router's load-aware "
+        "dispatch"
     ),
     "MPT_FAULT_DELAY_PROCESS": (
-        "restrict MPT_FAULT_DELAY_STEP_MS to this process index "
-        "(unset/-1 = every process)"
+        "restrict MPT_FAULT_DELAY_STEP_MS to this process index — or, on "
+        "an in-process serve fleet, to this fleet-host index "
+        "(unset/-1 = every process/host)"
     ),
     "MPT_FAULT_DELAY_AFTER_STEP": (
         "start MPT_FAULT_DELAY_STEP_MS only after this many steps have run "
@@ -81,6 +85,16 @@ FAULT_GATES: dict[str, str] = {
         "make the first N serve preprocess calls raise a non-ServeError — "
         "the preprocess-worker-crash scenario (typed PreprocessError to "
         "the caller, pool respawn)"
+    ),
+    "MPT_FAULT_SERVE_KILL_HOST": (
+        "fleet-host index the serve kill gate targets (with "
+        "MPT_FAULT_SERVE_KILL_AFTER) — the router hard-kills that host "
+        "mid-traffic so the failover path (drain, re-dispatch in-flight "
+        "by req_id, promote the warm spare) runs deterministically"
+    ),
+    "MPT_FAULT_SERVE_KILL_AFTER": (
+        "kill the MPT_FAULT_SERVE_KILL_HOST host after this many requests "
+        "have been dispatched to it (0 = gate off)"
     ),
     "MPT_PREEMPT_FILE": (
         "path to a preemption sentinel: when the file exists, the trainer's "

@@ -22,9 +22,17 @@ number worth quoting. Chip rows are staged per the artifact discipline
 (docs/RESULTS.md staleness ledger) until a driver-confirmed TPU battery
 refreshes them.
 
+``--fleet N`` drives the same sweeps against a local N-host FLEET
+(threads on the CPU/host mesh, one shared executable set) through the
+load-aware router (``serve/fleet/``): rejections are the front door's
+admission control, and each row gains ``fleet_hosts`` plus a ``per_host``
+fill/latency breakdown from the hosts' registry snapshots — how evenly
+the router actually spread the load.
+
 Run: ``python tools/bench_serve.py --smoke [--out docs/serve_bench.json]``
      ``python tools/bench_serve.py --bucket-sets "1,8,32,128;1,32,512" \
         --max-wait-ms 2,5,10 --requests 2000 --rps 0,500,2000``
+     ``python tools/bench_serve.py --smoke --fleet 3``
 """
 
 from __future__ import annotations
@@ -137,8 +145,42 @@ def open_loop(server, pool, requests: int, rps: float, seed: int, timeout_s: flo
     return lat_ms, time.monotonic() - t0, rejected
 
 
-def run_point(server, pool, *, mode, requests, concurrency, rps, seed, timeout_s):
+def _delta_mean(snap1, snap0, hist_name):
+    """Mean of a registry histogram over THIS sweep point only: the
+    sketches are cumulative across a server's life, so per-point means
+    come from (sum, count) deltas (percentiles cannot be delta'd from
+    summaries — the per-point tail is the top-level row's job)."""
+    h1 = snap1.get("histograms", {}).get(hist_name) or {}
+    h0 = snap0.get("histograms", {}).get(hist_name) or {}
+    n = h1.get("count", 0) - h0.get("count", 0)
+    if n <= 0:
+        return None
+    return round((h1.get("sum", 0.0) - h0.get("sum", 0.0)) / n, 3)
+
+
+def _per_host_breakdown(snaps0, snaps1, stats0, stats1) -> dict:
+    """The --fleet rows' per-host fill/latency table — all values are
+    deltas over this sweep point (a host promoted mid-point, e.g. the
+    spare after a failover, diffs against empty)."""
+    out = {}
+    for name, snap in sorted(snaps1.items()):
+        snap0 = snaps0.get(name, {})
+        served0 = stats0["hosts"].get(name, {}).get("served", 0)
+        served1 = stats1["hosts"].get(name, {}).get("served", 0)
+        out[name] = {
+            "requests": served1 - served0,
+            "fill_pct": _delta_mean(snap, snap0, "serve/fill_pct"),
+            "mean_ms": _delta_mean(
+                snap, snap0, "serve/request_latency_ms"
+            ),
+        }
+    return out
+
+
+def run_point(server, pool, *, mode, requests, concurrency, rps, seed, timeout_s,
+              fleet_hosts=0):
     stats0 = server.stats()
+    snaps0 = server.host_snapshots() if fleet_hosts else None
     if mode == "open":
         lat_ms, wall, rejected = open_loop(
             server, pool, requests, rps, seed, timeout_s
@@ -163,6 +205,11 @@ def run_point(server, pool, *, mode, requests, concurrency, rps, seed, timeout_s
         "compiles_after_warmup": stats1["compiles_after_warmup"],
         **_percentiles(lat_ms),
     }
+    if fleet_hosts:
+        row["fleet_hosts"] = fleet_hosts
+        row["per_host"] = _per_host_breakdown(
+            snaps0, server.host_snapshots(), stats0, stats1
+        )
     return row
 
 
@@ -185,6 +232,11 @@ def main() -> int:
                     help="comma list of offered open-loop rates; 0 = closed "
                     "loop only for that sweep point")
     ap.add_argument("--queue-depth", type=int, default=1024)
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="N > 0: drive a local N-host fleet (threads, one "
+                    "shared executable set) through the load-aware router "
+                    "instead of a single server; rows gain fleet_hosts + "
+                    "the per_host fill/latency breakdown")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--timeout-s", type=float, default=120.0)
     ap.add_argument("--fused-head", action="store_true",
@@ -200,7 +252,9 @@ def main() -> int:
     if args.smoke:
         args.model, args.image, args.num_classes = "resnet18", 32, 64
         args.topk, args.compute_dtype = 3, "float32"
-        args.bucket_sets = "1,4;1,8"
+        # Fleet smoke: one bucket set (the hosts share its executables,
+        # but each SET is a fresh fleet build — keep tier-1 cheap).
+        args.bucket_sets = "1,4" if args.fleet else "1,4;1,8"
         args.max_wait_ms, args.requests, args.concurrency = "2", 48, 8
         args.rps = "0,400"
 
@@ -219,7 +273,7 @@ def main() -> int:
         jax.config.update("jax_platforms", platform.split(",")[0].strip())
 
     from mpi_pytorch_tpu.config import Config
-    from mpi_pytorch_tpu.serve import InferenceServer
+    from mpi_pytorch_tpu.serve import FleetServer, InferenceServer
 
     out_rows = []
     pool = _image_pool(32, (args.image, args.image), args.seed)
@@ -232,10 +286,14 @@ def main() -> int:
             compute_dtype=args.compute_dtype, serve_buckets=bucket_set,
             serve_max_wait_ms=waits[0], serve_queue_depth=args.queue_depth,
             serve_topk=args.topk, fused_head_eval=args.fused_head,
+            serve_fleet_hosts=max(0, args.fleet),
             metrics_file="", log_file="", eval_log_file="",
         )
         cfg.validate_config()
-        server = InferenceServer(cfg, load_checkpoint=False)
+        if args.fleet > 0:
+            server = FleetServer(cfg, load_checkpoint=False)
+        else:
+            server = InferenceServer(cfg, load_checkpoint=False)
         try:
             for wait_ms in waits:
                 server.set_max_wait_ms(wait_ms)
@@ -245,6 +303,7 @@ def main() -> int:
                         server, pool, mode=mode, requests=args.requests,
                         concurrency=args.concurrency, rps=rps,
                         seed=args.seed, timeout_s=args.timeout_s,
+                        fleet_hosts=max(0, args.fleet),
                     )
                     row.update(
                         model=args.model, buckets=bucket_set,

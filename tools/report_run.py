@@ -11,6 +11,10 @@ run recorded that kind:
 - heartbeat summary (beats, hosts, straggler flags per host);
 - validation/eval rows and anomaly records;
 - serving flush/bench summaries;
+- fleet routing (per-host dispatch share from the router's route
+  windows) and FLEET lines per lifecycle event (failover: drained host,
+  re-dispatched in-flight count, promoted spare; controller retunes:
+  max_wait/bucket changes with the p99-vs-target evidence);
 - elastic-resume lines (topology from → to, ZeRO re-chunking, corrupt
   checkpoints skipped) and fault/preemption signals;
 - SLO alert lines (rule, value vs threshold, actions) and the final live
@@ -215,9 +219,41 @@ def summarize(records: list[dict]) -> dict:
             {k: r.get(k) for k in (
                 "mode", "buckets", "max_wait_ms", "offered_rps", "requests",
                 "rejected", "p50_ms", "p95_ms", "p99_ms", "images_per_sec",
-                "compiles_after_warmup",
+                "compiles_after_warmup", "fleet_hosts",
             )}
             for r in serve_bench
+        ]
+    routes = by_kind.get("route", [])
+    if routes:
+        # Windows are deltas (the router resets per record), so summing
+        # them per host gives each host's total dispatch share.
+        per_host: dict[str, dict] = {}
+        for r in routes:
+            h = per_host.setdefault(
+                r["host"], {"requests": 0, "score": None, "queue_depth": None}
+            )
+            h["requests"] += r["requests"]
+            if r.get("score") is not None:
+                h["score"] = r["score"]  # last observed
+            if r.get("queue_depth") is not None:
+                h["queue_depth"] = r["queue_depth"]
+        total = sum(h["requests"] for h in per_host.values()) or 1
+        for h in per_host.values():
+            h["share_pct"] = round(100.0 * h["requests"] / total, 1)
+        summary["fleet_routing"] = {
+            "total_requests": total,
+            "hosts": dict(sorted(per_host.items())),
+        }
+    fleet_events = by_kind.get("fleet", [])
+    if fleet_events:
+        summary["fleet_events"] = [
+            {k: f.get(k) for k in (
+                "event", "host", "detail", "redispatched", "spare",
+                "max_wait_ms_from", "max_wait_ms_to", "buckets_from",
+                "buckets_to", "p99_ms", "target_p99_ms",
+                "compiles_after_warmup",
+            )}
+            for f in fleet_events
         ]
     anomalies = by_kind.get("anomaly", [])
     if anomalies:
@@ -387,6 +423,38 @@ def render(path: str, records: list[dict], summary: dict) -> str:
               r["images_per_sec"], r.get("compiles_after_warmup")]
              for r in summary["serve_bench"]],
         )]
+    if "fleet_routing" in summary:
+        fr = summary["fleet_routing"]
+        out += ["", (
+            f"fleet routing: {fr['total_requests']} request(s) over "
+            f"{len(fr['hosts'])} host(s)"
+        ), table(
+            ["host", "requests", "share%", "last_score", "last_queue"],
+            [[name, h["requests"], h["share_pct"], h["score"],
+              h["queue_depth"]] for name, h in fr["hosts"].items()],
+        )]
+    for f in summary.get("fleet_events", []):
+        if f["event"] == "failover":
+            line = (
+                f"FLEET failover: host {f.get('host')} drained"
+                + (f" ({f['detail']})" if f.get("detail") else "")
+                + f" — {f.get('redispatched', 0)} in-flight re-dispatched"
+                + (f", spare {f['spare']} promoted" if f.get("spare")
+                   else ", no spare left")
+            )
+        elif f["event"] == "retune":
+            line = (
+                f"FLEET retune: host {f.get('host')} — max_wait "
+                f"{_fmt(f.get('max_wait_ms_from'))} → "
+                f"{_fmt(f.get('max_wait_ms_to'))} ms, buckets "
+                f"{f.get('buckets_from')} → {f.get('buckets_to')} "
+                f"(p99 {_fmt(f.get('p99_ms'))} ms vs target "
+                f"{_fmt(f.get('target_p99_ms'))}; compiles "
+                f"{f.get('compiles_after_warmup')})"
+            )
+        else:
+            line = f"FLEET {f['event']}: {f.get('host')} {f.get('detail') or ''}"
+        out += ["", line]
     for r in summary.get("resumes", []):
         frm = r.get("from_mesh") or (
             f"{r['from_devices']} devices" if r.get("from_devices") is not None
