@@ -520,8 +520,11 @@ def load_checkpoint(path: str, state: Any) -> tuple[Any, int, float]:
         run_logger().warning(
             "resuming from a DIRTY checkpoint (%s): it was saved after a "
             "mid-epoch preemption, so the state already carries part of epoch "
-            "%s+1's updates — replaying that epoch double-applies those "
-            "batches' steps (trajectory may differ from an uninterrupted run)",
+            "%s+1's updates. When the saved data cursor validates, the "
+            "trainer continues EXACTLY at the interrupted step (no replayed "
+            "updates); otherwise that epoch is replayed, double-applying "
+            "those batches' steps (trajectory may differ from an "
+            "uninterrupted run)",
             path, epoch_txt,
         )
     with open(path, "rb") as f:

@@ -356,6 +356,51 @@ class Config:
     # fleet policy, opted into per run).
     preempt_straggler_beats: int = 0
     preempt_nonfinite_steps: int = 0
+    # --- self-healing training (ISSUE 10) ---
+    # What a BAD step (non-finite loss / global grad norm) costs the run:
+    #   abort    — today's behavior: the NaN sentinel writes a diagnostic
+    #              record and raises (obs/health.py).
+    #   skip     — discard the update ON DEVICE (the jitted step selects the
+    #              pre-step params/opt-state when the psum'd grad norm is
+    #              non-finite — every host takes the same branch) and keep
+    #              training; aborts after --max-skipped-steps CONSECUTIVE
+    #              skips. Params across a skipped step are bit-identical.
+    #   rollback — restore the last good checkpoint IN-PROCESS
+    #              (elastic.restore_latest — no process death) when a
+    #              non-finite streak or a loss-spike drift fires
+    #              (train/elastic.RollbackPolicy), optionally backing off
+    #              the LR; bounded by --max-rollbacks, each writing a
+    #              kind="rollback" record (schema v6).
+    # skip/rollback read the step's loss/grad norm on the host, costing one
+    # device sync per step (the --step-metrics cost) — a recovery-policy
+    # run is telemetry-priced by construction. Both disable the NaN
+    # sentinel's hard abort (the policy IS the response).
+    bad_step_policy: str = "abort"
+    # skip: consecutive discarded steps before aborting anyway (something
+    # is systematically wrong, not transient).
+    max_skipped_steps: int = 10
+    # rollback triggers: consecutive non-finite steps, and (0 = off) a
+    # loss-spike ratio vs the run's own warmup baseline — the mean of the
+    # first rollback_drift_warmup finite losses, the SLO monitor's drift:
+    # semantics (obs/monitor.py).
+    rollback_nonfinite_steps: int = 2
+    rollback_loss_drift: float = 0.0
+    rollback_drift_warmup: int = 5
+    # rollback bounds: total in-process restores before aborting, and an
+    # LR scale applied on EACH rollback (1.0 = keep the LR; 0.5 halves it
+    # per rollback — note a scale != 1.0 rebuilds the optimizer and
+    # recompiles the step once per rollback).
+    max_rollbacks: int = 3
+    rollback_lr_backoff: float = 1.0
+    # --- input-pipeline robustness (ISSUE 10 satellite) ---
+    # An unreadable/corrupt image is retried with bounded backoff, then
+    # QUARANTINED: its batch row becomes a masked (label -1) copy of a good
+    # row, its path lands in quarantine_file ("" = no file) and a
+    # kind="anomaly" reason="bad_sample" record is written. More than
+    # max_bad_samples quarantines abort the run loudly (0 = abort on the
+    # first one past zero tolerance).
+    max_bad_samples: int = 16
+    quarantine_file: str = ""
     # Evaluation: also write per-image predictions as CSV
     # (file_name, predicted_label, predicted_category_id) — the Herbarium
     # task's actual deliverable (a submission file), which the reference's
@@ -651,6 +696,58 @@ class Config:
             raise ValueError(
                 "preempt_nonfinite_steps counts per-step grad norms; it "
                 "needs --step-metrics true to ever observe one"
+            )
+        if self.bad_step_policy not in ("abort", "skip", "rollback"):
+            raise ValueError(
+                f"bad_step_policy must be abort|skip|rollback, "
+                f"got {self.bad_step_policy!r}"
+            )
+        if self.max_skipped_steps < 1:
+            raise ValueError(
+                f"max_skipped_steps must be >= 1, got {self.max_skipped_steps}"
+            )
+        if self.rollback_nonfinite_steps < 1:
+            raise ValueError(
+                f"rollback_nonfinite_steps must be >= 1, "
+                f"got {self.rollback_nonfinite_steps}"
+            )
+        if self.rollback_loss_drift != 0.0 and self.rollback_loss_drift <= 1.0:
+            raise ValueError(
+                "rollback_loss_drift is a ratio vs the warmup-baseline loss "
+                f"and must be > 1.0 (0 disables), got {self.rollback_loss_drift}"
+            )
+        if self.rollback_drift_warmup < 1:
+            raise ValueError(
+                f"rollback_drift_warmup must be >= 1, "
+                f"got {self.rollback_drift_warmup}"
+            )
+        if self.max_rollbacks < 1:
+            raise ValueError(
+                f"max_rollbacks must be >= 1, got {self.max_rollbacks}"
+            )
+        if not 0.0 < self.rollback_lr_backoff <= 1.0:
+            raise ValueError(
+                "rollback_lr_backoff is a per-rollback LR scale in (0, 1] "
+                f"(1.0 = no backoff), got {self.rollback_lr_backoff}"
+            )
+        if self.bad_step_policy == "rollback":
+            if self.scan_epoch:
+                raise ValueError(
+                    "bad_step_policy='rollback' watches per-step host "
+                    "values; scan_epoch runs the whole epoch as one "
+                    "device-side scan with no step boundaries — use "
+                    "bad_step_policy='skip' (guarded inside the scan) or "
+                    "drop scan_epoch"
+                )
+            if self.checkpoint_every_epochs < 1:
+                raise ValueError(
+                    "bad_step_policy='rollback' restores the last good "
+                    "checkpoint; it needs checkpoint_every_epochs >= 1 to "
+                    "ever have one"
+                )
+        if self.max_bad_samples < 0:
+            raise ValueError(
+                f"max_bad_samples must be >= 0, got {self.max_bad_samples}"
             )
         if self.heartbeat_every_steps < 0:
             raise ValueError(

@@ -1,10 +1,16 @@
-from mpi_pytorch_tpu.data.manifest import Manifest, build_label_map, load_manifests
+from mpi_pytorch_tpu.data.manifest import (
+    Manifest,
+    build_label_map,
+    load_manifests,
+    manifest_fingerprint,
+)
 from mpi_pytorch_tpu.data.pipeline import DataLoader, decode_image, normalize_image, synthetic_image
 
 __all__ = [
     "Manifest",
     "build_label_map",
     "load_manifests",
+    "manifest_fingerprint",
     "DataLoader",
     "decode_image",
     "normalize_image",

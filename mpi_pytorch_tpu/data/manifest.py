@@ -60,6 +60,24 @@ class Manifest:
         )
 
 
+def manifest_fingerprint(manifest: Manifest) -> str:
+    """Stable digest of a manifest's identity (filenames + labels + size) —
+    the exact-step resume cursor (train/trainer.py) stamps it into the
+    checkpoint's topology sidecar so a resume can PROVE the saved
+    ``epoch_order`` offset still refers to the same dataset walk before
+    fast-forwarding past it. Order-sensitive by design: a reordered CSV is
+    a different walk."""
+    import hashlib
+
+    h = hashlib.sha1()
+    h.update(str(len(manifest)).encode())
+    for name in manifest.filenames:
+        h.update(name.encode())
+        h.update(b"\0")
+    h.update(np.ascontiguousarray(manifest.labels).tobytes())
+    return h.hexdigest()[:16]
+
+
 def _to_manifest(df: pd.DataFrame, img_dir: str, label_map: dict[int, int]) -> Manifest:
     cats = df["category_id"].to_numpy(dtype=np.int64)
     labels = np.asarray([label_map[c] for c in cats], dtype=np.int32)

@@ -21,6 +21,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator
 
@@ -28,6 +29,14 @@ import numpy as np
 
 from mpi_pytorch_tpu.config import IMAGENET_MEAN, IMAGENET_STD
 from mpi_pytorch_tpu.data.manifest import Manifest
+from mpi_pytorch_tpu.utils.env import fault_countdown
+
+
+class BadSampleLimitError(RuntimeError):
+    """More samples failed to decode than ``max_bad_samples`` tolerates.
+    Raised AFTER the final sample was quarantined and recorded, so the
+    abort carries a full quarantine trail — a dataset rotting past the
+    budget must fail the run loudly, not train on substitute rows."""
 
 _MEAN = np.asarray(IMAGENET_MEAN, dtype=np.float32)
 _STD = np.asarray(IMAGENET_STD, dtype=np.float32)
@@ -122,6 +131,10 @@ class DataLoader:
         decode_prescale: int = 2,
         host_cache: bool = False,
         packed_dir: str = "",
+        max_bad_samples: int = 16,
+        quarantine_file: str = "",
+        decode_retries: int = 2,
+        decode_retry_backoff_s: float = 0.05,
     ):
         self.manifest = manifest
         self.batch_size = batch_size
@@ -133,6 +146,25 @@ class DataLoader:
         self.num_workers = max(1, num_workers)
         self.prefetch = max(1, prefetch)
         self.decode_prescale = decode_prescale
+        # Decode-failure robustness: a sample that still fails after
+        # ``decode_retries`` bounded-backoff retries is QUARANTINED — its
+        # batch row becomes a copy of a good row with label -1 (masked by
+        # the loss exactly like padding), its path is appended to
+        # ``quarantine_file`` ("" = no file) and a kind="anomaly"
+        # reason="bad_sample" record is written when a metrics writer is
+        # attached (``self.metrics``, set by the trainer). More than
+        # ``max_bad_samples`` quarantines abort the run loudly
+        # (BadSampleLimitError).
+        self.max_bad_samples = max_bad_samples
+        self.quarantine_file = quarantine_file
+        self.decode_retries = max(0, decode_retries)
+        self.decode_retry_backoff_s = decode_retry_backoff_s
+        self.metrics = None  # optional MetricsWriter, attached post-build
+        self.bad_samples = 0
+        self._quarantined: set[int] = set()  # manifest row indices
+        self._poisoned_decode: set[int] = set()  # MPT_FAULT_DECODE_N victims
+        self._bad_lock = threading.Lock()
+        self._cur_epoch = 0
         # Decode the whole shard ONCE into host RAM (first epoch), then serve
         # every later epoch by slicing — zero decode cost after epoch 0, at
         # the price of n_images × H × W × 3 × dtype host memory. Works
@@ -179,7 +211,98 @@ class DataLoader:
         n = len(self.manifest)
         return n // self.batch_size if self.drop_remainder else -(-n // self.batch_size)
 
+    def _sample_name(self, i: int) -> str:
+        if self.synthetic:
+            return f"synthetic:{int(self.manifest.labels[i])}@{i}"
+        return os.path.join(self.manifest.img_dir, self.manifest.filenames[i])
+
+    def _quarantine(self, i: int, err: BaseException) -> None:
+        """Record one undecodable sample: remember its row (labels mask to
+        -1 from now on, including cached epochs), log it, append the path to
+        the quarantine file, write the anomaly record — then abort loudly
+        once the budget is blown. Runs on worker threads."""
+        from mpi_pytorch_tpu.utils.logging import run_logger
+
+        name = self._sample_name(i)
+        with self._bad_lock:
+            already = i in self._quarantined
+            self._quarantined.add(i)
+            if not already:
+                self.bad_samples += 1
+            count = self.bad_samples
+        if already:
+            return
+        run_logger().warning(
+            "quarantined undecodable sample %s (%d/%d bad allowed): %s",
+            name, count, self.max_bad_samples, err,
+        )
+        if self.quarantine_file:
+            with self._bad_lock:
+                with open(self.quarantine_file, "a") as f:
+                    f.write(f"{name}\t{type(err).__name__}: {err}\n")
+        if self.metrics is not None:
+            self.metrics.write(
+                {
+                    "kind": "anomaly", "reason": "bad_sample",
+                    "epoch": self._cur_epoch, "path": name,
+                    "detail": f"{type(err).__name__}: {err}",
+                }
+            )
+        if count > self.max_bad_samples:
+            raise BadSampleLimitError(
+                f"{count} undecodable samples exceed max_bad_samples="
+                f"{self.max_bad_samples} (latest: {name}: {err}); see the "
+                f"quarantine trail"
+            ) from err
+
+    def _decode_with_retries(self, i: int) -> np.ndarray | None:
+        """``_load_one`` behind bounded-backoff retries; None = quarantined
+        (the caller substitutes a good row and masks the label)."""
+        delay = self.decode_retry_backoff_s
+        err: BaseException | None = None
+        for attempt in range(self.decode_retries + 1):
+            try:
+                return self._load_one(i)
+            except BadSampleLimitError:
+                raise
+            except Exception as e:
+                err = e
+                if attempt < self.decode_retries and delay > 0:
+                    time.sleep(delay)
+                    delay *= 2
+        self._quarantine(i, err)
+        return None
+
+    def _masked_labels(self, idx: np.ndarray) -> np.ndarray:
+        """Batch labels with quarantined rows masked to -1 (the padding
+        label the loss already ignores) — THE label source of every batch
+        path, so a row quarantined in epoch 0 stays masked when later
+        epochs serve it from the host cache."""
+        labels = np.asarray(self.manifest.labels[idx])
+        if self._quarantined:
+            bad = np.fromiter(
+                (int(j) in self._quarantined for j in idx), bool, len(idx)
+            )
+            if bad.any():
+                labels = np.where(bad, np.int32(-1), labels).astype(labels.dtype)
+        return labels
+
     def _load_one(self, i: int) -> np.ndarray:
+        # MPT_FAULT_DECODE_N poisons N DISTINCT samples permanently (one
+        # countdown shot per sample on first draw, then every retry of that
+        # sample fails too) — deterministic regardless of worker-thread
+        # interleaving, so N=1 always quarantines exactly one sample.
+        if int(i) in self._poisoned_decode:
+            raise RuntimeError(
+                f"injected decode failure (MPT_FAULT_DECODE_N) for "
+                f"{self._sample_name(i)}"
+            )
+        if fault_countdown("MPT_FAULT_DECODE_N"):
+            self._poisoned_decode.add(int(i))
+            raise RuntimeError(
+                f"injected decode failure (MPT_FAULT_DECODE_N) for "
+                f"{self._sample_name(i)}"
+            )
         if self.synthetic:
             # Key the pattern by label so classes are separable. The pattern
             # is a pure function of (label, size, dtype), so a bounded cache
@@ -238,6 +361,20 @@ class DataLoader:
             paths = [
                 os.path.join(self.manifest.img_dir, self.manifest.filenames[i]) for i in idx
             ]
+            # Items the C decoder refuses fall back per path; the fallback
+            # rides the same retry/quarantine discipline as the PIL pool
+            # (a quarantined item returns a zero image — its label is
+            # masked by _masked_labels, so the content never trains).
+            row_of = {}
+            for k, p in enumerate(paths):
+                row_of.setdefault(p, int(idx[k]))
+
+            def robust_fallback(p):
+                img = self._decode_with_retries(row_of[p])
+                if img is None:
+                    return np.zeros((*self.image_size, 3), np.float32)
+                return img
+
             return native.decode_batch(
                 paths,
                 self.image_size,
@@ -245,9 +382,24 @@ class DataLoader:
                 _STD,
                 threads=self.num_workers,
                 prescale_margin=self.decode_prescale,
-                fallback=lambda p: normalize_image(decode_image(p, self.image_size)),
+                fallback=robust_fallback,
             )
-        return np.stack(list(pool.map(self._load_one, idx)))
+        rows = list(pool.map(self._decode_with_retries, idx))
+        bad = [k for k, r in enumerate(rows) if r is None]
+        if bad:
+            # Substitute quarantined rows with real decoded content (the
+            # _cyclic_fill rationale: BN statistics span the whole batch,
+            # so substitutes should be real pixels, not zeros) — zeros only
+            # when the entire batch failed. Labels mask either way.
+            good = [k for k, r in enumerate(rows) if r is not None]
+            fill_dtype = np.uint8 if self.raw_uint8 else np.float32
+            for n, k in enumerate(bad):
+                rows[k] = (
+                    rows[good[n % len(good)]]
+                    if good
+                    else np.zeros((*self.image_size, 3), fill_dtype)
+                )
+        return np.stack(rows)
 
     def wait_cache_complete(self) -> bool:
         """Join any in-flight cache-filling thread (the backfill keeps
@@ -282,15 +434,29 @@ class DataLoader:
         ):
             self._cache_images = other._cache_images
             self._cache_complete = True
+            # Rows the source loader quarantined while filling stay masked
+            # here too — the cache holds their substitute pixels.
+            self._quarantined |= other._quarantined
             return True
         return False
 
-    def epoch(self, epoch: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
-        """Iterate one epoch of batches, prefetched in the background."""
+    def epoch(
+        self, epoch: int = 0, start_batch: int = 0
+    ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """Iterate one epoch of batches, prefetched in the background.
+
+        ``start_batch`` fast-forwards past the first k batches WITHOUT
+        decoding them: the ``(seed, epoch)`` visit order is deterministic,
+        so the consumed prefix is just an offset into ``epoch_order`` — the
+        exact-step mid-epoch resume dataflow (train/trainer.py). Applies
+        identically to the streaming, RAM-cache, and packed-mmap paths
+        (all three walk the same order)."""
         n = len(self.manifest)
         order = epoch_order(self.seed, epoch, n, self.shuffle)
         nb = len(self)
-        if nb == 0:
+        self._cur_epoch = epoch
+        start_batch = max(0, min(start_batch, nb))
+        if nb - start_batch == 0:
             return iter(())
 
         if self.host_cache:
@@ -303,12 +469,11 @@ class DataLoader:
             # Slicing RAM is not worth a producer thread; the (seed, epoch)
             # order is identical to the streaming walk, so trajectories match.
             cache = self._cache_images
-            labels = self.manifest.labels
 
             def cached_gen() -> Iterator[tuple[np.ndarray, np.ndarray]]:
-                for b in range(nb):
+                for b in range(start_batch, nb):
                     idx = order[b * self.batch_size : (b + 1) * self.batch_size]
-                    yield cache[idx], labels[idx]
+                    yield cache[idx], self._masked_labels(idx)
 
             return cached_gen()
 
@@ -354,13 +519,14 @@ class DataLoader:
             error = None
             try:
                 with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
-                    for b in range(nb):
+                    for b in range(start_batch, nb):
                         if stop.is_set():
                             break  # consumer gone; still backfill the cache below
                         idx = order[b * self.batch_size : (b + 1) * self.batch_size]
-                        put_or_abandon(
-                            (decode_one_batch(idx, pool), self.manifest.labels[idx])
-                        )
+                        stacked = decode_one_batch(idx, pool)
+                        # Labels AFTER decode: a row quarantined by this
+                        # very batch must already be masked.
+                        put_or_abandon((stacked, self._masked_labels(idx)))
                     if fill_cache and not self._cache_complete:
                         # Backfill whatever this epoch didn't decode. With a
                         # live consumer this is at most the drop_remainder

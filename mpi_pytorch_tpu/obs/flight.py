@@ -38,7 +38,7 @@ import threading
 import time
 from collections import deque
 
-_AUTO_DUMP_KINDS = ("fault", "alert")
+_AUTO_DUMP_KINDS = ("fault", "alert", "rollback")
 _SAFE = re.compile(r"[^a-zA-Z0-9_.-]+")
 
 # One profiler window process-wide: jax.profiler.start_trace raises if a

@@ -165,6 +165,8 @@ class StepHealth:
         data_wait_s: float | None = None,
         step_s: float | None = None,
         sync_ms: float | None = None,
+        skipped: int | None = None,
+        steps_skipped: int | None = None,
     ) -> None:
         if not self.enabled:
             return
@@ -187,6 +189,12 @@ class StepHealth:
             record["overlap_frac"] = self.overlap_frac
         if sync_ms is not None:
             record["sync_ms"] = round(sync_ms, 3)
+        # Schema-v6 bad-step-policy fields (--bad-step-policy skip only):
+        # the trainer passes them when the policy is armed.
+        if skipped is not None:
+            record["skipped"] = int(skipped)
+        if steps_skipped is not None:
+            record["steps_skipped"] = int(steps_skipped)
         self.metrics.write(record)
         if grad_norm is not None:
             self.nonfinite_grad_streak = (
@@ -206,11 +214,16 @@ class StepHealth:
                 self.registry.gauge("train/sync_ms").set(sync_ms)
         self._sentinel(epoch, step, loss, grad_norm)
 
-    def on_scan_epoch(self, epoch: int, m: Mapping[str, Any]) -> None:
+    def on_scan_epoch(
+        self, epoch: int, m: Mapping[str, Any], steps_skipped_base: int = 0
+    ) -> None:
         """Per-step records for the scan-epoch mode, post-hoc from the
         ``[n_steps]`` metric arrays (the scan ran entirely on device, so
         there is no per-step host timing to report — those fields are
-        null; loss/grad-norm/recompiles are real)."""
+        null; loss/grad-norm/recompiles are real). ``steps_skipped_base``
+        is the run's skip total BEFORE this epoch, so scan-mode records
+        carry the same run-cumulative ``steps_skipped`` the per-step path
+        reports (the schema's contract)."""
         if not self.enabled:
             return
         import numpy as np
@@ -219,20 +232,27 @@ class StepHealth:
         norm_v = (
             np.asarray(m["grad_norm"], np.float64) if "grad_norm" in m else None
         )
+        skip_v = (
+            np.asarray(m["skipped"], np.int64) if "skipped" in m else None
+        )
+        skipped_total = int(steps_skipped_base)
         for step in range(loss_v.shape[0]):
-            self.metrics.write(
-                {
-                    "kind": "step",
-                    "epoch": epoch,
-                    "step": step,
-                    "loss": float(loss_v[step]),
-                    "grad_norm": None if norm_v is None else float(norm_v[step]),
-                    "data_wait_ms": None,
-                    "step_ms": None,
-                    "recompiles": _compile_count - self._baseline,
-                    "hbm_bytes": device_bytes_in_use(),
-                }
-            )
+            record = {
+                "kind": "step",
+                "epoch": epoch,
+                "step": step,
+                "loss": float(loss_v[step]),
+                "grad_norm": None if norm_v is None else float(norm_v[step]),
+                "data_wait_ms": None,
+                "step_ms": None,
+                "recompiles": _compile_count - self._baseline,
+                "hbm_bytes": device_bytes_in_use(),
+            }
+            if skip_v is not None:
+                skipped_total += int(skip_v[step])
+                record["skipped"] = int(skip_v[step])
+                record["steps_skipped"] = skipped_total
+            self.metrics.write(record)
             self._sentinel(
                 epoch, step, float(loss_v[step]),
                 None if norm_v is None else float(norm_v[step]),

@@ -16,13 +16,14 @@ Record kinds (every record also carries ``ts``, the epoch-seconds stamp
 | epoch     | epoch, loss, time_s, images_per_sec                 | tflops, mfu_pct |
 | val       | epoch, accuracy, loss                               |          |
 | eval      | accuracy, loss, images, time_s                      |          |
-| step      | epoch, step, loss                                   | grad_norm, data_wait_ms, step_ms, recompiles, hbm_bytes, sync_ms, overlap_frac |
+| step      | epoch, step, loss                                   | grad_norm, data_wait_ms, step_ms, recompiles, hbm_bytes, sync_ms, overlap_frac, skipped, steps_skipped |
 | heartbeat | epoch, step, step_ms, median_step_ms, stragglers, threshold | images_per_sec |
-| anomaly   | reason, epoch                                       | step, loss, grad_norm |
+| anomaly   | reason, epoch                                       | step, loss, grad_norm, path, detail |
 | serve     | bucket, requests, queue_depth, fill_ratio, queue_wait_ms, device_ms | preprocess_ms, total_ms |
 | serve_bench | mode, buckets, max_wait_ms, requests, p50_ms, p95_ms, p99_ms, images_per_sec | model, offered_rps, rejected, mean_fill_ratio, compiles_after_warmup, chips |
-| resume    | epoch, to_devices                                   | from_devices, from_mesh, to_mesh, path, zero_shards_from, zero_shards_to, corrupt_skipped, strategy |
+| resume    | epoch, to_devices                                   | from_devices, from_mesh, to_mesh, path, zero_shards_from, zero_shards_to, corrupt_skipped, strategy, cursor_epoch, cursor_step |
 | fault     | reason                                              | epoch, step, detail, streak |
+| rollback  | epoch, reason                                       | step, restored_epoch, rollbacks, lr_scale, path, detail |
 | metrics   | counters, gauges, histograms                        | merged_hosts |
 | alert     | rule, severity                                      | metric, value, threshold, streak, action, detail, epoch, step |
 | route     | host, requests                                      | share, score, queue_depth, inflight, window_s |
@@ -78,7 +79,16 @@ from typing import Any, Mapping
 #      ``serve/fleet/controller.py``), plus the ``serve_bench`` row's
 #      optional ``fleet_hosts`` / ``per_host`` breakdown
 #      (``tools/bench_serve.py --fleet N``) — ISSUE 9 / ROADMAP item 1.
-SCHEMA_VERSION = 5
+#   6: the self-healing-training fields (ISSUE 10): the ``rollback`` kind
+#      (one in-process bad-step rollback — the trigger, the checkpoint
+#      restored, the rollback count and LR scale), the ``step`` record's
+#      optional ``skipped``/``steps_skipped`` fields (--bad-step-policy
+#      skip: this step's update was discarded / cumulative discards), the
+#      ``resume`` record's optional ``cursor_epoch``/``cursor_step``
+#      (the exact-step data cursor stamped in the checkpoint's topology
+#      sidecar), and the ``anomaly`` record's optional ``path``/``detail``
+#      (``reason=bad_sample`` quarantines name the undecodable file).
+SCHEMA_VERSION = 6
 
 _NUM = (int, float)
 _INT = (int,)
@@ -116,6 +126,9 @@ REQUIRED: dict[str, dict[str, tuple]] = {
     # lifecycle event (failover/retune/…) per occurrence.
     "route": {"host": (str,), "requests": _INT},
     "fleet": {"event": (str,)},
+    # v6: one in-process bad-step rollback (train/trainer.py,
+    # --bad-step-policy rollback): where it triggered and why.
+    "rollback": {"epoch": _INT, "reason": (str,)},
 }
 
 OPTIONAL: dict[str, dict[str, tuple]] = {
@@ -128,9 +141,19 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # v2 grad-sync fields (spmd --grad-sync-buckets; absent on v1
         # records and on lever-less runs):
         "sync_ms": _NUM, "overlap_frac": _NUM,
+        # v6 bad-step-policy fields (--bad-step-policy skip only): whether
+        # THIS step's update was discarded on a non-finite grad norm
+        # (0/1), and the run's cumulative discard count.
+        "skipped": _INT, "steps_skipped": _INT,
     },
     "heartbeat": {"images_per_sec": _NUM},
-    "anomaly": {"step": _INT, "loss": _NUM, "grad_norm": _NUM},
+    # v6: bad_sample quarantines (data/pipeline.py) carry the undecodable
+    # file's path and the decode error; cursor_mismatch fallbacks carry
+    # the mismatch reason in detail.
+    "anomaly": {
+        "step": _INT, "loss": _NUM, "grad_norm": _NUM,
+        "path": (str,), "detail": (str,),
+    },
     "serve": {
         "preprocess_ms": _NUM, "total_ms": _NUM,
         # v3: requests of this flush dropped at preprocess (typed
@@ -151,6 +174,10 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         "from_devices": _INT, "from_mesh": (str,), "to_mesh": (str,),
         "path": (str,), "zero_shards_from": _INT, "zero_shards_to": _INT,
         "corrupt_skipped": _INT, "strategy": (str,),
+        # v6: the exact-step data cursor stamped in the restored
+        # checkpoint's topology sidecar (train/trainer.py): the epoch and
+        # step-in-epoch the run continues at when the cursor validates.
+        "cursor_epoch": _INT, "cursor_step": _INT,
     },
     "fault": {"epoch": _INT, "step": _INT, "detail": (str,), "streak": _INT},
     # v5: fleet routing/lifecycle fields. ``route`` is a per-host window:
@@ -169,6 +196,13 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         "spare": (str,), "max_wait_ms_from": _NUM, "max_wait_ms_to": _NUM,
         "buckets_from": (str,), "buckets_to": (str,), "p99_ms": _NUM,
         "target_p99_ms": _NUM, "compiles_after_warmup": _INT,
+    },
+    # v6: which step the rollback triggered at, what it restored (the
+    # checkpoint's filed epoch + path), how many rollbacks this run has
+    # taken, and the cumulative --rollback-lr-backoff scale in effect.
+    "rollback": {
+        "step": _INT, "restored_epoch": _INT, "rollbacks": _INT,
+        "lr_scale": _NUM, "path": (str,), "detail": (str,),
     },
     "metrics": {
         # How many hosts' registries were merged into this snapshot

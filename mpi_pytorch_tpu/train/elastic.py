@@ -220,6 +220,13 @@ def _write_resume_record(
             f"{a}={s}" for a, s in manifest.get("mesh_shape", {}).items()
         )
         record["zero_shards_from"] = int(manifest.get("zero_shards", 0))
+        cursor = manifest.get("data_cursor")
+        if isinstance(cursor, dict):
+            # Schema v6: the exact-step data cursor the writer stamped —
+            # where the run continues if the trainer validates it
+            # (train/trainer.py; a mismatch falls back to epoch replay).
+            record["cursor_epoch"] = int(cursor.get("epoch", 0))
+            record["cursor_step"] = int(cursor.get("step_in_epoch", 0))
     if zero_shards_to:
         record["zero_shards_to"] = int(zero_shards_to)
     metrics.write(record)
@@ -301,6 +308,7 @@ class PreemptionWatchdog:
         health=None,
         metrics=None,
         logger=None,
+        injector=None,
     ):
         self.guard = guard
         self.preempt_file = preempt_file or os.environ.get("MPT_PREEMPT_FILE", "")
@@ -310,6 +318,7 @@ class PreemptionWatchdog:
         self.health = health
         self.metrics = metrics
         self.log = logger or run_logger()
+        self.injector = injector  # FaultInjector (MPT_FAULT_PREEMPT_AT_STEP)
         self.fired_reason: str | None = None
         self.fired_detail: str = ""
         self.fired_streak: int | None = None
@@ -319,6 +328,12 @@ class PreemptionWatchdog:
             return "sigterm", "preemption signal received", None
         if self.preempt_file and os.path.exists(self.preempt_file):
             return "preempt_file", f"sentinel {self.preempt_file} exists", None
+        if self.injector is not None and self.injector.preempt_fired:
+            return (
+                "injected_preempt",
+                f"MPT_FAULT_PREEMPT_AT_STEP={self.injector.preempt_at_step}",
+                None,
+            )
         if (
             self.straggler_beats > 0
             and self.heartbeat is not None
@@ -369,6 +384,82 @@ class PreemptionWatchdog:
 
 
 # ---------------------------------------------------------------------------
+# Bad-step rollback policy (ISSUE 10: --bad-step-policy rollback)
+# ---------------------------------------------------------------------------
+
+
+class RollbackLimitError(RuntimeError):
+    """More in-process rollbacks than ``--max-rollbacks`` allows — the run
+    is not converging past the bad region, so it aborts loudly with the
+    full ``kind="rollback"`` trail in the metrics stream."""
+
+
+class RollbackPolicy:
+    """Host-side governor deciding WHEN ``--bad-step-policy rollback``
+    restores the last good checkpoint (the trainer does the restoring,
+    in-process, via ``restore_latest`` — no process death).
+
+    Two triggers, both computed from globally-reduced per-step values
+    (the count-weighted global loss and the all-parameter grad norm), so
+    every host reaches the identical verdict at the identical step:
+
+    - ``nonfinite_steps`` CONSECUTIVE steps with a non-finite loss/grad
+      norm (a diverged update poisons the params, so every later step
+      stays non-finite — the streak is the detection delay, not a retry);
+    - ``loss_drift`` > 0: the loss exceeds ``loss_drift`` × the run's own
+      warmup baseline (the mean of the first ``drift_warmup`` finite
+      losses) — the same warmup-baseline semantics as the SLO monitor's
+      ``drift:`` rules (obs/monitor.py), catching a spike that never goes
+      NaN but has clearly left the run's normal.
+    """
+
+    def __init__(
+        self,
+        *,
+        nonfinite_steps: int = 2,
+        loss_drift: float = 0.0,
+        drift_warmup: int = 5,
+    ):
+        self.nonfinite_steps = max(1, int(nonfinite_steps))
+        self.loss_drift = float(loss_drift)
+        self.drift_warmup = max(1, int(drift_warmup))
+        self.nonfinite_streak = 0
+        self.baseline: list[float] = []
+
+    def observe(self, loss: float, grad_norm: float | None) -> str | None:
+        """Feed one step's host-read metrics; returns the trigger reason
+        (``"nonfinite_streak"`` / ``"loss_drift"``) or None."""
+        import math
+
+        finite = math.isfinite(loss) and (
+            grad_norm is None or math.isfinite(grad_norm)
+        )
+        if not finite:
+            self.nonfinite_streak += 1
+            if self.nonfinite_streak >= self.nonfinite_steps:
+                return "nonfinite_streak"
+            return None
+        self.nonfinite_streak = 0
+        if self.loss_drift > 0:
+            if len(self.baseline) < self.drift_warmup:
+                # The first observations ARE the baseline (SLO drift
+                # semantics): the policy only judges once the run has
+                # defined "normal".
+                self.baseline.append(loss)
+                return None
+            base = sum(self.baseline) / len(self.baseline)
+            if base > 0 and loss / base > self.loss_drift:
+                return "loss_drift"
+        return None
+
+    def after_rollback(self) -> None:
+        """Re-arm after a restore: the streak resets (the restored state
+        is good); the warmup baseline is KEPT — it describes the run's
+        normal, which a rollback does not change."""
+        self.nonfinite_streak = 0
+
+
+# ---------------------------------------------------------------------------
 # In-process fault injection (the trainer-side half of tools/inject_faults.py)
 # ---------------------------------------------------------------------------
 
@@ -387,6 +478,17 @@ class FaultInjector:
       if set) — a fake straggler the heartbeat/watchdog stack must flag,
       appearing mid-run when j > 0 so the SLO monitor's warmup-baseline
       drift rules (obs/monitor.py) see a clean "normal" first.
+    - ``MPT_FAULT_NONFINITE_AT_STEP=n``: poison the n-th train batch
+      (1-based, counted across epochs) with NaN pixels so that step's
+      loss/grad norm go non-finite — announced with a ``kind="fault"``
+      record BEFORE the step runs, so the ``--bad-step-policy``
+      skip/rollback paths are testable without a hand-tuned poisoned
+      learning rate. Streaming float-input path only (uint8 batches
+      cannot carry a NaN; the device-cache path feeds indices).
+    - ``MPT_FAULT_PREEMPT_AT_STEP=n``: behave as if a preemption notice
+      arrived right after the n-th completed train step — a deterministic
+      mid-epoch stop (the watchdog polls ``preempt_fired``) exercising
+      the dirty-save + exact-step-resume path without racing a signal.
     """
 
     def __init__(self, metrics=None):
@@ -394,34 +496,71 @@ class FaultInjector:
         self.delay_ms = env_int("MPT_FAULT_DELAY_STEP_MS", 0)
         self.delay_process = env_int("MPT_FAULT_DELAY_PROCESS", -1)
         self.delay_after = env_int("MPT_FAULT_DELAY_AFTER_STEP", 0)
+        self.nonfinite_at_step = env_int("MPT_FAULT_NONFINITE_AT_STEP", 0)
+        self.preempt_at_step = env_int("MPT_FAULT_PREEMPT_AT_STEP", 0)
+        self.preempt_fired = False
         self.metrics = metrics
         self._steps = 0
         self._delay_calls = 0
+        self._batches = 0
 
     @property
     def active(self) -> bool:
-        return bool(self.kill_at_step or self.delay_ms)
+        return bool(
+            self.kill_at_step or self.delay_ms or self.nonfinite_at_step
+            or self.preempt_at_step
+        )
 
-    def maybe_delay(self) -> None:
-        """The straggler fake — called inside the step's timed region so
-        heartbeats attribute the delay to this host's step time. With
-        ``MPT_FAULT_DELAY_AFTER_STEP`` the first j steps stay clean."""
-        if self.delay_ms <= 0:
-            return
-        self._delay_calls += 1
-        if self._delay_calls <= self.delay_after:
-            return
-        if self.delay_process < 0 or process_index() == self.delay_process:
-            time.sleep(self.delay_ms / 1e3)
+    def poison_batches(self, batches, epoch: int | None = None):
+        """Wrap a host-batch iterator, NaN-poisoning the images of the
+        armed batch (1-based, counted across epochs — the injector
+        instance carries the count between epochs). The fault record is
+        written BEFORE the poisoned batch is yielded, so the stream always
+        shows the injection ahead of its non-finite step records."""
+        import numpy as np
+
+        for images, labels in batches:
+            self._batches += 1
+            if self._batches == self.nonfinite_at_step:
+                if self.metrics is not None:
+                    self.metrics.write(
+                        {
+                            "kind": "fault",
+                            "reason": "injected_nonfinite",
+                            "detail": (
+                                f"MPT_FAULT_NONFINITE_AT_STEP="
+                                f"{self.nonfinite_at_step}"
+                            ),
+                            **({"epoch": epoch} if epoch is not None else {}),
+                        }
+                    )
+                run_logger().warning(
+                    "fault injection: NaN-poisoning train batch %d "
+                    "(MPT_FAULT_NONFINITE_AT_STEP)", self._batches,
+                )
+                images = np.full_like(images, np.nan)
+            yield images, labels
 
     def after_step(self, epoch: int, step: int) -> None:
-        """Count completed steps; on the armed one, announce (the metrics
-        stream is line-buffered, so the record lands) and SIGKILL — no
-        cleanup, no drain: this is the crash, not a shutdown."""
-        if not self.kill_at_step:
+        """Count completed steps; fire whichever step-count gate is armed.
+        The kill gate announces itself (the metrics stream is
+        line-buffered, so the record lands) and SIGKILLs — no cleanup, no
+        drain: this is the crash, not a shutdown. The preempt gate only
+        latches a flag the watchdog polls at the next step boundary."""
+        if not (self.kill_at_step or self.preempt_at_step):
             return
         self._steps += 1
-        if self._steps < self.kill_at_step:
+        if (
+            self.preempt_at_step
+            and not self.preempt_fired
+            and self._steps >= self.preempt_at_step
+        ):
+            self.preempt_fired = True
+            run_logger().warning(
+                "fault injection: simulated preemption notice after train "
+                "step %d (epoch %d step %d)", self._steps, epoch, step,
+            )
+        if not self.kill_at_step or self._steps < self.kill_at_step:
             return
         if self.metrics is not None:
             self.metrics.write(
@@ -438,3 +577,15 @@ class FaultInjector:
             self._steps, epoch, step,
         )
         os.kill(os.getpid(), signal.SIGKILL)
+
+    def maybe_delay(self) -> None:
+        """The straggler fake — called inside the step's timed region so
+        heartbeats attribute the delay to this host's step time. With
+        ``MPT_FAULT_DELAY_AFTER_STEP`` the first j steps stay clean."""
+        if self.delay_ms <= 0:
+            return
+        self._delay_calls += 1
+        if self._delay_calls <= self.delay_after:
+            return
+        if self.delay_process < 0 or process_index() == self.delay_process:
+            time.sleep(self.delay_ms / 1e3)

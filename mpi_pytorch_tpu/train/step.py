@@ -114,6 +114,34 @@ def _apply_updates(state: TrainState, grads, new_bs) -> TrainState:
     )
 
 
+def _step_ok(metrics) -> jax.Array:
+    """Whether this step's update is SAFE to commit: finite loss AND finite
+    global grad norm. Both are globally-reduced quantities (the loss is the
+    count-weighted global mean, the norm spans every parameter), so under
+    SPMD every shard/host computes the identical verdict — the property
+    that lets the skip policy branch without a collective."""
+    return jnp.isfinite(metrics["loss"]) & jnp.isfinite(metrics["grad_norm"])
+
+
+def _guard_bad_step(ok, new_tree, old_tree):
+    """``--bad-step-policy skip``, the device half: select the OLD value of
+    every state leaf when ``ok`` is False — the non-finite update is
+    discarded and the state (params, moments, BN stats, step counter, rng)
+    is bit-identical to pre-step, so training simply retries on the next
+    batch. A whole-tree select instead of ``lax.cond`` because it stays
+    trivially correct inside shard_map/scan and costs one fused elementwise
+    pass only on runs that opted into the policy."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new_tree, old_tree
+    )
+
+
+def _with_skip_flag(metrics, ok):
+    """Stamp the step's verdict into the metrics (``skipped`` ∈ {0, 1}) —
+    the host side of the policy (streak counting, telemetry) reads this."""
+    return dict(metrics, skipped=(~ok).astype(jnp.int32))
+
+
 # ---------------------------------------------------------------------------
 # auto mode: compiler-partitioned jit
 # ---------------------------------------------------------------------------
@@ -121,7 +149,8 @@ def _apply_updates(state: TrainState, grads, new_bs) -> TrainState:
 
 @functools.lru_cache(maxsize=None)
 def make_train_step(
-    compute_dtype=jnp.bfloat16, remat: bool = False, accum_steps: int = 1, mesh=None
+    compute_dtype=jnp.bfloat16, remat: bool = False, accum_steps: int = 1, mesh=None,
+    bad_step_skip: bool = False,
 ) -> Callable:
     """Auto-sharded train step: ``jit(step)`` with donated state. Sharding
     comes from the input arrays' placements (state placed by
@@ -162,7 +191,12 @@ def make_train_step(
                 state, images, labels, rng, remat=remat
             )
             new_state = _apply_updates(state, grads, new_bs)
-            return new_state, compute_metrics(loss, logits, labels, grads)
+            metrics = compute_metrics(loss, logits, labels, grads)
+            if bad_step_skip:
+                ok = _step_ok(metrics)
+                new_state = _guard_bad_step(ok, new_state, state)
+                metrics = _with_skip_flag(metrics, ok)
+            return new_state, metrics
 
         return train_step
 
@@ -256,13 +290,20 @@ def make_train_step(
             # same quantity the unsplit step reports.
             "grad_norm": optax.global_norm(grads).astype(jnp.float32),
         }
+        if bad_step_skip:
+            ok = _step_ok(metrics)
+            new_state = _guard_bad_step(ok, new_state, state)
+            metrics = _with_skip_flag(metrics, ok)
         return new_state, metrics
 
     return accum_train_step
 
 
 @functools.lru_cache(maxsize=None)
-def make_cached_train_step(mesh, compute_dtype=jnp.bfloat16, remat: bool = False) -> Callable:
+def make_cached_train_step(
+    mesh, compute_dtype=jnp.bfloat16, remat: bool = False,
+    bad_step_skip: bool = False,
+) -> Callable:
     """Train step over a DEVICE-RESIDENT dataset (cfg.device_cache): the
     normalized image set lives in HBM (replicated), and each step gathers its
     batch rows by index inside the compiled program — the host sends only
@@ -278,7 +319,8 @@ def make_cached_train_step(mesh, compute_dtype=jnp.bfloat16, remat: bool = False
     @functools.partial(jax.jit, donate_argnums=(0,))
     def cached_step(state: TrainState, dataset, labels_all, idx, valid):
         return _cached_batch_step(
-            mesh, compute_dtype, state, dataset, labels_all, idx, valid, remat=remat
+            mesh, compute_dtype, state, dataset, labels_all, idx, valid,
+            remat=remat, bad_step_skip=bad_step_skip,
         )
 
     return cached_step
@@ -332,7 +374,8 @@ def _gather_batch(mesh, compute_dtype, dataset, labels_all, idx, valid):
 
 
 def _cached_batch_step(
-    mesh, compute_dtype, state, dataset, labels_all, idx, valid, remat: bool = False
+    mesh, compute_dtype, state, dataset, labels_all, idx, valid,
+    remat: bool = False, bad_step_skip: bool = False,
 ):
     """One gather-from-HBM train step — THE shared body of the per-step
     cached mode and the scanned-epoch mode, so the two can never drift
@@ -348,11 +391,21 @@ def _cached_batch_step(
         "count": valid_count(labels),
         "grad_norm": optax.global_norm(grads).astype(jnp.float32),
     }
+    if bad_step_skip:
+        # Inside the scanned epoch this guards EVERY scan iteration: a
+        # non-finite step mid-scan is discarded on device and the scan
+        # simply carries the pre-step state forward.
+        ok = _step_ok(metrics)
+        new_state = _guard_bad_step(ok, new_state, state)
+        metrics = _with_skip_flag(metrics, ok)
     return new_state, metrics
 
 
 @functools.lru_cache(maxsize=None)
-def make_scanned_epoch(mesh, compute_dtype=jnp.bfloat16, remat: bool = False) -> Callable:
+def make_scanned_epoch(
+    mesh, compute_dtype=jnp.bfloat16, remat: bool = False,
+    bad_step_skip: bool = False,
+) -> Callable:
     """An ENTIRE epoch as one compiled program (cfg.scan_epoch): ``lax.scan``
     over the per-step index batches, gathering each batch from the
     HBM-resident dataset exactly like ``make_cached_train_step``.
@@ -374,7 +427,8 @@ def make_scanned_epoch(mesh, compute_dtype=jnp.bfloat16, remat: bool = False) ->
         def body(state, step_batch):
             idx, valid = step_batch
             return _cached_batch_step(
-                mesh, compute_dtype, state, dataset, labels_all, idx, valid, remat=remat
+                mesh, compute_dtype, state, dataset, labels_all, idx, valid,
+                remat=remat, bad_step_skip=bad_step_skip,
             )
 
         return lax.scan(body, state, (idx_all, valid_all))
@@ -654,6 +708,7 @@ def make_spmd_train_step(
     remat: bool = False,
     zero_opt_state: bool = False,
     grad_bucket_mb: float = 0.0,
+    bad_step_skip: bool = False,
 ) -> Callable:
     """Reference-parity DP step: shard_map over ``data``; local BN stats;
     explicit ``avg_grads`` pmean — the literal TPU translation of one
@@ -739,7 +794,15 @@ def make_spmd_train_step(
             new_state = _apply_updates(state, grads, new_bs)
             # grads were just averaged: every shard computes the identical
             # global-gradient norm, so no further collective is needed.
-            return new_state, _metrics(loss, logits, labels, optax.global_norm(grads))
+            metrics = _metrics(loss, logits, labels, optax.global_norm(grads))
+            if bad_step_skip:
+                # The verdict reads the ALREADY-psum'd loss and the
+                # averaged-grads norm, so every shard takes the same branch
+                # with no extra collective (the skip-policy contract).
+                ok = _step_ok(metrics)
+                new_state = _guard_bad_step(ok, new_state, state)
+                metrics = _with_skip_flag(metrics, ok)
+            return new_state, metrics
 
         sharded = shard_map(
             per_shard,
@@ -809,7 +872,16 @@ def make_spmd_train_step(
             leaf[None] if getattr(leaf, "ndim", 0) else leaf
             for leaf in jax.tree_util.tree_leaves(new_opt)
         )
-        return new_state, new_flat, _metrics(loss, logits, labels, grad_norm)
+        metrics = _metrics(loss, logits, labels, grad_norm)
+        if bad_step_skip:
+            # Same contract as the non-ZeRO shard: the psum'd loss/norm
+            # give every shard the identical verdict, and the guard covers
+            # BOTH the replicated state and this shard's opt-state slices.
+            ok = _step_ok(metrics)
+            new_state = _guard_bad_step(ok, new_state, state)
+            new_flat = _guard_bad_step(ok, new_flat, tuple(flat_opt))
+            metrics = _with_skip_flag(metrics, ok)
+        return new_state, new_flat, metrics
 
     def step(state: TrainState, batch):
         flat_opt, opt_treedef = jax.tree_util.tree_flatten(state.opt_state)

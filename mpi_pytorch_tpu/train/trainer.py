@@ -18,6 +18,7 @@ stage:
 
 from __future__ import annotations
 
+import os
 import signal
 import threading
 import time
@@ -30,7 +31,7 @@ import numpy as np
 
 from mpi_pytorch_tpu import checkpoint as ckpt
 from mpi_pytorch_tpu.config import Config
-from mpi_pytorch_tpu.data import DataLoader, load_manifests
+from mpi_pytorch_tpu.data import DataLoader, load_manifests, manifest_fingerprint
 from mpi_pytorch_tpu.models import create_model_bundle
 from mpi_pytorch_tpu.obs import (
     FlightRecorder,
@@ -200,6 +201,8 @@ def build_training(cfg: Config, mesh=None):
         decode_prescale=cfg.decode_prescale,
         host_cache=cfg.host_cache,
         packed_dir=cfg.packed_dir,
+        max_bad_samples=cfg.max_bad_samples,
+        quarantine_file=cfg.quarantine_file,
     )
 
     bundle, variables = create_model_bundle(
@@ -315,21 +318,106 @@ def global_step_count(total_examples: int, host_batch: int, drop_remainder: bool
     return -(-largest // host_batch)
 
 
-def synchronized_batches(loader: DataLoader, epoch: int, n_steps: int):
-    """Yield exactly ``n_steps`` (images, labels) host-batches from ``loader``,
-    padding with all-padding batches (every label -1) once the local shard is
-    exhausted and truncating any surplus — so every host issues the same
-    number of collective steps (see ``global_step_count``).
+def data_cursor(
+    cfg: Config, fingerprint: str, n_steps: int, next_epoch: int, step_in_epoch: int
+) -> dict:
+    """The exact-step resume cursor stamped into every checkpoint's topology
+    sidecar (ISSUE 10): WHERE the run continues — ``(epoch, step_in_epoch)``
+    in the deterministic global walk — plus everything that must still hold
+    for that offset to mean the same samples: the shuffle discipline
+    (seed/shuffle), the global batch and per-epoch step count (steps ×
+    global batch is the topology-invariant sample count), the host count
+    (per-host shards derive from it on the streaming path), and the global
+    train manifest's fingerprint. ``validate_cursor`` checks each field and
+    falls back to epoch replay on any mismatch — the cursor can be ignored,
+    never silently misaligned."""
+    return {
+        "epoch": int(next_epoch),
+        "step_in_epoch": int(step_in_epoch),
+        "seed": int(cfg.seed),
+        "shuffle": bool(cfg.shuffle),
+        "global_batch": int(cfg.batch_size),
+        "drop_remainder": bool(cfg.drop_remainder),
+        "processes": int(jax.process_count()),
+        "steps_per_epoch": int(n_steps),
+        "manifest_fingerprint": fingerprint,
+    }
+
+
+def validate_cursor(
+    cursor, *, cfg: Config, fingerprint: str, n_steps: int, start_epoch: int
+) -> tuple[int, str | None]:
+    """``(start_step, None)`` when ``cursor`` still describes this run's
+    data walk, else ``(0, why)`` — the caller logs the typed warning and
+    replays the epoch (today's behavior), never silently misaligning."""
+    if not isinstance(cursor, dict):
+        return 0, "no data cursor in the checkpoint's topology manifest"
+    expected = {
+        "epoch": start_epoch,
+        "seed": int(cfg.seed),
+        "shuffle": bool(cfg.shuffle),
+        "global_batch": int(cfg.batch_size),
+        "drop_remainder": bool(cfg.drop_remainder),
+        "processes": int(jax.process_count()),
+        "steps_per_epoch": int(n_steps),
+        "manifest_fingerprint": fingerprint,
+    }
+    for key, want in expected.items():
+        got = cursor.get(key)
+        if got != want:
+            return 0, f"cursor {key}={got!r} != current {want!r}"
+    step = int(cursor.get("step_in_epoch", 0))
+    if not 0 <= step < max(n_steps, 1):
+        return 0, f"cursor step_in_epoch={step} outside 0..{n_steps - 1}"
+    if step and cfg.scan_epoch:
+        # A partial scanned epoch would need a differently-shaped scan
+        # (one extra compile for a state the scan path can never itself
+        # produce — scans never stop mid-epoch). Replay instead.
+        return 0, "mid-epoch cursor with scan_epoch=True (scan is all-or-nothing)"
+    return step, None
+
+
+def _abort_skip_limit(metrics, epoch: int, streak: int, limit: int) -> None:
+    """``--bad-step-policy skip`` ran out of patience: N consecutive
+    non-finite updates were discarded, so the divergence is systematic, not
+    transient — record it and abort (the same typed error the sentinel
+    raises, so callers handle both abort paths uniformly)."""
+    from mpi_pytorch_tpu.obs.health import NonFiniteLossError
+
+    metrics.write(
+        {
+            "kind": "anomaly", "reason": "skip_limit", "epoch": epoch,
+            "detail": f"{streak} consecutive skipped steps hit "
+                      f"max_skipped_steps={limit}",
+        }
+    )
+    raise NonFiniteLossError(
+        f"{streak} consecutive non-finite steps were skipped (epoch {epoch}) "
+        f"— hit --max-skipped-steps={limit}; the divergence is systematic, "
+        "aborting instead of discarding updates forever"
+    )
+
+
+def synchronized_batches(
+    loader: DataLoader, epoch: int, n_steps: int, start_step: int = 0
+):
+    """Yield exactly ``n_steps - start_step`` (images, labels) host-batches
+    from ``loader`` — steps ``start_step..n_steps-1`` of the epoch — padding
+    with all-padding batches (every label -1) once the local shard is
+    exhausted and truncating any surplus, so every host issues the same
+    number of collective steps (see ``global_step_count``). ``start_step``
+    is the exact-step resume fast-forward: the loader skips the consumed
+    prefix of its deterministic ``(seed, epoch)`` order without decoding it.
 
     Filler batches repeat the images of the last REAL batch (labels all -1):
     the loss masks them either way, but BatchNorm batch statistics span
     whatever images the step sees, so filler must be real image content, not
     zeros — the same reasoning as ``pad_batch``."""
-    it = iter(loader.epoch(epoch))
+    it = iter(loader.epoch(epoch, start_batch=start_step))
     all_pad = np.full((loader.batch_size,), -1, np.int32)
     last_images = None
     try:
-        for _ in range(n_steps):
+        for _ in range(start_step, n_steps):
             batch = next(it, None)
             if batch is not None:
                 last_images = batch[0]
@@ -347,18 +435,20 @@ def synchronized_batches(loader: DataLoader, epoch: int, n_steps: int):
 
 def cached_index_batches(
     cfg: Config, n: int, host_batch: int, epoch: int, n_steps: int,
-    shuffle: bool | None = None,
+    shuffle: bool | None = None, start_step: int = 0,
 ):
     """Per-epoch (idx [B] int32, valid [B] bool) batches for the
     device-cache path. The permutation uses the same ``(seed, epoch)`` rng
     discipline as ``DataLoader.epoch``, so a cached run and a streaming run
     walk the data in the same order; tail indices repeat real rows
     (the ``_cyclic_fill`` policy) with ``valid=False``. ``shuffle=False``
-    gives the ordered walk the cached eval path uses."""
+    gives the ordered walk the cached eval path uses; ``start_step`` is the
+    exact-step resume fast-forward (the consumed prefix of the permutation
+    is simply not yielded)."""
     from mpi_pytorch_tpu.data.pipeline import epoch_order
 
     order = epoch_order(cfg.seed, epoch, n, cfg.shuffle if shuffle is None else shuffle)
-    for step_i in range(n_steps):
+    for step_i in range(start_step, n_steps):
         idx = order[step_i * host_batch : (step_i + 1) * host_batch]
         valid = np.ones(len(idx), bool)
         pad = host_batch - len(idx)
@@ -431,6 +521,7 @@ def build_device_cache(cfg: Config, manifest, loader: DataLoader, mesh):
     # transiently hold the slice twice, at exactly the scale (GBs) this
     # feature targets. Zeros beyond real_hi are the never-indexed padding.
     local = np.zeros((hi - lo, *loader.image_size, 3), loader.image_dtype)
+    labels_np = manifest.labels.astype(np.int32)
     if real_hi > lo:
         ordered = DataLoader(
             manifest.select(np.arange(lo, real_hi)),
@@ -445,15 +536,37 @@ def build_device_cache(cfg: Config, manifest, loader: DataLoader, mesh):
             native_decode=loader.native_decode,
             decode_prescale=loader.decode_prescale,
             packed_dir=loader.packed_dir,
+            max_bad_samples=loader.max_bad_samples,
+            quarantine_file=loader.quarantine_file,
         )
+        ordered.metrics = loader.metrics
         row = 0
         for batch_images, _ in ordered.epoch(0):
             local[row : row + batch_images.shape[0]] = batch_images
             row += batch_images.shape[0]
         assert row == real_hi - lo, (row, lo, real_hi)
+        if ordered._quarantined:
+            if jax.process_count() > 1:
+                # Each host decodes only its own row range, so a per-host
+                # label mask would make the REPLICATED labels array differ
+                # across hosts — silent divergence inside every collective
+                # step. Abort loudly instead (the quarantine trail names
+                # the files); multi-host runs must fix the data or take
+                # the streaming/host-cache path, whose masking is local.
+                from mpi_pytorch_tpu.data.pipeline import BadSampleLimitError
+
+                raise BadSampleLimitError(
+                    f"{len(ordered._quarantined)} sample(s) quarantined "
+                    "while building the multi-host device cache — per-host "
+                    "label masking cannot stay consistent across hosts; "
+                    "repair/remove the corrupt files (see the quarantine "
+                    "log) or drop --device-cache"
+                )
+            # Quarantined rows hold substitute pixels — mask their labels.
+            labels_np = labels_np.copy()
+            labels_np[lo + np.fromiter(ordered._quarantined, int)] = -1
 
     rep = NamedSharding(mesh, P())
-    labels_np = manifest.labels.astype(np.int32)
     if jax.process_count() == 1:
         dataset = jax.device_put(local, sharding)
         labels = jax.device_put(labels_np, rep)
@@ -485,6 +598,8 @@ def make_eval_loader(cfg: Config, manifest, host_cache: bool = False) -> DataLoa
         decode_prescale=cfg.decode_prescale,
         host_cache=host_cache,
         packed_dir=cfg.packed_dir,
+        max_bad_samples=cfg.max_bad_samples,
+        quarantine_file=cfg.quarantine_file,
     )
 
 
@@ -573,8 +688,13 @@ def train(cfg: Config) -> TrainSummary:
             registry, parse_rules(cfg.slo_rules), metrics=metrics,
             preempt_path=cfg.preempt_file, tracer=tracer, logger=logger,
         )
+    # With a bad-step POLICY armed (skip/rollback) the sentinel's hard
+    # abort is replaced by the policy: the non-finite step is the event the
+    # policy handles, not a reason to crash (the policy's own bounds —
+    # max_skipped_steps / max_rollbacks — are the new aborts).
     health = StepHealth(
-        metrics, step_metrics=cfg.step_metrics, nan_sentinel=cfg.nan_sentinel,
+        metrics, step_metrics=cfg.step_metrics,
+        nan_sentinel=cfg.nan_sentinel and cfg.bad_step_policy == "abort",
         tracer=tracer, registry=registry,
     )
     heartbeat = Heartbeat(
@@ -608,8 +728,13 @@ def train(cfg: Config) -> TrainSummary:
     # on the step's metrics before timestamping (documented cost of
     # step_metrics/heartbeat; registry step-time gauges/histograms must be
     # completion times too, so a live registry also syncs; the default
-    # loop stays fully async).
-    telemetry_sync = health.enabled or heartbeat.enabled or registry is not None
+    # loop stays fully async). A bad-step policy also syncs: the host must
+    # observe every step's loss/grad-norm verdict to count skips or
+    # trigger a rollback.
+    telemetry_sync = (
+        health.enabled or heartbeat.enabled or registry is not None
+        or cfg.bad_step_policy != "abort"
+    )
     try:
         return _train_impl(
             cfg, logger, metrics, tracer, health, heartbeat, telemetry_sync,
@@ -661,8 +786,16 @@ def _train_impl(
         cfg.model_name, cfg.num_classes, cfg.batch_size, len(loader.manifest),
     )
 
+    # The exact-step resume cursor is defined over the GLOBAL train
+    # manifest (global-sample space — topology-invariant); the loader gets
+    # the metrics writer so decode quarantines land in the stream.
+    fingerprint = manifest_fingerprint(train_manifest)
+    loader.metrics = metrics
+
     start_epoch = 0
     resumed = False
+    resume_manifest = None
+    resume_was_dirty = False
     zero_shards_to = (
         mesh.shape[cfg.mesh.data_axis] if (cfg.spmd_mode and cfg.zero_opt_state) else 0
     )
@@ -679,6 +812,8 @@ def _train_impl(
         if res is not None:
             state, start_epoch, last_loss, _resume = res
             resumed = True
+            resume_manifest = _resume.get("manifest")
+            resume_was_dirty = os.path.exists(_resume["path"] + ".dirty")
             start_epoch += 1
             logger.info(
                 "resumed from %s (epoch %d, loss %.4f)",
@@ -764,12 +899,6 @@ def _train_impl(
     # whole run, and the executable's cost analysis gives exact FLOPs/step for
     # MFU logging (SURVEY §5 — the reference has only wall-clock timers).
     n_steps = global_step_count(len(train_manifest), host_batch, cfg.drop_remainder)
-    # begin/end token rather than a with-block: the compile region below
-    # branches four ways and re-indenting it buys nothing. Opened AFTER the
-    # cache build in the device-cache branch — a span that swallowed the
-    # dataset decode would misattribute ingest time to XLA, the exact
-    # confusion the tracer exists to prevent.
-    _compile_span = None
     dataset = labels_all = None
     val_loader = None  # built lazily, then reused so its host cache persists
     # Cached-mode index batches are GLOBAL (every host draws the identical
@@ -777,6 +906,9 @@ def _train_impl(
     # step on all hosts, stepping over global rows.
     cache_batch = cfg.batch_size
     n_cache = len(train_manifest)
+    # --bad-step-policy skip: the jitted step itself discards a non-finite
+    # update (train/step.py _guard_bad_step); the host side only counts.
+    bad_step_skip = cfg.bad_step_policy == "skip"
     if cfg.device_cache:
         # Step count over the GLOBAL walk (the streaming count derives from
         # per-host array_split shards and can differ by rounding off it).
@@ -791,84 +923,141 @@ def _train_impl(
             "(%.1f MB/device %s)",
             n_cache, n_data, dataset.nbytes / n_data / 1e6, dataset.dtype,
         )
-        _compile_span = tracer.begin("compile")
-        # The per-step program is the FLOPs reference either way; the scan
-        # mode reuses the Lowered (cost analysis needs no backend compile)
-        # because XLA counts a scan body once regardless of trip count.
-        lowered_step = jax.jit(
-            make_cached_train_step(mesh, _dtype(cfg.compute_dtype), remat=(cfg.remat == "full")),
-            donate_argnums=(0,), out_shardings=(_state_shardings(state), None),
-        ).lower(
-            state, dataset, labels_all,
-            np.zeros((cache_batch,), np.int32), np.ones((cache_batch,), bool),
+
+    def build_compiled(st: TrainState):
+        """AOT-compile the train step (scan-epoch mode: the whole-epoch
+        scan) against ``st``'s placed layout → ``(compiled_step,
+        flops_per_step)``. Factored out of the straight-line setup so a
+        bad-step ROLLBACK that rebuilt the optimizer (--rollback-lr-backoff
+        embeds a new LR in the step program) can recompile against the
+        restored state; the default run calls it exactly once. The compile
+        span opens here, AFTER the device-cache build — a span that
+        swallowed the dataset decode would misattribute ingest time to XLA,
+        the exact confusion the tracer exists to prevent."""
+        span = tracer.begin("compile")
+        try:
+            if cfg.device_cache:
+                # The per-step program is the FLOPs reference either way;
+                # the scan mode reuses the Lowered (cost analysis needs no
+                # backend compile) because XLA counts a scan body once
+                # regardless of trip count.
+                lowered_step = jax.jit(
+                    make_cached_train_step(
+                        mesh, _dtype(cfg.compute_dtype), remat=(cfg.remat == "full"),
+                        bad_step_skip=bad_step_skip,
+                    ),
+                    donate_argnums=(0,), out_shardings=(_state_shardings(st), None),
+                ).lower(
+                    st, dataset, labels_all,
+                    np.zeros((cache_batch,), np.int32), np.ones((cache_batch,), bool),
+                )
+                if cfg.scan_epoch:
+                    epoch_fn = make_scanned_epoch(
+                        mesh, _dtype(cfg.compute_dtype), remat=(cfg.remat == "full"),
+                        bad_step_skip=bad_step_skip,
+                    )
+                    compiled = jax.jit(
+                        epoch_fn, donate_argnums=(0,),
+                        out_shardings=(_state_shardings(st), None),
+                    ).lower(
+                        st, dataset, labels_all,
+                        np.zeros((n_steps, cache_batch), np.int32),
+                        np.ones((n_steps, cache_batch), bool),
+                    ).compile(compiler_options=cfg.parsed_compiler_options())
+                    # Per-step FLOPs for the scan mode, without compiling a
+                    # throwaway per-step executable. Two wrinkles: (a)
+                    # Lowered.cost_analysis() runs BEFORE SPMD partitioning,
+                    # so the per-step lowering gives WHOLE-program FLOPs
+                    # (÷ device_count approximates per-device); (b) whether
+                    # the compiled scan's cost analysis counts the body once
+                    # or trip-count times is an XLA implementation detail
+                    # (observed: once). Use the compiled scan's number,
+                    # disambiguated against the lowered estimate.
+                    est = hw.step_flops(lowered_step) / max(1, jax.device_count())
+                    cand = hw.step_flops(compiled)
+                    if cand > 0 and est > 0 and n_steps > 1:
+                        flops = (
+                            cand if abs(cand - est) <= abs(cand / n_steps - est)
+                            else cand / n_steps
+                        )
+                    else:
+                        flops = cand if cand > 0 else est
+                    return compiled, flops
+                compiled = lowered_step.compile(
+                    compiler_options=cfg.parsed_compiler_options()
+                )
+                return compiled, hw.step_flops(compiled)
+            step_fn = (
+                make_spmd_train_step(
+                    mesh, _dtype(cfg.compute_dtype), remat=(cfg.remat == "full"),
+                    zero_opt_state=cfg.zero_opt_state,
+                    grad_bucket_mb=cfg.grad_sync_buckets,
+                    bad_step_skip=bad_step_skip,
+                )
+                if cfg.spmd_mode
+                else make_train_step(
+                    _dtype(cfg.compute_dtype), remat=(cfg.remat == "full"),
+                    accum_steps=cfg.accum_steps, mesh=mesh,
+                    bad_step_skip=bad_step_skip,
+                )
+            )
+            # The sample must match the loader's batch dtype exactly — the
+            # AOT executable is specialized on input avals.
+            sample = shard_batch(
+                (np.zeros((host_batch, *cfg.image_size, 3), loader.image_dtype),
+                 np.zeros((host_batch,), np.int32)),
+                mesh,
+            )
+            if cfg.spmd_mode:
+                compiled = step_fn.lower(st, sample).compile(
+                    compiler_options=cfg.parsed_compiler_options()
+                )
+            else:
+                compiled = jax.jit(
+                    step_fn, donate_argnums=(0,),
+                    out_shardings=(_state_shardings(st), None),
+                ).lower(st, sample).compile(
+                    compiler_options=cfg.parsed_compiler_options()
+                )
+            return compiled, hw.step_flops(compiled)
+        finally:
+            tracer.end(span)
+
+    compiled_step, flops_per_step = build_compiled(state)
+
+    # Exact-step resume (ISSUE 10): validate the restored checkpoint's data
+    # cursor against THIS run's walk. A match fast-forwards the first
+    # post-resume epoch past the consumed batches (zero replayed optimizer
+    # steps); any mismatch writes a typed kind="anomaly" record and falls
+    # back to today's epoch replay — the cursor can be ignored, never
+    # silently misaligned.
+    start_step = 0
+    if resumed:
+        cursor = (resume_manifest or {}).get("data_cursor")
+        start_step, cursor_why = validate_cursor(
+            cursor, cfg=cfg, fingerprint=fingerprint, n_steps=n_steps,
+            start_epoch=start_epoch,
         )
-        if cfg.scan_epoch:
-            epoch_fn = make_scanned_epoch(mesh, _dtype(cfg.compute_dtype), remat=(cfg.remat == "full"))
-            compiled_step = jax.jit(
-                epoch_fn, donate_argnums=(0,),
-                out_shardings=(_state_shardings(state), None),
-            ).lower(
-                state, dataset, labels_all,
-                np.zeros((n_steps, cache_batch), np.int32),
-                np.ones((n_steps, cache_batch), bool),
-            ).compile(compiler_options=cfg.parsed_compiler_options())
-        else:
-            compiled_step = lowered_step.compile(
-                compiler_options=cfg.parsed_compiler_options()
+        if cursor_why is not None and (cursor is not None or resume_was_dirty):
+            metrics.write(
+                {
+                    "kind": "anomaly", "reason": "cursor_mismatch",
+                    "epoch": start_epoch, "detail": cursor_why,
+                }
             )
-    else:
-        _compile_span = tracer.begin("compile")
-        step_fn = (
-            make_spmd_train_step(
-                mesh, _dtype(cfg.compute_dtype), remat=(cfg.remat == "full"),
-                zero_opt_state=cfg.zero_opt_state,
-                grad_bucket_mb=cfg.grad_sync_buckets,
+            logger.warning(
+                "exact-step resume unavailable (%s) — replaying epoch %d "
+                "from step 0%s", cursor_why, start_epoch,
+                " (DIRTY checkpoint: the replay double-applies the partial "
+                "epoch's updates)" if resume_was_dirty else "",
             )
-            if cfg.spmd_mode
-            else make_train_step(
-                _dtype(cfg.compute_dtype), remat=(cfg.remat == "full"),
-                accum_steps=cfg.accum_steps, mesh=mesh,
+        elif start_step:
+            logger.info(
+                "exact-step resume: continuing epoch %d at step %d "
+                "(fast-forwarding %d consumed batch(es) without decoding)",
+                start_epoch, start_step, start_step,
             )
-        )
-        # The sample must match the loader's batch dtype exactly — the AOT
-        # executable is specialized on input avals.
-        sample = shard_batch(
-            (np.zeros((host_batch, *cfg.image_size, 3), loader.image_dtype),
-             np.zeros((host_batch,), np.int32)),
-            mesh,
-        )
-        if cfg.spmd_mode:
-            compiled_step = step_fn.lower(state, sample).compile(
-                compiler_options=cfg.parsed_compiler_options()
-            )
-        else:
-            compiled_step = jax.jit(
-                step_fn, donate_argnums=(0,),
-                out_shardings=(_state_shardings(state), None),
-            ).lower(state, sample).compile(
-                compiler_options=cfg.parsed_compiler_options()
-            )
-    if cfg.device_cache and cfg.scan_epoch:
-        # Per-step FLOPs for the scan mode, without compiling a throwaway
-        # per-step executable. Two wrinkles: (a) Lowered.cost_analysis() runs
-        # BEFORE SPMD partitioning, so the per-step lowering gives WHOLE-
-        # program FLOPs (÷ device_count approximates per-device); (b) whether
-        # the compiled scan's cost analysis counts the body once or
-        # trip-count times is an XLA implementation detail (observed: once).
-        # Use the compiled scan's number, disambiguated against the lowered
-        # estimate — exact on the observed behavior, correct within
-        # collective-overhead noise if XLA ever changes it.
-        est = hw.step_flops(lowered_step) / max(1, jax.device_count())
-        cand = hw.step_flops(compiled_step)
-        if cand > 0 and est > 0 and n_steps > 1:
-            flops_per_step = (
-                cand if abs(cand - est) <= abs(cand / n_steps - est) else cand / n_steps
-            )
-        else:
-            flops_per_step = cand if cand > 0 else est
-    else:
-        flops_per_step = hw.step_flops(compiled_step)
-    tracer.end(_compile_span)
+
     # Grad-sync bucket-plan telemetry (spmd + --grad-sync-buckets): one
     # instant span per bucket (bytes/leaves, in reverse-topo issue order)
     # and the static overlap_frac estimate stamped onto every step health
@@ -940,28 +1129,198 @@ def _train_impl(
     # (the run is already finishing), and only a SECOND signal falls through
     # to the previous handler — the escape hatch if the drain itself wedges.
     guard = PreemptionGuard()
-    # The watchdog unifies every stop signal behind one poll: the guard's
-    # SIGTERM flag, the MPT_PREEMPT_FILE sentinel, and repeated health
-    # signals (straggler beats / non-finite grad norms) — each firing
-    # writes a kind="fault" record and stops the run at the same safe
-    # boundary a SIGTERM would (train/elastic.py).
-    watchdog = elastic.PreemptionWatchdog(
-        guard,
-        preempt_file=cfg.preempt_file,
-        straggler_beats=cfg.preempt_straggler_beats,
-        nonfinite_steps=cfg.preempt_nonfinite_steps,
-        heartbeat=heartbeat, health=health, metrics=metrics, logger=logger,
-    )
     # Deterministic chaos, armed only via the MPT_FAULT_* env gates
     # (utils/env.py FAULT_GATES; driven by tools/inject_faults.py).
     faults = elastic.FaultInjector(metrics=metrics)
     if faults.active:
         logger.warning(
             "fault injection armed: kill_at_step=%d delay_step_ms=%d "
-            "(MPT_FAULT_* gates)", faults.kill_at_step, faults.delay_ms,
+            "nonfinite_at_step=%d preempt_at_step=%d (MPT_FAULT_* gates)",
+            faults.kill_at_step, faults.delay_ms, faults.nonfinite_at_step,
+            faults.preempt_at_step,
         )
+    if faults.nonfinite_at_step and (
+        cfg.device_cache or loader.image_dtype == np.dtype(np.uint8)
+    ):
+        logger.warning(
+            "MPT_FAULT_NONFINITE_AT_STEP has no effect on this run: the "
+            "gate NaN-poisons streaming float batches, and this run feeds "
+            "%s", "device-cache indices" if cfg.device_cache else "uint8 pixels",
+        )
+    # The watchdog unifies every stop signal behind one poll: the guard's
+    # SIGTERM flag, the MPT_PREEMPT_FILE sentinel, repeated health signals
+    # (straggler beats / non-finite grad norms), and the injected-preempt
+    # gate — each firing writes a kind="fault" record and stops the run at
+    # the same safe boundary a SIGTERM would (train/elastic.py).
+    watchdog = elastic.PreemptionWatchdog(
+        guard,
+        preempt_file=cfg.preempt_file,
+        straggler_beats=cfg.preempt_straggler_beats,
+        nonfinite_steps=cfg.preempt_nonfinite_steps,
+        heartbeat=heartbeat, health=health, metrics=metrics, logger=logger,
+        injector=faults,
+    )
+    # --- bad-step-policy state (ISSUE 10) ---------------------------------
+    # skip: the step discards on device; the host counts the consecutive
+    # streak (every host reads the same psum'd verdict, so the abort below
+    # is agreed without a collective). rollback: the governor watches the
+    # same host-read values and the trainer restores in-process.
+    if cfg.bad_step_policy != "abort":
+        logger.info(
+            "bad-step policy '%s': the NaN sentinel's hard abort is "
+            "replaced by the policy (per-step host sync enabled to observe "
+            "loss/grad norm)", cfg.bad_step_policy,
+        )
+    skip_streak = 0
+    steps_skipped_total = 0
+    if registry is not None and bad_step_skip:
+        registry.counter("train/steps_skipped")  # registered up front
+    rollback_policy = (
+        elastic.RollbackPolicy(
+            nonfinite_steps=cfg.rollback_nonfinite_steps,
+            loss_drift=cfg.rollback_loss_drift,
+            drift_warmup=cfg.rollback_drift_warmup,
+        )
+        if cfg.bad_step_policy == "rollback"
+        else None
+    )
+    rollbacks_done = 0
+    lr_scale = 1.0
     last_saved_epoch = -1
     stopped_mid_epoch = False
+    # Recomputed the way build_training computes schedule lengths, for the
+    # rollback LR-backoff optimizer rebuild.
+    total_steps = (
+        global_step_count(len(train_manifest), host_batch, cfg.drop_remainder)
+        * cfg.num_epochs
+    )
+
+    def _rollback_restore(at_epoch: int, at_step: int, reason: str):
+        """--bad-step-policy rollback, the restore half: drain the async
+        writer, restore the newest loadable checkpoint IN-PROCESS (the
+        same elastic.restore_latest + placement dataflow as a process
+        restart — minus the process death), optionally back off the LR,
+        and return ``(next_epoch, next_start_step)`` from the restored
+        cursor. Deterministic across hosts: the trigger reads globally-
+        reduced values, so every process calls this at the same step."""
+        nonlocal rollbacks_done, lr_scale, state, compiled_step, flops_per_step
+        nonlocal last_saved_epoch, last_completed_epoch
+        checkpointer.wait()
+        if rollbacks_done >= cfg.max_rollbacks:
+            metrics.write(
+                {
+                    "kind": "anomaly", "reason": "rollback_limit",
+                    "epoch": at_epoch, "step": at_step,
+                    "detail": f"{rollbacks_done} rollbacks hit "
+                              f"max_rollbacks={cfg.max_rollbacks}",
+                }
+            )
+            raise elastic.RollbackLimitError(
+                f"bad-step rollback requested ({reason} at epoch {at_epoch} "
+                f"step {at_step}) but {rollbacks_done} rollback(s) already "
+                f"hit --max-rollbacks={cfg.max_rollbacks}; aborting — see "
+                "the kind='rollback' trail in the metrics stream"
+            )
+        rollbacks_done += 1
+        # Restore template with the UNSHARDED optimizer layout: a ZeRO
+        # run's live [P, chunk] opt-state does not match the on-disk
+        # gathered payload the checkpoint loader deserializes against.
+        tmpl = state
+        if opt_template is not None:
+            tmpl = state.replace(
+                opt_state=jax.tree_util.tree_map(
+                    lambda s: np.zeros(s.shape, s.dtype), opt_template
+                )
+            )
+        res = elastic.restore_latest(
+            cfg.checkpoint_dir, tmpl, mesh, metrics=metrics, logger=logger,
+            zero_shards_to=zero_shards_to,
+        )
+        if res is None:
+            raise elastic.RollbackLimitError(
+                f"bad-step rollback requested ({reason} at epoch {at_epoch} "
+                f"step {at_step}) but no checkpoint exists in "
+                f"{cfg.checkpoint_dir} to restore"
+            )
+        restored, ckpt_epoch, _ckpt_loss, info = res
+        tx_changed = False
+        if cfg.rollback_lr_backoff != 1.0:
+            lr_scale *= cfg.rollback_lr_backoff
+            restored = restored.replace(
+                tx=make_optimizer(
+                    cfg.learning_rate * lr_scale,
+                    bundle.trainable_mask,
+                    optimizer=cfg.optimizer,
+                    lr_schedule=cfg.lr_schedule,
+                    warmup_steps=cfg.warmup_steps,
+                    total_steps=total_steps,
+                    weight_decay=cfg.weight_decay,
+                )
+            )
+            tx_changed = True
+        # Re-place onto the mesh — the resume path's dataflow, including
+        # the ZeRO detach (never device_put the full unsharded moments).
+        raw_opt = restored.opt_state
+        if defer_zero_opt:
+            restored = restored.replace(opt_state=())
+        placed = elastic.with_retries(
+            lambda: elastic.checked_place(
+                restored, mesh, zero_optimizer=cfg.zero_optimizer, fsdp=cfg.fsdp
+            ),
+            what="rollback state placement (device_put)",
+            retries=cfg.resume_retries, backoff_s=cfg.resume_backoff_s,
+            logger=logger,
+        )
+        if defer_zero_opt:
+            placed = placed.replace(opt_state=zero_shard_opt_state(raw_opt, mesh))
+        state = placed
+        if tx_changed:
+            # The LR lives inside the compiled step program: rebuild it
+            # (one compile per backed-off rollback, documented cost).
+            compiled_step, flops_per_step = build_compiled(state)
+        rollback_policy.after_rollback()
+        next_epoch = ckpt_epoch + 1
+        # Epoch bookkeeping rewinds WITH the state: a later preemption save
+        # must file under what the RESTORED state has completed, not what
+        # the abandoned timeline had.
+        last_completed_epoch = ckpt_epoch
+        rb_cursor = (info.get("manifest") or {}).get("data_cursor")
+        next_step, rb_why = validate_cursor(
+            rb_cursor, cfg=cfg, fingerprint=fingerprint, n_steps=n_steps,
+            start_epoch=next_epoch,
+        )
+        if rb_why is not None and (
+            rb_cursor is not None or os.path.exists(info["path"] + ".dirty")
+        ):
+            # Same typed fallback contract as the resume path: ANY cursor
+            # mismatch is recorded, never silently misaligned.
+            metrics.write(
+                {
+                    "kind": "anomaly", "reason": "cursor_mismatch",
+                    "epoch": next_epoch, "detail": rb_why,
+                }
+            )
+            logger.warning(
+                "rollback cursor unavailable (%s) — replaying epoch %d "
+                "from step 0", rb_why, next_epoch,
+            )
+        metrics.write(
+            {
+                "kind": "rollback", "epoch": at_epoch, "step": at_step,
+                "reason": reason, "restored_epoch": ckpt_epoch,
+                "rollbacks": rollbacks_done, "lr_scale": round(lr_scale, 6),
+                "path": info["path"],
+            }
+        )
+        last_saved_epoch = ckpt_epoch
+        logger.warning(
+            "bad-step rollback #%d/%d (%s at epoch %d step %d): restored "
+            "%s in-process, continuing at epoch %d step %d%s",
+            rollbacks_done, cfg.max_rollbacks, reason, at_epoch, at_step,
+            info["path"], next_epoch, next_step,
+            f", LR scaled to {lr_scale:g}x" if tx_changed else "",
+        )
+        return next_epoch, next_step
     # A resumed run must not demote a better historical best (best.json
     # survives restarts; missing marker → any first accuracy wins). Only
     # process 0 reads the marker: on multi-host WITHOUT a shared checkpoint
@@ -976,9 +1335,16 @@ def _train_impl(
         best_accuracy = _p0_scalar(
             _marker["accuracy"] if _marker else float("-inf"), mesh
         )
+    # Epoch loop as an explicit cursor (epoch, next_start_step) rather than
+    # a range: exact-step resume starts the first epoch mid-way, and a
+    # bad-step rollback jumps BACKWARD to the restored checkpoint's cursor.
+    epoch = start_epoch
+    next_start_step = start_step
+    last_completed_epoch = start_epoch - 1
+    interrupted = None  # (epoch, next_step, steps_run_this_session) on mid-epoch stop
     with guard:
       try:
-        for epoch in range(start_epoch, cfg.num_epochs):
+        while epoch < cfg.num_epochs:
             if _stop_agreed(watchdog.should_stop(epoch=epoch), mesh):
                 summary.preempted = True
                 logger.info(
@@ -987,16 +1353,20 @@ def _train_impl(
                     "checkpoint)", epoch,
                 )
                 break
+            start_step_this, next_start_step = next_start_step, 0
             t0 = time.perf_counter()  # ≙ MPI.Wtime() (main.py:145)
             health.start_epoch()  # re-arm the recompile counter per epoch
             heartbeat.start_epoch()  # beats never span epoch boundaries
             losses, counts = [], []
             loss_v = count_v = None  # [steps] device arrays, set below
+            rollback_trigger = None  # (reason, step) breaking the step loop
             if cfg.device_cache and cfg.scan_epoch:
                 # One dispatch for the whole epoch: stack the per-step index
                 # batches and let the compiled lax.scan run every step
                 # back-to-back on device. metrics come back as [n_steps]
                 # arrays — used as-is, never split into per-step scalars.
+                # (start_step_this is always 0 here: validate_cursor replays
+                # rather than reshaping the compiled scan.)
                 idx_steps = list(
                     cached_index_batches(cfg, n_cache, cache_batch, epoch, n_steps)
                 )
@@ -1008,10 +1378,40 @@ def _train_impl(
                         if telemetry_sync:
                             jax.block_until_ready(m["loss"])
                     loss_v, count_v = m["loss"], m["count"]
+                    skipped_before_epoch = steps_skipped_total
+                    if bad_step_skip and "skipped" in m:
+                        # Mask skipped steps out of the epoch accounting (a
+                        # discarded update contributes no samples, and its
+                        # observed NaN loss must not poison the mean), and
+                        # enforce the consecutive-skip budget post-hoc.
+                        skip_v = np.asarray(m["skipped"], np.int64)
+                        steps_skipped_total += int(skip_v.sum())
+                        if registry is not None and skip_v.sum():
+                            registry.counter("train/steps_skipped").inc(
+                                int(skip_v.sum())
+                            )
+                        keep = jnp.asarray(1 - skip_v)
+                        loss_v = jnp.where(keep == 1, loss_v, 0.0)
+                        count_v = count_v * keep.astype(count_v.dtype)
+                        # Seed from the previous epoch's trailing streak so
+                        # a run of skips spanning the epoch boundary still
+                        # trips the limit (the scan has no per-step host
+                        # boundary to count at).
+                        longest, run = 0, skip_streak
+                        for flag in skip_v:
+                            run = run + 1 if flag else 0
+                            longest = max(longest, run)
+                        skip_streak = run  # carries into the next epoch
+                        if longest >= cfg.max_skipped_steps:
+                            _abort_skip_limit(
+                                metrics, epoch, int(longest), cfg.max_skipped_steps
+                            )
                     # Per-step records post-hoc from the [n_steps] arrays
                     # (host timing is null — the scan never returns to the
                     # host between steps); sentinel checks every step.
-                    health.on_scan_epoch(epoch, m)
+                    health.on_scan_epoch(
+                        epoch, m, steps_skipped_base=skipped_before_epoch
+                    )
                     if cfg.log_every_steps:
                         for step_i in range(
                             cfg.log_every_steps - 1, int(loss_v.shape[0]), cfg.log_every_steps
@@ -1027,7 +1427,8 @@ def _train_impl(
                 step_args = (
                     (dataset, labels_all, idx, valid)
                     for idx, valid in cached_index_batches(
-                        cfg, n_cache, cache_batch, epoch, n_steps
+                        cfg, n_cache, cache_batch, epoch, n_steps,
+                        start_step=start_step_this,
                     )
                 )
             else:
@@ -1035,16 +1436,20 @@ def _train_impl(
                 # shape with masked rows, so training keeps every image without
                 # triggering an XLA recompile; device_prefetch keeps the H2D
                 # copies a couple of steps ahead of compute.
+                batches = synchronized_batches(
+                    loader, epoch, n_steps, start_step=start_step_this
+                )
+                if faults.nonfinite_at_step:
+                    batches = faults.poison_batches(batches, epoch)
                 step_args = (
                     (dev_batch,)
                     for dev_batch in device_prefetch(
-                        synchronized_batches(loader, epoch, n_steps),
-                        mesh, host_batch, cfg.prefetch_device_batches,
+                        batches, mesh, host_batch, cfg.prefetch_device_batches,
                     )
                 )
             stopped_mid_epoch = False
             step_iter = iter(step_args)
-            step_i = -1
+            step_i = start_step_this - 1
             while True:
                 # Ingest span = time the consumer WAITS for the next batch:
                 # decode + H2D dispatch not yet hidden by prefetch — the
@@ -1074,10 +1479,50 @@ def _train_impl(
                     # lands in the step time the heartbeat exchanges.
                     faults.maybe_delay()
                 step_s = time.perf_counter() - t_step
-                losses.append(m["loss"])
-                counts.append(m["count"])
-                health.on_step(epoch, step_i, m, data_wait_s, step_s)
+                was_skipped = None
+                if bad_step_skip:
+                    # The device already discarded the bad update; count the
+                    # streak (the verdict is a psum'd value, so every host
+                    # agrees) and mask the step out of the epoch accounting.
+                    was_skipped = int(m["skipped"])
+                    if was_skipped:
+                        skip_streak += 1
+                        steps_skipped_total += 1
+                        if registry is not None:
+                            registry.counter("train/steps_skipped").inc()
+                        logger.warning(
+                            "bad step skipped (non-finite update) at epoch "
+                            "%d step %d — params unchanged, %d consecutive "
+                            "(%d total)", epoch, step_i, skip_streak,
+                            steps_skipped_total,
+                        )
+                        losses.append(jnp.zeros_like(m["loss"]))
+                        counts.append(jnp.zeros_like(m["count"]))
+                    else:
+                        skip_streak = 0
+                        losses.append(m["loss"])
+                        counts.append(m["count"])
+                else:
+                    losses.append(m["loss"])
+                    counts.append(m["count"])
+                health.on_step(
+                    epoch, step_i, m, data_wait_s, step_s,
+                    skipped=was_skipped,
+                    steps_skipped=steps_skipped_total if bad_step_skip else None,
+                )
                 heartbeat.on_step(epoch, step_i, step_s)
+                if bad_step_skip and skip_streak >= cfg.max_skipped_steps:
+                    _abort_skip_limit(
+                        metrics, epoch, skip_streak, cfg.max_skipped_steps
+                    )
+                if rollback_policy is not None:
+                    reason = rollback_policy.observe(
+                        float(m["loss"]),
+                        float(m["grad_norm"]) if "grad_norm" in m else None,
+                    )
+                    if reason is not None:
+                        rollback_trigger = (reason, step_i)
+                        break
                 if registry is not None:
                     h_wait_ms.observe(data_wait_s * 1e3)
                     h_step_ms.observe(step_s * 1e3)
@@ -1095,13 +1540,23 @@ def _train_impl(
                     logger.info(
                         "epoch %d step %d loss %.4f", epoch, step_i + 1, float(m["loss"])
                     )
+            if rollback_trigger is not None:
+                # Bad-step rollback: restore the last good checkpoint
+                # in-process and jump the epoch cursor back to it. The
+                # partial epoch's bookkeeping (losses/counts) is discarded
+                # with the poisoned state.
+                reason, at_step = rollback_trigger
+                epoch, next_start_step = _rollback_restore(epoch, at_step, reason)
+                continue
             if stopped_mid_epoch:
                 summary.preempted = True
+                interrupted = (epoch, step_i, step_i - start_step_this, start_step_this)
                 logger.info(
-                    "preemption signal: stopping mid-epoch %d at step boundary "
-                    "%d (partial-epoch state — saved with a .dirty marker; "
-                    "resume warns before replaying the interrupted epoch)",
-                    epoch, step_i,
+                    "preemption signal: stopping mid-epoch %d at step "
+                    "boundary %d (partial-epoch state — saved dirty with an "
+                    "exact-step data cursor; resume continues at step %d "
+                    "when the cursor validates, replaying zero optimizer "
+                    "steps)", epoch, step_i, step_i,
                 )
                 break
             # Device sync so the timer measures compute, not dispatch.
@@ -1166,7 +1621,8 @@ def _train_impl(
                 # immediately; device_get + write happen on a background thread
                 # (the sync version stalled epochs 25-45 s through the device
                 # relay). ≙ rank-0 save (main.py:162-171), without stopping the
-                # world.
+                # world. The topology sidecar carries the exact-step data
+                # cursor: a clean epoch-E save resumes at (E+1, step 0).
                 ckpt_t0 = time.perf_counter()
                 with tracer.span("checkpoint", args={"epoch": epoch}):
                     path = checkpointer.save(
@@ -1174,7 +1630,12 @@ def _train_impl(
                         loss=epoch_loss,
                         keep=cfg.keep_checkpoints,
                         moments_bf16=cfg.ckpt_bf16_moments,
-                        manifest=topology,
+                        manifest=dict(
+                            topology,
+                            data_cursor=data_cursor(
+                                cfg, fingerprint, n_steps, epoch + 1, 0
+                            ),
+                        ),
                     )
                 last_saved_epoch = epoch
                 if path:
@@ -1252,12 +1713,20 @@ def _train_impl(
                             loss=epoch_loss, keep=cfg.keep_checkpoints,
                             on_durable=_mark_best,
                             moments_bf16=cfg.ckpt_bf16_moments,
-                            manifest=topology,
+                            manifest=dict(
+                                topology,
+                                data_cursor=data_cursor(
+                                    cfg, fingerprint, n_steps, epoch + 1, 0
+                                ),
+                            ),
                         )
                         last_saved_epoch = epoch
                         if best_path:
                             summary.checkpoint_path = best_path
                     logger.info("new best: val acc %.4f at epoch %d", acc, epoch)
+
+            last_completed_epoch = epoch
+            epoch += 1
 
       except BaseException:
         # Drain the in-flight write on the failure path too, but never let a
@@ -1270,25 +1739,76 @@ def _train_impl(
             logger.warning("background checkpoint write also failed: %s", werr)
         raise
       if summary.preempted and cfg.checkpoint_every_epochs:
-        # Preserve completed-but-unsaved progress (checkpoint_every_epochs>1
-        # leaves up to k-1 epochs unsaved). After a mid-epoch stop the state
-        # additionally carries a partial epoch's updates — saved under the
-        # last COMPLETED epoch, so resume redoes the interrupted epoch on
-        # top, double-applying those batches' steps. Such saves are marked
-        # dirty (a ``.dirty`` sidecar) and resume warns: the progress is
-        # kept, the trajectory perturbation vs the reference's clean-boundary
-        # restart (main.py:127-130) is surfaced instead of silent.
-        # `completed >= start_epoch`: only epochs completed by THIS run — a
-        # resumed run preempted before finishing any epoch must not replace
-        # the clean on-disk checkpoint it restored from with a dirty state.
-        completed = start_epoch + summary.epochs_run - 1
-        if completed >= start_epoch and completed != last_saved_epoch:
+        # Preserve whatever the preemption would otherwise lose. Two cases:
+        #
+        # - Stopped MID-epoch with steps run this session: save the state
+        #   (which carries the partial epoch's updates) DIRTY under the
+        #   last completed epoch, with the exact-step data cursor in the
+        #   topology sidecar — resume continues at step N+1, replaying
+        #   ZERO optimizer steps (ISSUE 10). A run that stopped before
+        #   running any new step saves nothing new (the on-disk checkpoint
+        #   already describes this state); mid-epoch-0 stops with no
+        #   completed epoch still have no epoch to file under, so the
+        #   partial steps are dropped exactly as before.
+        # - Stopped at an epoch boundary: save completed-but-unsaved
+        #   epochs (checkpoint_every_epochs > 1 leaves up to k-1 unsaved).
+        completed = last_completed_epoch
+        # Never rewrite the best-pinned checkpoint with partial-epoch state:
+        # best.json claims that file holds the accuracy it measured, and the
+        # dirty save below files under `completed` — the same name as that
+        # epoch's clean save. Integrity of the pinned file outranks keeping
+        # the partial steps (they are dropped, exactly the old behavior).
+        _best = ckpt.best_marker(cfg.checkpoint_dir) if cfg.track_best else None
+        _best_is_target = bool(
+            _best
+            and completed >= 0
+            and _best.get("checkpoint")
+            == os.path.basename(ckpt._ckpt_path(cfg.checkpoint_dir, completed))
+        )
+        if (
+            interrupted is not None and interrupted[2] > 0 and completed >= 0
+            and not _best_is_target
+        ):
+            int_epoch, int_step, _steps, _start = interrupted
             path = checkpointer.save(
                 cfg.checkpoint_dir, epoch=completed, state=_saveable(state),
                 loss=epoch_loss,
-                keep=cfg.keep_checkpoints, dirty=stopped_mid_epoch,
+                keep=cfg.keep_checkpoints, dirty=True,
                 moments_bf16=cfg.ckpt_bf16_moments,
-                manifest=topology,
+                manifest=dict(
+                    topology,
+                    data_cursor=data_cursor(
+                        cfg, fingerprint, n_steps, int_epoch, int_step
+                    ),
+                ),
+            )
+            last_saved_epoch = completed
+            if path:
+                summary.checkpoint_path = path
+                logger.info(
+                    "preemption checkpoint dispatched: %s (dirty; cursor "
+                    "epoch %d step %d)", path, int_epoch, int_step,
+                )
+        elif (
+            completed >= start_epoch
+            and completed != last_saved_epoch
+            # A stop with ZERO new steps in the interrupted epoch is a clean
+            # boundary state ONLY if the epoch wasn't entered mid-way (a
+            # resumed-then-immediately-stopped run's state is the on-disk
+            # dirty checkpoint, already saved).
+            and (interrupted is None or interrupted[3] == 0)
+        ):
+            path = checkpointer.save(
+                cfg.checkpoint_dir, epoch=completed, state=_saveable(state),
+                loss=epoch_loss,
+                keep=cfg.keep_checkpoints,
+                moments_bf16=cfg.ckpt_bf16_moments,
+                manifest=dict(
+                    topology,
+                    data_cursor=data_cursor(
+                        cfg, fingerprint, n_steps, completed + 1, 0
+                    ),
+                ),
             )
             if path:
                 summary.checkpoint_path = path

@@ -81,6 +81,28 @@ FAULT_GATES: dict[str, str] = {
         "make the first N resume-side state placements raise — exercises "
         "the bounded retry+backoff around device_put on restore"
     ),
+    "MPT_FAULT_NONFINITE_AT_STEP": (
+        "poison the Nth train batch (1-based, counted across epochs) with "
+        "NaN pixels so that step's loss/grad norm go non-finite — announced "
+        "with a kind='fault' record BEFORE the step runs, so the bad-step "
+        "policies (--bad-step-policy skip|rollback) are testable without a "
+        "hand-tuned poisoned learning rate. Streaming float-input train "
+        "path only (uint8 batches cannot carry a NaN; the device-cache "
+        "path feeds indices, not pixels)"
+    ),
+    "MPT_FAULT_DECODE_N": (
+        "poison N DISTINCT samples' decodes permanently (one count per "
+        "sample on first draw; every retry of a poisoned sample fails too) "
+        "— N=1 quarantines exactly one sample regardless of worker-thread "
+        "interleaving, driving the decode-failure retry/quarantine path in "
+        "data/pipeline.py deterministically"
+    ),
+    "MPT_FAULT_PREEMPT_AT_STEP": (
+        "behave as if a preemption notice arrived right after the Nth "
+        "completed train step (1-based, counted across epochs) — a "
+        "deterministic mid-epoch stop that exercises the dirty-save + "
+        "exact-step-resume path without racing a real signal"
+    ),
     "MPT_FAULT_PREPROCESS_N": (
         "make the first N serve preprocess calls raise a non-ServeError — "
         "the preprocess-worker-crash scenario (typed PreprocessError to "

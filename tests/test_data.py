@@ -239,10 +239,12 @@ def test_host_cache_completes_after_early_close():
 
 
 def test_host_cache_backfill_error_surfaces(tmp_path):
-    """A decode failure in the post-close backfill must not be silent: the
-    next epoch (or wait_cache_complete) raises it."""
+    """A decode failure in the post-close backfill must not be silent: a
+    failure past the quarantine budget surfaces through
+    wait_cache_complete (within-budget failures quarantine instead —
+    tests/test_selfheal.py)."""
     from mpi_pytorch_tpu.data.manifest import Manifest
-    from mpi_pytorch_tpu.data.pipeline import DataLoader
+    from mpi_pytorch_tpu.data.pipeline import BadSampleLimitError, DataLoader
 
     img_dir = tmp_path / "img"
     img_dir.mkdir()
@@ -259,12 +261,13 @@ def test_host_cache_backfill_error_surfaces(tmp_path):
         category_ids=np.arange(10), img_dir=str(img_dir),
     )
     dl = DataLoader(m, batch_size=4, image_size=(16, 16), shuffle=False,
-                    drop_remainder=True, synthetic=False, host_cache=True)
+                    drop_remainder=True, synthetic=False, host_cache=True,
+                    max_bad_samples=1, decode_retries=0)
     it = dl.epoch(0)
     next(it)
     next(it)  # both full batches decode fine (files 0-7)
     it.close()  # backfill of the missing tail files now fails in background
-    with pytest.raises(Exception):
+    with pytest.raises(BadSampleLimitError):
         dl.wait_cache_complete()
     assert not dl._cache_complete
 

@@ -9,8 +9,11 @@ not that the fault happened to miss. Two halves:
   FAULT_GATES``): in-process faults the framework itself honors — kill a
   rank right after step N, delay a host's steps to fake a straggler, wedge
   backend init for N attempts, fail the first N resume placements, crash
-  the first N serve preprocess calls. ``fault_env()`` builds the env-var
-  dict a test hands its trainer subprocess.
+  the first N serve preprocess calls, NaN-poison the Nth train batch
+  (the ``--bad-step-policy`` drills), fail the first N image decodes
+  (the quarantine drill), and fake a preemption notice after step N
+  (the exact-step mid-epoch-resume drill). ``fault_env()`` builds the
+  env-var dict a test hands its trainer subprocess.
 
 - **File faults** (this module's actions): corrupt the NEWEST checkpoint
   (truncate / garbage / empty) so the restore fallback path
@@ -86,6 +89,9 @@ def fault_env(
     device_put_fail: int | None = None,
     preprocess_crash: int | None = None,
     preempt_file: str | None = None,
+    nonfinite_at_step: int | None = None,
+    decode_fail: int | None = None,
+    preempt_at_step: int | None = None,
     base: dict | None = None,
 ) -> dict:
     """The env-var dict arming the in-process gates — hand it to a trainer
@@ -102,6 +108,9 @@ def fault_env(
         "MPT_FAULT_DEVICE_PUT_N": device_put_fail,
         "MPT_FAULT_PREPROCESS_N": preprocess_crash,
         "MPT_PREEMPT_FILE": preempt_file,
+        "MPT_FAULT_NONFINITE_AT_STEP": nonfinite_at_step,
+        "MPT_FAULT_DECODE_N": decode_fail,
+        "MPT_FAULT_PREEMPT_AT_STEP": preempt_at_step,
     }
     env = dict(base) if base else {}
     for name, value in values.items():

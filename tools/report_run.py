@@ -17,6 +17,9 @@ run recorded that kind:
   max_wait/bucket changes with the p99-vs-target evidence);
 - elastic-resume lines (topology from → to, ZeRO re-chunking, corrupt
   checkpoints skipped) and fault/preemption signals;
+- self-healing lines (ISSUE 10): one ROLLBACK line per in-process
+  bad-step rollback (trigger → restored epoch, LR backoff) and the
+  skipped-step totals / longest streak in the step section;
 - SLO alert lines (rule, value vs threshold, actions) and the final live
   metrics-registry snapshot (counters + histogram p50/p95/p99).
 
@@ -157,6 +160,17 @@ def summarize(records: list[dict]) -> dict:
                 "first": round(norms[0], 4), "last": round(norms[-1], 4),
                 "max": round(max(norms), 4),
             }
+        # Schema-v6 bad-step-policy fields (--bad-step-policy skip runs):
+        # how many updates were discarded, and the longest consecutive run.
+        skips = [s for s in steps if s.get("skipped")]
+        if any("skipped" in s for s in steps):
+            longest = run = 0
+            for s in steps:
+                run = run + 1 if s.get("skipped") else 0
+                longest = max(longest, run)
+            stat["steps_skipped"] = {
+                "total": len(skips), "longest_streak": longest,
+            }
         hbm = _finite([s.get("hbm_bytes") for s in steps])
         if hbm:
             stat["hbm_peak_mb"] = round(max(hbm) / 1e6, 1)
@@ -277,6 +291,15 @@ def summarize(records: list[dict]) -> dict:
             {k: f.get(k) for k in ("reason", "epoch", "step", "detail", "streak")}
             for f in faults
         ]
+    rollbacks = by_kind.get("rollback", [])
+    if rollbacks:
+        summary["rollbacks"] = [
+            {k: r.get(k) for k in (
+                "epoch", "step", "reason", "restored_epoch", "rollbacks",
+                "lr_scale", "path",
+            )}
+            for r in rollbacks
+        ]
     alerts = by_kind.get("alert", [])
     if alerts:
         summary["alerts"] = [
@@ -365,6 +388,12 @@ def render(path: str, records: list[dict], summary: dict) -> str:
             )
         if "hbm_peak_mb" in ss:
             out.append(f"  peak HBM in use: {ss['hbm_peak_mb']} MB")
+        if "steps_skipped" in ss:
+            sk = ss["steps_skipped"]
+            out.append(
+                f"  skipped steps (bad-step policy): {sk['total']} "
+                f"discarded, longest streak {sk['longest_streak']}"
+            )
         out.append(
             f"  recompiles (max per record): {ss['recompiles_max']}; "
             f"non-finite losses: {ss['nonfinite_losses']}"
@@ -478,6 +507,18 @@ def render(path: str, records: list[dict], summary: dict) -> str:
             + ("" if f.get("step") is None else f" step {f['step']}")
             + ("" if not f.get("detail") else f" — {f['detail']}")
         )]
+    for r in summary.get("rollbacks", []):
+        line = (
+            f"ROLLBACK: #{r.get('rollbacks')} — {r['reason']} at epoch "
+            f"{r['epoch']}"
+            + ("" if r.get("step") is None else f" step {r['step']}")
+            + f" → restored epoch {r.get('restored_epoch')}"
+        )
+        if r.get("lr_scale") not in (None, 1.0, 1):
+            line += f" (LR scaled to {r['lr_scale']}x)"
+        if r.get("path"):
+            line += f" [{os.path.basename(str(r['path']))}]"
+        out += ["", line]
     for a in summary.get("alerts", []):
         out += ["", (
             f"ALERT [{a.get('severity')}]: {a['rule']} — "
