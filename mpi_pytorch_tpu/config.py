@@ -287,6 +287,26 @@ class Config:
     # path computes lax.top_k online). --fused-head-eval streams argmax only,
     # so the fused server serves k=1 (warned, not silent).
     serve_topk: int = 5
+    # Serving numeric precision (ISSUE 11): which predict-executable set(s)
+    # are AOT-compiled and warmed at startup.
+    #   bf16 — the compute-dtype path (today's default);
+    #   int8 — post-training int8 (ops/quantize.py): per-channel int8
+    #          conv/dense weights dequantized on the fly (half the resident
+    #          weight bytes — the head is byte-bound, docs/roofline_*.json),
+    #          and under the --fused-head-eval gate the fused int8 head
+    #          kernel (int8×int8 MXU, int32 accumulate);
+    #   both — compile BOTH sets and start serving bf16: the fleet
+    #          controller's precision retune axis (bf16 under SLO headroom,
+    #          int8 under p99 pressure) switches only ever between these
+    #          startup-compiled sets, parity stamped on retune records.
+    serve_precision: str = "bf16"
+    # evaluate --quantize-eval: offline int8-vs-bf16 parity report (top-1/
+    # top-5 agreement + max logit drift on a fixed seeded sample) — the
+    # reusable oracle behind the serve-side parity gates.
+    quantize_eval: bool = False
+    # Sample-batch size for int8 calibration (the head activation scale),
+    # the serve startup parity stamp, and the --quantize-eval probe.
+    quantize_calib: int = 64
 
     # --- fleet serving (mpi_pytorch_tpu/serve/fleet/, ISSUE 9) ---
     # N > 0 builds an in-process N-host fleet (FleetServer: N InferenceServer
@@ -615,6 +635,25 @@ class Config:
             raise ValueError(
                 f"serve_topk={self.serve_topk} exceeds num_classes="
                 f"{self.num_classes}"
+            )
+        if self.serve_precision not in ("bf16", "int8", "both"):
+            raise ValueError(
+                f"serve_precision must be bf16|int8|both, got "
+                f"{self.serve_precision!r}"
+            )
+        if self.serve_precision != "bf16" and self.fused_head_eval and self.serve_topk > 1:
+            raise ValueError(
+                f"serve_precision={self.serve_precision!r} with "
+                "--fused-head-eval serves through the fused int8 head "
+                "kernel, which streams argmax only — and a precision-"
+                "switchable server must keep ONE response shape across its "
+                f"executable sets. Set serve_topk=1 (got {self.serve_topk}) "
+                "or drop --fused-head-eval for top-k int8 serving"
+            )
+        if self.quantize_calib < 1:
+            raise ValueError(
+                f"quantize_calib must be >= 1 (the int8 calibration/parity "
+                f"sample batch), got {self.quantize_calib}"
             )
         if self.serve_max_wait_ms < 0:
             raise ValueError(
@@ -1000,6 +1039,16 @@ class Config:
                 f"{self.serve_buckets!r}"
             )
         return tuple(buckets)
+
+    def parsed_serve_precisions(self) -> tuple[str, ...]:
+        """``serve_precision`` as the tuple of executable sets to compile
+        at startup — ONE definition of the bf16|int8|both mapping, shared
+        by InferenceServer and FleetServer (``validate_config`` rejects
+        anything else first)."""
+        return {
+            "bf16": ("bf16",), "int8": ("int8",),
+            "both": ("bf16", "int8"),
+        }[self.serve_precision]
 
 
 def parse_compiler_options(text: str) -> dict[str, Any] | None:

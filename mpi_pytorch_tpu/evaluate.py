@@ -203,7 +203,10 @@ def evaluate(cfg: Config) -> EvalSummary:
     )
 
 
-def _make_predict_step(mesh, compute_dtype, fused_head: bool = False, topk: int = 1):
+def _make_predict_step(
+    mesh, compute_dtype, fused_head: bool = False, topk: int = 1,
+    int8_head: bool = False,
+):
     # Canonicalize to positional args: lru_cache keys keyword and
     # positional calls separately, which would double-compile the step.
     if fused_head and topk > 1:
@@ -212,7 +215,15 @@ def _make_predict_step(mesh, compute_dtype, fused_head: bool = False, topk: int 
             "the plain predict path (serve forces topk=1 under "
             "--fused-head-eval, with a warning)"
         )
-    return _make_predict_step_impl(mesh, compute_dtype, bool(fused_head), int(topk))
+    if int8_head and not fused_head:
+        raise ValueError(
+            "int8_head selects the fused int8 kernel variant and requires "
+            "fused_head=True; the plain int8 path is just the plain predict "
+            "step over a quantized state (ops/quantize.quantize_state)"
+        )
+    return _make_predict_step_impl(
+        mesh, compute_dtype, bool(fused_head), int(topk), bool(int8_head)
+    )
 
 
 def _row_sharding(mesh, batch: int):
@@ -228,7 +239,9 @@ def _row_sharding(mesh, batch: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _make_predict_step_impl(mesh, compute_dtype, fused_head: bool, topk: int):
+def _make_predict_step_impl(
+    mesh, compute_dtype, fused_head: bool, topk: int, int8_head: bool = False,
+):
     """ONE batched forward yielding both the eval metrics and the per-image
     argmax — predictions and accuracy come from the same pass (the
     reference's predictor ranks compute the per-image argmax and discard it,
@@ -289,9 +302,11 @@ def _make_predict_step_impl(mesh, compute_dtype, fused_head: bool, topk: int):
 
     from mpi_pytorch_tpu.ops.fused_head_ce import head_predict
 
-    @jax.jit
-    def predict_fused(state, batch):
-        images, labels = batch
+    def _intercepted_forward(state, images):
+        """Run the forward with the 'head' Dense intercepted: its INPUT
+        features/kernel/bias land in the returned box, its dummy output IS
+        the model output (the head is every zoo model's last layer that
+        fires this filter) — shared by the bf16 and int8 fused steps."""
         box = {}
 
         def grab_head_input(next_fn, args, kwargs, context):
@@ -302,9 +317,8 @@ def _make_predict_step_impl(mesh, compute_dtype, fused_head: bool, topk: int):
                 box["b"] = m.variables["params"].get(
                     "bias", jnp.zeros((m.features,), jnp.float32)
                 )
-                # The dummy return IS the model output (the head is every
-                # zoo model's last layer that fires this filter) and is
-                # discarded below; XLA dead-code-eliminates it.
+                # The dummy return is discarded below; XLA dead-code-
+                # eliminates it.
                 return jnp.zeros(args[0].shape[:-1] + (m.features,), jnp.float32)
             return next_fn(*args, **kwargs)
 
@@ -312,16 +326,73 @@ def _make_predict_step_impl(mesh, compute_dtype, fused_head: bool, topk: int):
             out = state.apply_fn(
                 state.variables, ingest_images(images, compute_dtype), train=False
             )
+        return out, box
+
+    def _plain_from_logits(out, labels, batch_rows):
+        """The no-head-match fallback (conv-classifier models): ``out`` is
+        the model's REAL logits — plain metrics + pinned argmax."""
+        logits = jax.lax.optimization_barrier(out.astype(jnp.float32))
+        preds = jax.lax.with_sharding_constraint(
+            jnp.argmax(logits, axis=-1).astype(jnp.int32),
+            _row_sharding(mesh, batch_rows),
+        )
+        return metrics_from_logits(logits, labels), preds
+
+    def _fused_metrics(loss, preds, labels):
+        valid = labels >= 0
+        return {
+            "loss": jnp.sum(loss),  # the kernels zero padding rows
+            "correct": jnp.sum((preds == labels) & valid),
+            "count": jnp.sum(valid.astype(jnp.int32)),
+        }
+
+    if int8_head:
+        from mpi_pytorch_tpu.ops.quantize import head_kernel_key, head_predict_int8
+
+        @jax.jit
+        def predict_fused_int8(state, batch):
+            """The int8 twin of ``predict_fused`` over a quantized state
+            (``quantize_state(..., keep_head_int8=True)``): the head Dense
+            kernel the interceptor captures is the RAW int8 tensor (the
+            dequantizing apply wrapper skips it), and the Pallas int8
+            kernel consumes it with the packed tree's per-channel scales
+            and the calibrated activation scale."""
+            images, labels = batch
+            packed = state.params  # {"q", "scale", "act_scale"}
+            out, box = _intercepted_forward(state, images)
+            hk = head_kernel_key(packed["scale"], packed["q"])  # static
+            if "feats" not in box or hk is None:
+                # No int8-kept Dense head (conv classifiers): everything
+                # was dequantized by the apply wrapper and ``out`` is the
+                # real (weight-quantized) logits.
+                return _plain_from_logits(out, labels, images.shape[0])
+            assert out.shape == box["feats"].shape[:-1] + (box["w"].shape[1],), (
+                "intercepted 'head' output shape does not match the model "
+                f"output: {out.shape} vs "
+                f"{box['feats'].shape[:-1] + (box['w'].shape[1],)}"
+            )
+            loss, preds = head_predict_int8(
+                box["feats"], box["w"], box["b"], labels,
+                w_scale=packed["scale"][hk],
+                act_scale=packed["act_scale"],
+                dp_mesh=mesh,
+            )
+            preds = jax.lax.with_sharding_constraint(
+                preds, _row_sharding(mesh, images.shape[0])
+            )
+            return _fused_metrics(loss, preds, labels), preds
+
+        return predict_fused_int8
+
+    @jax.jit
+    def predict_fused(state, batch):
+        images, labels = batch
+        out, box = _intercepted_forward(state, images)
         if "feats" not in box:
             # Head never matched (e.g. squeezenet's Conv classifier, which
             # is also not the final op): ``out`` is then the model's REAL
             # logits — take the plain path instead of failing.
-            logits = jax.lax.optimization_barrier(out.astype(jnp.float32))
-            preds = jax.lax.with_sharding_constraint(
-                jnp.argmax(logits, axis=-1).astype(jnp.int32),
-                _row_sharding(mesh, images.shape[0]),
-            )
-            return metrics_from_logits(logits, labels), preds
+            return _plain_from_logits(out, labels, images.shape[0])
         # The interceptor's dummy return must BE the model output — if an
         # architecture ever routes more layers after its 'head' Dense, the
         # captured features would not be the logits' features and the fused
@@ -337,16 +408,10 @@ def _make_predict_step_impl(mesh, compute_dtype, fused_head: bool, topk: int):
         loss, preds = head_predict(
             box["feats"], box["w"], box["b"], labels, dp_mesh=mesh
         )
-        valid = labels >= 0
-        metrics = {
-            "loss": jnp.sum(loss),  # head_predict zeroes padding rows
-            "correct": jnp.sum((preds == labels) & valid),
-            "count": jnp.sum(valid.astype(jnp.int32)),
-        }
         preds = jax.lax.with_sharding_constraint(
             preds, _row_sharding(mesh, images.shape[0])
         )
-        return metrics, preds
+        return _fused_metrics(loss, preds, labels), preds
 
     return predict_fused
 
@@ -469,8 +534,93 @@ def evaluate_with_predictions(
     return acc, (loss_sum / count if count else float("nan"))
 
 
-def main(argv=None) -> EvalSummary:
-    return evaluate(parse_config(argv))
+def quantize_eval_report(cfg: Config) -> dict:
+    """``--quantize-eval``: the offline int8-vs-bf16 parity report — the
+    reusable oracle the serve-side parity gates lean on (``ops/quantize.
+    parity_probe``), run against the checkpoint the server would load.
+
+    A fixed seeded sample (``--quantize-calib`` images, ``--seed``) goes
+    through the trained model on both paths — the served contract (fused
+    int8 kernel when the ``--fused-head-eval`` gate is active, otherwise
+    the plain predict over the weight-quantized state) — and the report
+    carries top-1/top-5 agreement plus the max full-model logit drift.
+    Written as a ``kind="quant_parity"`` record (schema v7) and returned.
+    """
+    from mpi_pytorch_tpu.config import apply_runtime_flags
+    from mpi_pytorch_tpu.ops import quantize as qz
+    from mpi_pytorch_tpu.parallel.distributed import maybe_initialize_distributed
+    from mpi_pytorch_tpu.train.step import place_state_on_mesh
+
+    maybe_initialize_distributed()
+    apply_runtime_flags(cfg)
+    logger = init_logger("MPT_EVAL", cfg.eval_log_file)
+    # Serving has the request as data: the report needs no manifest either.
+    mesh, _, state, _ = build_inference(cfg, manifests=(None, None))
+    latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
+    if cfg.use_best:
+        marker = ckpt.best_marker(cfg.checkpoint_dir)
+        if marker is None:
+            raise FileNotFoundError(
+                f"use_best=True but no best.json in {cfg.checkpoint_dir}"
+            )
+        latest = os.path.join(cfg.checkpoint_dir, marker["checkpoint"])
+    if latest:
+        state, epoch, _ = ckpt.load_for_eval(latest, state)
+        logger.info("quantize-eval: checkpoint %s (epoch %d)", latest, epoch)
+    else:
+        logger.info(
+            "quantize-eval: no checkpoint in %s — probing fresh init",
+            cfg.checkpoint_dir,
+        )
+    state = place_state_on_mesh(state, mesh)
+    compute_dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        cfg.compute_dtype
+    ]
+    # The SAME gate and calibration batch the serve executables use
+    # (ops/quantize.fused_head_gate / calibration_batch): the oracle
+    # measures the contract the server would actually run, by
+    # construction rather than by textual coincidence.
+    fused = qz.fused_head_gate(cfg)
+    images = qz.calibration_batch(cfg)
+    act_scale = qz.calibrate_head_act_scale(state, images, compute_dtype)
+    q_plain = qz.quantize_state(state, keep_head_int8=False, act_scale=act_scale)
+    drift = qz.max_logit_drift(state, q_plain, images, compute_dtype)
+    if fused:
+        qstate = qz.quantize_state(
+            state, keep_head_int8=True, act_scale=act_scale
+        )
+        topk = 1  # the fused kernels stream argmax only (both precisions)
+    else:
+        qstate, topk = q_plain, min(cfg.serve_topk, cfg.num_classes)
+    probe = qz.parity_probe(
+        state, qstate, mesh, compute_dtype, images,
+        topk=topk, fused_head=fused,
+    )
+    report = {
+        "kind": "quant_parity",
+        "precision": "int8",
+        "model": cfg.model_name,
+        "max_logit_drift": round(drift, 6),
+        **probe,
+    }
+    logger.info(
+        "quantize-eval parity: top1 %.4f, top5 %s, max logit drift %.4g "
+        "over %d samples (%s path)",
+        report["top1_agree"],
+        "-" if report["top5_agree"] is None else f"{report['top5_agree']:.4f}",
+        drift, report["samples"], "fused int8" if fused else "plain int8",
+    )
+    writer = MetricsWriter(cfg.metrics_file)
+    writer.write(dict(report))
+    writer.close()
+    return report
+
+
+def main(argv=None):
+    cfg = parse_config(argv)
+    if cfg.quantize_eval:
+        return quantize_eval_report(cfg)
+    return evaluate(cfg)
 
 
 if __name__ == "__main__":

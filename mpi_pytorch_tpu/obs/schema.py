@@ -19,8 +19,9 @@ Record kinds (every record also carries ``ts``, the epoch-seconds stamp
 | step      | epoch, step, loss                                   | grad_norm, data_wait_ms, step_ms, recompiles, hbm_bytes, sync_ms, overlap_frac, skipped, steps_skipped |
 | heartbeat | epoch, step, step_ms, median_step_ms, stragglers, threshold | images_per_sec |
 | anomaly   | reason, epoch                                       | step, loss, grad_norm, path, detail |
-| serve     | bucket, requests, queue_depth, fill_ratio, queue_wait_ms, device_ms | preprocess_ms, total_ms |
-| serve_bench | mode, buckets, max_wait_ms, requests, p50_ms, p95_ms, p99_ms, images_per_sec | model, offered_rps, rejected, mean_fill_ratio, compiles_after_warmup, chips |
+| serve     | bucket, requests, queue_depth, fill_ratio, queue_wait_ms, device_ms | preprocess_ms, total_ms, precision |
+| serve_bench | mode, buckets, max_wait_ms, requests, p50_ms, p95_ms, p99_ms, images_per_sec | model, offered_rps, rejected, mean_fill_ratio, compiles_after_warmup, chips, precision, parity_top1 |
+| quant_parity | precision, top1_agree, samples                   | top5_agree, max_logit_drift, model |
 | resume    | epoch, to_devices                                   | from_devices, from_mesh, to_mesh, path, zero_shards_from, zero_shards_to, corrupt_skipped, strategy, cursor_epoch, cursor_step |
 | fault     | reason                                              | epoch, step, detail, streak |
 | rollback  | epoch, reason                                       | step, restored_epoch, rollbacks, lr_scale, path, detail |
@@ -88,7 +89,17 @@ from typing import Any, Mapping
 #      (the exact-step data cursor stamped in the checkpoint's topology
 #      sidecar), and the ``anomaly`` record's optional ``path``/``detail``
 #      (``reason=bad_sample`` quarantines name the undecodable file).
-SCHEMA_VERSION = 6
+#   7: the quantized-serving fields (ISSUE 11): ``precision`` on ``serve``
+#      flushes (which startup-compiled executable set ran the batch —
+#      stamped when a server holds multiple sets or serves non-bf16) and
+#      on ``serve_bench`` rows (plus ``parity_top1``, the int8-vs-bf16
+#      startup agreement, on int8 rows); ``precision_from``/
+#      ``precision_to`` + ``parity_top1`` on ``fleet`` retune records
+#      (the controller's precision axis, with the measured top-1 parity
+#      delta on the record); and the ``quant_parity`` kind — one offline
+#      int8-vs-bf16 parity report from ``evaluate --quantize-eval``
+#      (top-1/top-5 agreement + max logit drift on a fixed sample).
+SCHEMA_VERSION = 7
 
 _NUM = (int, float)
 _INT = (int,)
@@ -129,6 +140,11 @@ REQUIRED: dict[str, dict[str, tuple]] = {
     # v6: one in-process bad-step rollback (train/trainer.py,
     # --bad-step-policy rollback): where it triggered and why.
     "rollback": {"epoch": _INT, "reason": (str,)},
+    # v7: one offline int8-vs-bf16 parity report (evaluate --quantize-eval
+    # — the serve-side parity gates' reusable oracle).
+    "quant_parity": {
+        "precision": (str,), "top1_agree": _NUM, "samples": _INT,
+    },
 }
 
 OPTIONAL: dict[str, dict[str, tuple]] = {
@@ -160,6 +176,10 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # PreprocessError to their callers) and cumulative worker-pool
         # respawns — absent on clean flushes.
         "preprocess_failures": _INT, "worker_respawns": _INT,
+        # v7: which startup-compiled executable set ran this flush —
+        # stamped when the server holds multiple precision sets or serves
+        # non-bf16 (pure-bf16 servers keep v6-identical records).
+        "precision": (str,),
     },
     "serve_bench": {
         "model": (str,), "offered_rps": _NUM, "rejected": _INT,
@@ -169,6 +189,10 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # name → {requests, fill_pct, mean_ms}, all deltas over THIS
         # sweep point; per-point tail percentiles live on the row itself).
         "fleet_hosts": _INT, "per_host": (dict,),
+        # v7: the --precision sweep axis; int8 rows also carry the
+        # startup int8-vs-bf16 top-1 agreement the accuracy claim rests
+        # on (a throughput row without its parity stamp is half a row).
+        "precision": (str,), "parity_top1": _NUM,
     },
     "resume": {
         "from_devices": _INT, "from_mesh": (str,), "to_mesh": (str,),
@@ -196,6 +220,11 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         "spare": (str,), "max_wait_ms_from": _NUM, "max_wait_ms_to": _NUM,
         "buckets_from": (str,), "buckets_to": (str,), "p99_ms": _NUM,
         "target_p99_ms": _NUM, "compiles_after_warmup": _INT,
+        # v7: the controller's precision retune axis — which executable
+        # set the host left/entered, and the measured int8-vs-bf16 top-1
+        # agreement stamped as the retune's accuracy evidence.
+        "precision_from": (str,), "precision_to": (str,),
+        "parity_top1": _NUM,
     },
     # v6: which step the rollback triggered at, what it restored (the
     # checkpoint's filed epoch + path), how many rollbacks this run has
@@ -212,6 +241,10 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
     "alert": {
         "metric": (str,), "value": _NUM, "threshold": _NUM, "streak": _INT,
         "action": (str,), "detail": (str,), "epoch": _INT, "step": _INT,
+    },
+    # v7: top5_agree is null for fused (argmax-only) contracts.
+    "quant_parity": {
+        "top5_agree": _NUM, "max_logit_drift": _NUM, "model": (str,),
     },
 }
 

@@ -256,25 +256,18 @@ def _bwd_rule(interpret, residuals, g):
 _fused_head_ce.defvjp(_fwd_rule, _bwd_rule)
 
 
-def _predict_kernel(
-    labels_ref, feats_ref, w_ref, b_ref,
+def online_predict_update(
+    j, n_programs, logits, labels_ref,
     loss_ref, pred_ref, m_ref, l_ref, picked_ref, arg_ref,
 ):
-    """Inference sibling of ``_fwd_kernel``: same online softmax, plus a
-    running ARGMAX (the predictions-pass output) — so eval accuracy, loss,
-    and per-image predictions all come out of one pass that never
-    materializes [B, V]. Grid: (num_row_blocks, num_v_blocks) — the vocab
-    axis is the MINOR (fastest) grid dim, so for each row block the
-    m/l/picked/arg outputs alias one block across the sequential vocab
-    sweep as accumulators, then the grid advances to the next row block
-    (the B=4096+ row tiling; the single-block case is grid (1, n_v))."""
-    j = pl.program_id(1)
-    feats = feats_ref[...]  # [B, D] bf16
-    w = w_ref[...]  # [D, BV] bf16
-    logits = lax.dot_general(
-        feats, w, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) + b_ref[...].astype(jnp.float32)  # [B, BV] f32
+    """The shared per-vocab-block accumulator update of the predict
+    kernels: online softmax (m, l), running argmax, and the picked label
+    logit, finalized into (loss, pred) on the last block. ``logits`` is
+    this block's [B, BV] f32 tile; how it was produced is the kernel's
+    business — the bf16 MXU matmul in ``_predict_kernel`` below, or the
+    int8×int8→int32 dequantized matmul in ``ops/quantize.py``'s sibling.
+    One definition so the two kernels cannot drift on the subtle parts
+    (tie convention, padding-row zeroing, the f32 index trick)."""
     b_rows, bv = logits.shape
 
     @pl.when(j == 0)
@@ -309,12 +302,37 @@ def _predict_kernel(
     hit = cols == local
     picked_ref[...] += jnp.sum(jnp.where(hit, logits, 0.0), axis=1, keepdims=True)
 
-    @pl.when(j == pl.num_programs(1) - 1)
+    @pl.when(j == n_programs - 1)
     def _finish():
         valid = labels >= 0
         loss = jnp.log(l_ref[...]) + m_ref[...] - picked_ref[...]
         loss_ref[...] = jnp.where(valid, loss, 0.0)
         pred_ref[...] = arg_ref[...]
+
+
+def _predict_kernel(
+    labels_ref, feats_ref, w_ref, b_ref,
+    loss_ref, pred_ref, m_ref, l_ref, picked_ref, arg_ref,
+):
+    """Inference sibling of ``_fwd_kernel``: same online softmax, plus a
+    running ARGMAX (the predictions-pass output) — so eval accuracy, loss,
+    and per-image predictions all come out of one pass that never
+    materializes [B, V]. Grid: (num_row_blocks, num_v_blocks) — the vocab
+    axis is the MINOR (fastest) grid dim, so for each row block the
+    m/l/picked/arg outputs alias one block across the sequential vocab
+    sweep as accumulators, then the grid advances to the next row block
+    (the B=4096+ row tiling; the single-block case is grid (1, n_v))."""
+    j = pl.program_id(1)
+    feats = feats_ref[...]  # [B, D] bf16
+    w = w_ref[...]  # [D, BV] bf16
+    logits = lax.dot_general(
+        feats, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + b_ref[...].astype(jnp.float32)  # [B, BV] f32
+    online_predict_update(
+        j, pl.num_programs(1), logits, labels_ref,
+        loss_ref, pred_ref, m_ref, l_ref, picked_ref, arg_ref,
+    )
 
 
 # One warning per (process, reason): a TPU caller asking for the fused

@@ -32,26 +32,42 @@ class BucketExecutables:
     """Per-bucket AOT-compiled predict executables over a placed state.
 
     ``fused_head`` follows the evaluate driver's gate (TPU backend or the
-    ``MPT_HEAD_INTERPRET`` test path); the fused kernel streams argmax
-    only, so it forces ``topk=1`` with a logged warning — degraded k is
-    surfaced, never silent (the --fused-head-eval lesson, advisor r5).
+    ``MPT_HEAD_INTERPRET``/``MPT_QHEAD_INTERPRET`` test paths); the fused
+    kernels stream argmax only, so it forces ``topk=1`` with a logged
+    warning — degraded k is surfaced, never silent (the --fused-head-eval
+    lesson, advisor r5).
+
+    ``precision`` (ISSUE 11): ``"bf16"`` compiles the compute-dtype
+    predict step over ``state`` as-is; ``"int8"`` post-training-quantizes
+    the state first (``ops/quantize.quantize_state`` — per-channel int8
+    conv/dense weights, head activation scale calibrated on a seeded
+    sample batch) and compiles the quantized predict step (the fused int8
+    head kernel under the fused gate). Either way the executables are
+    AOT-compiled at startup and steady state never compiles; a server
+    holding BOTH sets switches between them as a pure executable-set
+    swap (``InferenceServer.set_precision``).
     """
 
-    def __init__(self, cfg, state, mesh, *, logger=None):
+    def __init__(self, cfg, state, mesh, *, logger=None, precision: str = "bf16"):
         import jax
         import jax.numpy as jnp
 
         from mpi_pytorch_tpu.evaluate import _make_predict_step
         from mpi_pytorch_tpu.obs import compile_count, ensure_compile_listener
-        from mpi_pytorch_tpu.utils.env import env_flag
-        from mpi_pytorch_tpu.utils.hardware import tpu_backend
 
+        if precision not in ("bf16", "int8"):
+            raise ValueError(
+                f"precision must be 'bf16' or 'int8', got {precision!r} "
+                "(a set compiles ONE precision; serve_precision='both' "
+                "builds two sets)"
+            )
+        from mpi_pytorch_tpu.ops.quantize import fused_head_gate
+
+        self.precision = precision
         self._mesh = mesh
         self.buckets = parse_buckets(cfg.parsed_serve_buckets())
         self.topk = int(cfg.serve_topk)
-        self.fused_head = bool(
-            cfg.fused_head_eval and (tpu_backend() or env_flag("MPT_HEAD_INTERPRET"))
-        )
+        self.fused_head = fused_head_gate(cfg)
         if self.fused_head and self.topk > 1:
             if logger is not None:
                 logger.warning(
@@ -62,9 +78,6 @@ class BucketExecutables:
         compute_dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
             cfg.compute_dtype
         ]
-        predict = _make_predict_step(
-            mesh, compute_dtype, fused_head=self.fused_head, topk=self.topk
-        )
 
         # The host batch dtype mirrors the loader contract (data/pipeline):
         # f32/bf16 batches arrive normalized; uint8 ships raw pixels and
@@ -75,6 +88,29 @@ class BucketExecutables:
             self.image_dtype = np.dtype(ml_dtypes.bfloat16)
         else:
             self.image_dtype = np.dtype(cfg.input_dtype)
+
+        if precision == "int8":
+            from mpi_pytorch_tpu.ops import quantize as qz
+
+            # The shared seeded calibration batch (quantize.calibration_
+            # batch — identical on every host and in the offline oracle,
+            # so a fleet's int8 sets and the --quantize-eval probe can
+            # never disagree on scales). Only the fused int8 kernel
+            # consumes the activation scale; the plain dequant path skips
+            # the calibration forward entirely.
+            act_scale = (
+                qz.calibrate_head_act_scale(
+                    state, qz.calibration_batch(cfg), compute_dtype
+                )
+                if self.fused_head else 1.0
+            )
+            state = qz.quantize_state(
+                state, keep_head_int8=self.fused_head, act_scale=act_scale
+            )
+        predict = _make_predict_step(
+            mesh, compute_dtype, fused_head=self.fused_head, topk=self.topk,
+            int8_head=(precision == "int8" and self.fused_head),
+        )
 
         self._state = state
         self._compiled = {}
@@ -151,3 +187,48 @@ class BucketExecutables:
 
     def compiles_since_warmup(self) -> int:
         return self._compile_count() - self._baseline
+
+    def rebaseline(self) -> None:
+        """Reset the steady-state-compile baseline to NOW. The compile
+        listener is process-global, so when a server warms SEVERAL
+        precision sets, a sibling set's warmup compiles would otherwise
+        count against this set's zero-steady-state assertion — the server
+        warms every set first, then rebaselines them all."""
+        self._baseline = self._compile_count()
+
+
+def measure_parity_top1(exe_ref, exe_q, *, samples: int = 32, seed: int = 0) -> float:
+    """Top-1 agreement between two warmed executable sets on a fixed
+    seeded sample, through the REAL serve path (place → bucket executable
+    → readback) — the startup parity stamp carried on precision-retune
+    records and int8 bench rows. Runs only already-compiled bucket shapes
+    (the zero-steady-state-compile assertion holds through it); cached on
+    ``exe_q`` so N fleet hosts sharing one set pair measure once."""
+    cached = getattr(exe_q, "_parity_top1_vs", None)
+    if cached is not None and cached[0] is exe_ref:
+        return cached[1]
+    import jax
+
+    bucket = exe_ref.buckets[-1]
+    h, w = exe_ref._image_hw
+    rng = np.random.default_rng(seed)
+    agree = total = 0
+    for _ in range(max(1, -(-samples // bucket))):
+        if exe_ref.image_dtype == np.uint8:
+            images = rng.integers(0, 256, size=(bucket, h, w, 3)).astype(np.uint8)
+        else:
+            # Float contract: rows arrive normalized — a unit-gaussian
+            # sample is in-distribution for the normalize output.
+            images = rng.normal(size=(bucket, h, w, 3)).astype(np.float32)
+        labels = np.full((bucket,), -1, np.int32)
+        p_ref = np.asarray(
+            jax.device_get(exe_ref(bucket, exe_ref.place(images, labels)))
+        ).reshape(bucket, -1)
+        p_q = np.asarray(
+            jax.device_get(exe_q(bucket, exe_q.place(images, labels)))
+        ).reshape(bucket, -1)
+        agree += int((p_ref[:, 0] == p_q[:, 0]).sum())
+        total += bucket
+    parity = round(agree / total, 4)
+    exe_q._parity_top1_vs = (exe_ref, parity)
+    return parity

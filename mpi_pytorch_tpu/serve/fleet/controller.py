@@ -10,16 +10,21 @@ Policy, per host per tick (AIMD-shaped — halve on breach, grow gently):
 
 - **p99 above target** → halve ``max_wait_ms`` (clamped to
   ``min_wait_ms``): the flush deadline is the additive queueing term of
-  request latency. Already at the floor → DEACTIVATE the largest active
-  bucket: a smaller largest bucket caps per-flush service time (the
-  multiplicative term). The full compiled set stays warm; only the flush
-  policy's target set shrinks.
+  request latency. Already at the floor → switch the host to its INT8
+  executable set if it holds one (ISSUE 11: halve the byte-bound head's
+  bytes before shedding capacity; the measured top-1 parity delta rides
+  the retune record) → then DEACTIVATE the largest active bucket: a
+  smaller largest bucket caps per-flush service time (the multiplicative
+  term). The full compiled set stays warm; only the flush policy's
+  target set shrinks.
 - **p99 under half the target** → restore the next compiled bucket if
   any were deactivated (the emergency is over; and a bucket-capped host
   reports artificially perfect fill, so restoration is NOT fill-gated);
-  once the full set is active, grow ``max_wait_ms`` 1.5× (clamped to
-  ``max_wait_ms_cap``) when fill sits below ``fill_low_pct`` — latency
-  headroom is being wasted on padded flushes.
+  then switch back to bf16 (headroom buys full fidelity back before
+  throughput tuning); once the full set is active at bf16, grow
+  ``max_wait_ms`` 1.5× (clamped to ``max_wait_ms_cap``) when fill sits
+  below ``fill_low_pct`` — latency headroom is being wasted on padded
+  flushes.
 
 Every retune only ever ACTIVATES pre-compiled executables
 (``server.set_active_buckets`` rejects anything else) and re-reads the
@@ -140,13 +145,25 @@ class FleetController:
 
         wait_from = host.max_wait_ms
         active_from = tuple(host.active_buckets)
-        wait_to, active_to = wait_from, active_from
+        # Precision axis (ISSUE 11): hosts holding BOTH startup-compiled
+        # precision sets expose it; single-set hosts (and the fake hosts
+        # of older tests) read as a one-point axis and are never switched.
+        prec_from = getattr(host, "precision", "bf16")
+        prec_avail = tuple(getattr(host, "precisions", ()) or (prec_from,))
+        wait_to, active_to, prec_to = wait_from, active_from, prec_from
         if p99 > self.target_p99_ms:
             wait_to = wait_from / 2.0
             if wait_to < max(self._min_wait_ms, 0.25):
                 wait_to = self._min_wait_ms  # snap to the floor, don't asymptote
-            if wait_to == wait_from and len(active_from) > 1:
-                active_to = active_from[:-1]  # cap per-flush service time
+            if wait_to == wait_from:
+                # Wait already at the floor: the escalation ladder is
+                # int8 BEFORE bucket shedding — halving the head's bytes
+                # raises capacity without capping flush size, and the
+                # switch only ever activates a startup-compiled set.
+                if prec_from != "int8" and "int8" in prec_avail:
+                    prec_to = "int8"
+                elif len(active_from) > 1:
+                    active_to = active_from[:-1]  # cap per-flush service time
         elif p99 < 0.5 * self.target_p99_ms:
             compiled = tuple(host.buckets)
             if active_from != compiled:
@@ -155,11 +172,19 @@ class FleetController:
                 # bucket-capped host reports artificially perfect fill,
                 # so this branch must not be gated on the fill signal.
                 active_to = compiled[: len(active_from) + 1]
+            elif prec_from == "int8" and "bf16" in prec_avail:
+                # Unwind in reverse escalation order: precision back to
+                # full-fidelity bf16 before growing the wait — headroom
+                # buys accuracy back first, throughput tuning second.
+                prec_to = "bf16"
             elif fill is not None and fill < self._fill_low_pct:
                 wait_to = min(
                     self._max_wait_ms_cap, max(wait_from * 1.5, 1.0)
                 )
-        if wait_to == wait_from and active_to == active_from:
+        if (
+            wait_to == wait_from and active_to == active_from
+            and prec_to == prec_from
+        ):
             return False
 
         if wait_to != wait_from:
@@ -168,6 +193,8 @@ class FleetController:
             # Only ever a subset of the compiled set — set_active_buckets
             # raises on anything that would need a fresh executable.
             host.set_active_buckets(active_to)
+        if prec_to != prec_from:
+            host.set_precision(prec_to)
         compiles = host.compiles_after_warmup()
         if compiles != 0:
             # The invariant this subsystem is built on broke — say so
@@ -180,13 +207,14 @@ class FleetController:
         self.retunes += 1
         self._logger.info(
             "fleet controller: retuned %s — max_wait %.2f→%.2f ms, "
-            "buckets %s→%s (p99 %.1f ms vs target %.1f, fill %s)",
+            "buckets %s→%s, precision %s→%s (p99 %.1f ms vs target %.1f, "
+            "fill %s)",
             host.name, wait_from, wait_to, list(active_from),
-            list(active_to), p99, self.target_p99_ms,
+            list(active_to), prec_from, prec_to, p99, self.target_p99_ms,
             "-" if fill is None else f"{fill:.0f}%",
         )
         if self._metrics is not None:
-            self._metrics.write({
+            record = {
                 "kind": "fleet",
                 "event": "retune",
                 "host": host.name,
@@ -197,5 +225,16 @@ class FleetController:
                 "p99_ms": round(p99, 3),
                 "target_p99_ms": self.target_p99_ms,
                 "compiles_after_warmup": compiles,
-            })
+            }
+            if prec_to != prec_from:
+                # Schema-v7: a precision switch carries the measured
+                # top-1 parity delta between the two sets — the accuracy
+                # cost of the capacity the retune just bought (or gave
+                # back), on the record a human audits later.
+                record["precision_from"] = prec_from
+                record["precision_to"] = prec_to
+                parity = getattr(host, "parity_top1", None)
+                if parity is not None:
+                    record["parity_top1"] = parity
+            self._metrics.write(record)
         return True

@@ -149,8 +149,26 @@ class LocalHost:
     def set_active_buckets(self, buckets) -> None:
         self.server.set_active_buckets(buckets)
 
+    # -- precision axis (ISSUE 11) ------------------------------------
+    @property
+    def precision(self) -> str:
+        return self.server.precision
+
+    @property
+    def precisions(self) -> tuple[str, ...]:
+        return self.server.precisions
+
+    def set_precision(self, precision: str) -> None:
+        self.server.set_precision(precision)
+
+    @property
+    def parity_top1(self):
+        """int8-vs-bf16 startup top-1 agreement (None when the host holds
+        a single precision set) — stamped on precision retune records."""
+        return self.server.parity_top1
+
     def compiles_after_warmup(self) -> int:
-        return self.server._exe.compiles_since_warmup()
+        return self.server.compiles_after_warmup()
 
     def stats(self) -> dict:
         return self.server.stats()

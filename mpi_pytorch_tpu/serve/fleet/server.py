@@ -10,11 +10,13 @@ deployment each host is its own PROCESS over its own chips
 ``serve/http.py``) — the router and controller never know the
 difference, that is the point of the handle.
 
-Cost model: all hosts share ONE ``BucketExecutables`` (and the placed
-params behind it — predict is read-only), so an N-host local fleet pays
-one warmup compile set, not N. Per-host state is the part that matters
-for routing: each host has its own bounded queue, batcher, preprocess
-pool, and metrics registry.
+Cost model: all hosts share ONE ``BucketExecutables`` per precision (and
+the placed params behind it — predict is read-only), so an N-host local
+fleet pays one warmup compile set per precision, not N
+(``serve_precision="both"`` shares a bf16 AND an int8 set, arming the
+controller's precision retune axis). Per-host state is the part that
+matters for routing: each host has its own bounded queue, batcher,
+preprocess pool, and metrics registry.
 
 All hosts, the router, and the controller write into one shared metrics
 stream (``cfg.metrics_file``): ``kind="serve"`` flushes tagged per host
@@ -88,10 +90,19 @@ class FleetServer:
             from mpi_pytorch_tpu.train.step import place_state_on_mesh
 
             state = place_state_on_mesh(state, mesh)
-            executables = BucketExecutables(
-                cfg, state, mesh, logger=self._logger
-            )
-            executables.warmup()
+            # One executable set PER PRECISION, shared by every host —
+            # serve_precision="both" is what arms the controller's
+            # precision retune axis (each host switches between the two
+            # shared, startup-warmed sets).
+            precisions = cfg.parsed_serve_precisions()
+            executables = {
+                p: BucketExecutables(
+                    cfg, state, mesh, logger=self._logger, precision=p
+                )
+                for p in precisions
+            }
+            for exe in executables.values():
+                exe.warmup()
         self._exe = executables
 
         self._metrics = MetricsWriter(cfg.metrics_file)
@@ -168,6 +179,31 @@ class FleetServer:
         spare = self.router.spare_host()
         if spare is not None:
             spare.set_max_wait_ms(max_wait_ms)
+
+    @property
+    def precision(self) -> str:
+        """The active precision of the fleet's hosts (bench sweep surface;
+        individual hosts may diverge under a mid-traffic controller
+        retune — this reads the first live host)."""
+        hosts = self.router.active_hosts()
+        return hosts[0].precision if hosts else "bf16"
+
+    @property
+    def parity_top1(self):
+        """The shared sets' int8-vs-bf16 startup parity (None when the
+        fleet holds a single precision)."""
+        hosts = self.router.active_hosts()
+        return hosts[0].parity_top1 if hosts else None
+
+    def set_precision(self, precision: str) -> None:
+        """Switch every live host (and the spare) onto the named
+        startup-compiled precision set — the bench sweep lever; the
+        controller does this per host with its own policy."""
+        for h in self.router.active_hosts():
+            h.set_precision(precision)
+        spare = self.router.spare_host()
+        if spare is not None:
+            spare.set_precision(precision)
 
     def host_snapshots(self) -> dict:
         """name → live registry snapshot, for every host still serving —

@@ -78,6 +78,9 @@ class _InFlight:
     t_dispatch: float
     t_oldest: float
     prep_failures: int = 0  # requests of this flush dropped at preprocess
+    # Which executable set served this flush — a precision retune lands
+    # between flushes, so the record must carry the set that actually ran.
+    precision: str = "bf16"
 
 
 class InferenceServer:
@@ -123,11 +126,20 @@ class InferenceServer:
         self.host_index = host_index
         self.name = "serve" if host_index is None else f"h{host_index}"
         if executables is not None:
-            # A pre-built (shared) executable set: the fleet harness
-            # compiles ONE BucketExecutables and hands it to every host,
-            # so an N-host local fleet costs one warmup compile set, not
-            # N. State/mesh building is the executable owner's job.
-            self.mesh = mesh if mesh is not None else executables._mesh
+            # Pre-built (shared) executable set(s): the fleet harness
+            # compiles ONE BucketExecutables per precision and hands them
+            # to every host, so an N-host local fleet costs one warmup
+            # compile set (per precision), not N. State/mesh building is
+            # the executable owner's job. A bare BucketExecutables is
+            # accepted for the single-precision callers (tests, bench).
+            if not isinstance(executables, dict):
+                executables = {
+                    getattr(executables, "precision", "bf16"): executables
+                }
+            self.mesh = (
+                mesh if mesh is not None
+                else next(iter(executables.values()))._mesh
+            )
         else:
             if mesh is None:
                 if jax.process_count() > 1:
@@ -215,16 +227,50 @@ class InferenceServer:
         # (the trainer's failure-path discipline).
         try:
             if executables is not None:
-                self._exe = executables
-                if not self._exe.warm:
-                    self._exe.warmup()
+                self._exe_sets = dict(executables)
             else:
-                self._exe = BucketExecutables(
-                    cfg, state, self.mesh, logger=self._logger
-                )
-                self._exe.warmup()  # zero steady-state compiles from here on
+                # serve_precision selects the startup-compiled set(s):
+                # "both" compiles bf16 AND int8 so the fleet controller
+                # can treat precision as a retune axis — a switch is an
+                # executable-set swap, never a compile.
+                precisions = cfg.parsed_serve_precisions()
+                self._exe_sets = {
+                    p: BucketExecutables(
+                        cfg, state, self.mesh, logger=self._logger,
+                        precision=p,
+                    )
+                    for p in precisions
+                }
+            # Warm EVERY set before rebaselining ANY: the compile listener
+            # is process-global, so set B's warmup compiles would land on
+            # set A's counter otherwise.
+            for exe in self._exe_sets.values():
+                if not exe.warm:
+                    exe.warmup()
+            for exe in self._exe_sets.values():
+                exe.rebaseline()  # zero steady-state compiles from here on
+            self.precision = "bf16" if "bf16" in self._exe_sets else next(
+                iter(self._exe_sets)
+            )
+            self._exe = self._exe_sets[self.precision]
             self.buckets = self._exe.buckets
             self.topk = self._exe.topk
+            # Startup parity stamp (measured, not assumed): top-1
+            # agreement between the two sets on a fixed seeded sample —
+            # the delta the controller stamps on precision retunes.
+            self.parity_top1 = None
+            if "bf16" in self._exe_sets and "int8" in self._exe_sets:
+                from mpi_pytorch_tpu.serve.executables import measure_parity_top1
+
+                self.parity_top1 = measure_parity_top1(
+                    self._exe_sets["bf16"], self._exe_sets["int8"],
+                    samples=cfg.quantize_calib, seed=cfg.seed,
+                )
+                self._logger.info(
+                    "serve: int8-vs-bf16 startup parity: top-1 agreement "
+                    "%.4f over %d samples", self.parity_top1,
+                    cfg.quantize_calib,
+                )
 
             self._batcher = DynamicBatcher(
                 self.buckets, cfg.serve_max_wait_ms / 1e3, cfg.serve_queue_depth
@@ -272,11 +318,12 @@ class InferenceServer:
             self._shutdown_sinks()
             raise
         self._logger.info(
-            "serve: %d bucket executable(s) %s warm (topk=%d, fused_head=%s, "
-            "max_wait=%.1f ms, queue=%d) — steady state compiles: 0 by "
-            "construction",
-            len(self.buckets), list(self.buckets), self.topk,
-            self._exe.fused_head, cfg.serve_max_wait_ms, cfg.serve_queue_depth,
+            "serve: %d bucket executable(s) %s warm per precision set %s "
+            "(active %s, topk=%d, fused_head=%s, max_wait=%.1f ms, "
+            "queue=%d) — steady state compiles: 0 by construction",
+            len(self.buckets), list(self.buckets), list(self.precisions),
+            self.precision, self.topk, self._exe.fused_head,
+            cfg.serve_max_wait_ms, cfg.serve_queue_depth,
         )
 
     # ------------------------------------------------------------------ build
@@ -530,6 +577,11 @@ class InferenceServer:
                     continue
                 t_prep = time.monotonic()
                 self._maybe_fault_delay()
+                # One coherent executable set per flush: a precision
+                # retune between reads must not split place/dispatch
+                # across sets (both are warm, but AOT shardings are
+                # per-set state).
+                exe = self._exe
                 bucket = pick_bucket(len(good), self._batcher.active_buckets)
                 labels = np.full((len(good),), -1, np.int32)
                 images, labels = pad_batch(np.stack(rows), labels, bucket)
@@ -537,7 +589,7 @@ class InferenceServer:
                 if self._tracer.enabled:
                     dispatch_args["req_ids"] = [r.req_id for r in good]
                 with self._tracer.span("serve/dispatch", args=dispatch_args):
-                    preds = self._exe(bucket, self._exe.place(images, labels))
+                    preds = exe(bucket, exe.place(images, labels))
                 self._inflight.put(
                     _InFlight(
                         requests=good,
@@ -550,6 +602,7 @@ class InferenceServer:
                         t_dispatch=time.monotonic(),
                         t_oldest=min(r.t_submit for r in good),
                         prep_failures=prep_failures,
+                        precision=exe.precision,
                     )
                 )
             except BaseException as e:  # noqa: BLE001 — keep serving
@@ -615,6 +668,11 @@ class InferenceServer:
                     record["preprocess_failures"] = item.prep_failures
                     with self._lock:
                         record["worker_respawns"] = self._stats["worker_respawns"]
+                if len(self._exe_sets) > 1 or item.precision != "bf16":
+                    # Schema-v7: stamp the serving precision whenever it
+                    # is a live axis (multi-set or non-default) — pure-bf16
+                    # servers keep their records byte-identical to v6.
+                    record["precision"] = item.precision
                 self._metrics.write(record)
                 # Live registry: per-flush aggregates (the /metrics p99 the
                 # acceptance test matches against this record stream) plus
@@ -628,7 +686,7 @@ class InferenceServer:
                 for req in item.requests:
                     self._m_req_ms.observe(1e3 * (t_done - req.t_submit))
                 self._g_qdepth.set(record["queue_depth"])
-                self._g_compiles.set(self._exe.compiles_since_warmup())
+                self._g_compiles.set(self.compiles_after_warmup())
                 self._maybe_evaluate_slo(force=True)
                 # Futures resolve LAST: by the time a caller observes its
                 # result, the flush is already visible in the record
@@ -660,6 +718,43 @@ class InferenceServer:
         self._batcher.max_wait_s = float(max_wait_ms) / 1e3
 
     @property
+    def precisions(self) -> tuple[str, ...]:
+        """The startup-compiled precision sets this server can switch
+        between (the controller's precision axis reads this)."""
+        return tuple(sorted(self._exe_sets))
+
+    def set_precision(self, precision: str) -> None:
+        """Switch the ACTIVE executable set — the fleet controller's
+        precision lever (bf16 under SLO headroom, int8 under p99
+        pressure). Only ever selects a startup-compiled-and-warmed set
+        (the ``set_active_buckets`` discipline generalized): anything
+        else is a typed error, because it would be the mid-request
+        compile this subsystem exists to make impossible."""
+        with self._lock:
+            exe = self._exe_sets.get(precision)
+            if exe is None:
+                raise ServeError(
+                    f"precision {precision!r} was never compiled at "
+                    f"startup (compiled sets: {sorted(self._exe_sets)}); "
+                    "build with serve_precision='both' to switch live"
+                )
+            if precision == self.precision:
+                return
+            self._exe = exe
+            self.precision = precision
+        self._logger.info(
+            "serve[%s]: precision switched to %s (startup-compiled set; "
+            "no compile)", self.name, precision,
+        )
+
+    def compiles_after_warmup(self) -> int:
+        """Steady-state compiles summed over EVERY precision set — a
+        compile on the inactive set is just as much a broken invariant."""
+        return sum(
+            e.compiles_since_warmup() for e in self._exe_sets.values()
+        )
+
+    @property
     def max_wait_ms(self) -> float:
         return self._batcher.max_wait_s * 1e3
 
@@ -684,9 +779,12 @@ class InferenceServer:
         with self._lock:
             out = dict(self._stats, by_bucket=dict(self._stats["by_bucket"]))
         out["queue_depth"] = self._batcher.qsize()
-        out["compiles_after_warmup"] = self._exe.compiles_since_warmup()
+        out["compiles_after_warmup"] = self.compiles_after_warmup()
         out["topk"] = self.topk
         out["buckets"] = list(self.buckets)
+        out["precision"] = self.precision
+        if self.parity_top1 is not None:
+            out["parity_top1"] = self.parity_top1
         return out
 
     def registry_snapshot(self) -> dict:
@@ -697,7 +795,7 @@ class InferenceServer:
         router scores hosts off exactly this snapshot — a busy host whose
         completion loop is behind must not look idle."""
         self._g_qdepth.set(self._batcher.qsize())
-        self._g_compiles.set(self._exe.compiles_since_warmup())
+        self._g_compiles.set(self.compiles_after_warmup())
         return self._registry.snapshot()
 
     @property
@@ -747,6 +845,7 @@ class InferenceServer:
             "served": stats["served"],
             "rejected": stats["rejected"],
             "buckets": stats["buckets"],
+            "precision": stats["precision"],
         }
 
     def _shutdown_sinks(self) -> None:

@@ -1,0 +1,547 @@
+"""Tests for the int8 quantized serving path (ISSUE 11).
+
+The acceptance surface: per-channel quant/dequant round-trip error bounds,
+the Pallas int8 head-predict kernel ≡ the exact-integer XLA reference in
+interpret mode (argmax bitwise, loss to tolerance) including the
+bucket-row-sharding path on the 8-device CPU mesh, quantized-state predict
+parity through a real zoo model, executable-set switching with
+``compiles_after_warmup == 0`` and precision-stamped serve records, the
+controller's precision retune axis (escalate to int8 before bucket
+shedding, restore bf16 on headroom, parity delta on the record), config
+validation of the new knobs, the ``--quantize-eval`` offline oracle,
+schema-v7 record shapes, and precision keyed into the serve regression
+trend lines.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ quant math
+
+
+def test_per_channel_roundtrip_error_bounds():
+    from mpi_pytorch_tpu.ops.quantize import dequantize, quantize_per_channel
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(64, 500)) * 0.05, jnp.float32)
+    q, scale = quantize_per_channel(w)
+    assert q.dtype == jnp.int8 and scale.shape == (500,)
+    err = np.abs(np.asarray(dequantize(q, scale)) - np.asarray(w))
+    # Round-to-nearest: per-element error bounded by half a step of that
+    # channel's scale.
+    bound = np.asarray(scale)[None, :] / 2 + 1e-7
+    assert (err <= bound).all(), float((err - bound).max())
+    # The channel max hits ±127 exactly (symmetric, full range used).
+    assert int(np.abs(np.asarray(q)).max()) == 127
+
+    # Conv kernels quantize over the trailing (output-channel) axis too.
+    wc = jnp.asarray(rng.normal(size=(3, 3, 8, 16)), jnp.float32)
+    qc, sc = quantize_per_channel(wc)
+    assert qc.shape == wc.shape and sc.shape == (16,)
+
+    # All-zero channels stay exact zeros (no divide-by-zero).
+    wz = jnp.zeros((4, 3), jnp.float32)
+    qz, sz = quantize_per_channel(wz)
+    assert not np.asarray(qz).any() and np.isfinite(np.asarray(sz)).all()
+
+
+def test_quantize_params_tree_selects_kernels_only():
+    from mpi_pytorch_tpu.ops.quantize import head_kernel_key, quantize_params
+
+    params = {
+        "conv": {"kernel": jnp.ones((3, 3, 4, 8)), "bias": jnp.ones((8,))},
+        "bn": {"scale": jnp.ones((8,)), "bias": jnp.zeros((8,))},
+        "head": {"kernel": jnp.ones((8, 16)), "bias": jnp.zeros((16,))},
+    }
+    qtree, scales = quantize_params(params)
+    assert qtree["conv"]["kernel"].dtype == jnp.int8
+    assert qtree["head"]["kernel"].dtype == jnp.int8
+    assert qtree["conv"]["bias"].dtype == jnp.float32  # untouched
+    assert qtree["bn"]["scale"].dtype == jnp.float32
+    assert set(scales) == {"conv/kernel", "head/kernel"}
+    assert head_kernel_key(scales, qtree) == "head/kernel"
+    # A conv-shaped 'head' kernel (squeezenet) is NOT a fused-int8 head.
+    conv_head = {"head": {"kernel": jnp.ones((1, 1, 8, 16))}}
+    qt2, sc2 = quantize_params(conv_head)
+    assert head_kernel_key(sc2, qt2) is None
+
+
+# ------------------------------------------------- int8 kernel vs reference
+
+
+def _head_inputs(rows=16, d=64, v=5000, seed=0):
+    from mpi_pytorch_tpu.ops.quantize import quantize_per_channel
+
+    rng = np.random.default_rng(seed)
+    feats = jnp.asarray(rng.normal(size=(rows, d)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(d, v)) * 0.05, jnp.float32)
+    b = jnp.asarray(rng.normal(size=(v,)) * 0.1, jnp.float32)
+    labels = np.asarray(rng.integers(0, v, size=(rows,)), np.int32)
+    labels[3] = -1  # padding row
+    w_q, w_scale = quantize_per_channel(w)
+    act_scale = float(jnp.max(jnp.abs(feats))) / 127.0
+    return feats, w_q, b, jnp.asarray(labels), w_scale, act_scale
+
+
+def test_int8_head_kernel_matches_reference_interpret():
+    """The Pallas int8 kernel (interpret mode) against the exact-integer
+    XLA reference: argmax predictions BITWISE equal (shared int32 matmul
+    + dequant expression), loss to online-softmax tolerance, padding rows
+    zeroed. V=5000 exercises the -inf/unit-scale block padding."""
+    from mpi_pytorch_tpu.ops.quantize import (
+        head_predict_int8,
+        head_predict_int8_reference,
+    )
+
+    feats, w_q, b, labels, w_scale, act_scale = _head_inputs()
+    loss_k, pred_k = head_predict_int8(
+        feats, w_q, b, labels, w_scale, act_scale, interpret=True
+    )
+    loss_r, pred_r = head_predict_int8_reference(
+        feats, w_q, b, labels, w_scale, act_scale
+    )
+    np.testing.assert_array_equal(np.asarray(pred_k), np.asarray(pred_r))
+    np.testing.assert_allclose(
+        np.asarray(loss_k), np.asarray(loss_r), rtol=1e-4, atol=1e-4
+    )
+    assert pred_k.dtype == jnp.int32
+    assert float(loss_k[3]) == 0.0  # padding row
+
+
+def test_int8_head_kernel_row_sharded_8dev_mesh():
+    """``dp_mesh`` partitions the kernel over the 8-device data axis (the
+    bucket-row-sharding path serve buckets divisible by the mesh take):
+    per-row results equal the unsharded reference exactly."""
+    from jax.sharding import Mesh
+
+    from mpi_pytorch_tpu.ops.quantize import (
+        head_predict_int8,
+        head_predict_int8_reference,
+    )
+
+    n = len(jax.devices())
+    assert n == 8  # conftest virtual-CPU mesh
+    mesh = Mesh(np.array(jax.devices()).reshape(n, 1), ("data", "model"))
+    feats, w_q, b, labels, w_scale, act_scale = _head_inputs(rows=32, seed=4)
+    loss_s, pred_s = head_predict_int8(
+        feats, w_q, b, labels, w_scale, act_scale, interpret=True,
+        dp_mesh=mesh,
+    )
+    loss_r, pred_r = head_predict_int8_reference(
+        feats, w_q, b, labels, w_scale, act_scale
+    )
+    np.testing.assert_array_equal(np.asarray(pred_s), np.asarray(pred_r))
+    np.testing.assert_allclose(
+        np.asarray(loss_s), np.asarray(loss_r), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_int8_head_activation_saturation_is_clipped():
+    """Out-of-calibration activations saturate at ±127 (never wrap): an
+    act_scale calibrated on small values keeps the kernel ≡ reference
+    (both share the clip), just with saturation error."""
+    from mpi_pytorch_tpu.ops.quantize import (
+        head_predict_int8,
+        head_predict_int8_reference,
+        quantize_activations,
+    )
+
+    feats, w_q, b, labels, w_scale, _ = _head_inputs(seed=5)
+    tiny_scale = 1e-3  # everything saturates
+    q = np.asarray(quantize_activations(feats, tiny_scale))
+    assert q.max() == 127 and q.min() == -127
+    _, pred_k = head_predict_int8(
+        feats, w_q, b, labels, w_scale, tiny_scale, interpret=True
+    )
+    _, pred_r = head_predict_int8_reference(
+        feats, w_q, b, labels, w_scale, tiny_scale
+    )
+    np.testing.assert_array_equal(np.asarray(pred_k), np.asarray(pred_r))
+
+
+# ------------------------------------------- quantized state / predict step
+
+
+def test_quantized_state_predict_parity_real_model(monkeypatch):
+    """quantize_state through a real zoo model: the PLAIN predict step
+    runs the quantized state unchanged (dequant-at-apply), and the fused
+    int8 step (real kernel, interpret mode) agrees with the bf16 fused
+    step on top-1 — the parity_probe oracle's own numbers."""
+    import optax
+    from jax.sharding import Mesh
+
+    from mpi_pytorch_tpu.evaluate import _make_predict_step_impl
+    from mpi_pytorch_tpu.models import create_model_bundle
+    from mpi_pytorch_tpu.ops import quantize as qz
+    from mpi_pytorch_tpu.train.state import TrainState
+
+    bundle, variables = create_model_bundle(
+        "resnet18", 64, rng=jax.random.PRNGKey(0), image_size=32
+    )
+    state = TrainState.create(
+        apply_fn=bundle.model.apply, variables=variables,
+        tx=optax.identity(), rng=jax.random.PRNGKey(1),
+    )
+    mesh = Mesh(np.array(jax.devices()).reshape(-1, 1), ("data", "model"))
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, size=(16, 32, 32, 3)).astype(np.uint8)
+
+    act_scale = qz.calibrate_head_act_scale(state, images, jnp.float32)
+    assert act_scale > 0
+    q_plain = qz.quantize_state(state, keep_head_int8=False, act_scale=act_scale)
+    drift = qz.max_logit_drift(state, q_plain, images, jnp.float32)
+    assert 0 < drift < 1.0, drift  # small vs O(1) logit margins
+
+    probe = qz.parity_probe(
+        state, q_plain, mesh, jnp.float32, images, topk=5, fused_head=False
+    )
+    assert probe["samples"] == 16
+    assert probe["top1_agree"] >= 0.8
+    assert probe["top5_agree"] >= 0.9
+
+    monkeypatch.setenv("MPT_HEAD_INTERPRET", "1")
+    _make_predict_step_impl.cache_clear()
+    try:
+        q_fused = qz.quantize_state(
+            state, keep_head_int8=True, act_scale=act_scale
+        )
+        # The head kernel really is kept int8 in the packed tree.
+        hk = qz.head_kernel_key(q_fused.params["scale"], q_fused.params["q"])
+        leaf = q_fused.params["q"]
+        for s in hk.split("/"):
+            leaf = leaf[s]
+        assert leaf.dtype == jnp.int8
+        probe_f = qz.parity_probe(
+            state, q_fused, mesh, jnp.float32, images, topk=1, fused_head=True
+        )
+        assert probe_f["top1_agree"] >= 0.8
+        assert probe_f["top5_agree"] is None  # argmax-only contract
+    finally:
+        monkeypatch.delenv("MPT_HEAD_INTERPRET")
+        _make_predict_step_impl.cache_clear()
+
+
+def test_int8_head_requires_fused():
+    from mpi_pytorch_tpu.evaluate import _make_predict_step
+
+    with pytest.raises(ValueError, match="int8_head"):
+        _make_predict_step(None, jnp.float32, fused_head=False, int8_head=True)
+
+
+# --------------------------------------------------- serve executable sets
+
+
+@pytest.fixture(scope="module")
+def qcfg():
+    from mpi_pytorch_tpu.config import Config
+
+    cfg = Config(
+        model_name="resnet18", num_classes=64, width=32, height=32,
+        synthetic_data=True, compute_dtype="float32",
+        serve_buckets="1,8", serve_max_wait_ms=2.0, serve_topk=3,
+        serve_queue_depth=64, loader_workers=4,
+        serve_precision="both", quantize_calib=16,
+        metrics_file="", log_file="", eval_log_file="",
+    )
+    cfg.validate_config()
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def shared_sets(qcfg):
+    """ONE warmed pair of precision sets for the whole module — servers
+    below share them, so tests pay the warmup compiles once. Bucket 8
+    divides the 8-device mesh → the int8 set's row-sharded predict path
+    is compiled and exercised."""
+    from jax.sharding import Mesh
+
+    from mpi_pytorch_tpu.evaluate import build_inference
+    from mpi_pytorch_tpu.serve.executables import BucketExecutables
+    from mpi_pytorch_tpu.train.step import place_state_on_mesh
+
+    mesh = Mesh(np.array(jax.devices()).reshape(-1, 1), ("data", "model"))
+    _, _, state, _ = build_inference(qcfg, mesh=mesh, manifests=(None, None))
+    state = place_state_on_mesh(state, mesh)
+    sets = {
+        p: BucketExecutables(qcfg, state, mesh, precision=p)
+        for p in ("bf16", "int8")
+    }
+    for exe in sets.values():
+        exe.warmup()
+    return sets
+
+
+def test_executable_set_switching_zero_compiles(qcfg, shared_sets, tmp_path):
+    """The tentpole serve invariant: a precision switch is an executable-
+    set swap — zero compiles across BOTH sets through traffic on each,
+    precision stamped on the flush records, unknown precisions are a
+    typed error."""
+    import dataclasses
+
+    from mpi_pytorch_tpu.obs.schema import load_records, validate_jsonl
+    from mpi_pytorch_tpu.serve import InferenceServer, ServeError
+
+    cfg = dataclasses.replace(
+        qcfg, metrics_file=str(tmp_path / "m.jsonl")
+    )
+    cfg.validate_config()
+    server = InferenceServer(cfg, executables=shared_sets)
+    rng = np.random.default_rng(0)
+    images = [
+        rng.integers(0, 256, size=(32, 32, 3)).astype(np.uint8)
+        for _ in range(12)
+    ]
+    try:
+        assert server.precision == "bf16"
+        assert server.precisions == ("bf16", "int8")
+        assert server.parity_top1 is not None and 0 <= server.parity_top1 <= 1
+        p_b = server.predict_batch(images, timeout=120)
+        server.set_precision("int8")
+        assert server.precision == "int8"
+        p_i = server.predict_batch(images, timeout=120)
+        assert p_b.shape == p_i.shape == (12, 3)  # one response contract
+        agree = float((p_b[:, 0] == p_i[:, 0]).mean())
+        assert agree >= 0.8, agree
+        server.set_precision("int8")  # idempotent no-op
+        server.set_precision("bf16")  # and back — still no compiles
+        server.predict_batch(images[:3], timeout=120)
+        stats = server.stats()
+        assert stats["compiles_after_warmup"] == 0
+        assert stats["precision"] == "bf16"
+        with pytest.raises(ServeError, match="never compiled"):
+            server.set_precision("fp4")
+        assert server._healthz()["precision"] == "bf16"
+    finally:
+        server.close()
+    path = str(tmp_path / "m.jsonl")
+    assert validate_jsonl(path) == []
+    serves = [r for r in load_records(path) if r["kind"] == "serve"]
+    assert {r.get("precision") for r in serves} >= {"bf16", "int8"}
+
+
+def test_controller_precision_retune_axis(qcfg, shared_sets, tmp_path):
+    """The precision ladder: with the wait already at the floor a p99
+    breach switches bf16 → int8 BEFORE shedding buckets (parity delta on
+    the record); on recovered headroom the controller restores bf16
+    before growing the wait. Single-precision hosts are never switched
+    (the older controller tests pin that half)."""
+    import dataclasses
+
+    from mpi_pytorch_tpu.obs.schema import load_records, validate_jsonl
+    from mpi_pytorch_tpu.serve import InferenceServer
+    from mpi_pytorch_tpu.serve.fleet import FleetController, LocalHost
+    from mpi_pytorch_tpu.utils.logging import MetricsWriter
+
+    cfg = dataclasses.replace(qcfg)
+    cfg.validate_config()
+    server = InferenceServer(cfg, executables=shared_sets, host_index=0)
+    host = LocalHost(server)
+    writer = MetricsWriter(str(tmp_path / "ctl.jsonl"))
+    ctl = FleetController(
+        lambda: [host], target_p99_ms=0.001, metrics=writer,
+    )
+    images = [
+        np.random.default_rng(7).integers(0, 256, size=(32, 32, 3))
+        .astype(np.uint8)
+        for _ in range(6)
+    ]
+    try:
+        host.set_max_wait_ms(0.0)  # already at the floor
+        assert host.precision == "bf16"
+        server.predict_batch(images, timeout=120)
+        assert ctl.tick() == 1
+        # Precision escalated; the bucket set was NOT shed.
+        assert host.precision == "int8"
+        assert host.active_buckets == (1, 8)
+        # Next breach (still int8): NOW the largest bucket sheds.
+        server.predict_batch(images, timeout=120)
+        assert ctl.tick() == 1
+        assert host.active_buckets == (1,)
+        assert host.compiles_after_warmup() == 0
+        # Recovery: huge target → bucket restored first, then bf16, then
+        # the wait grows — reverse escalation order.
+        ctl.target_p99_ms = 1e9
+        ctl._fill_low_pct = 200.0
+        server.predict_batch(images, timeout=120)
+        assert ctl.tick() == 1
+        assert host.active_buckets == (1, 8)
+        server.predict_batch(images, timeout=120)
+        assert ctl.tick() == 1
+        assert host.precision == "bf16"
+        server.predict_batch(images, timeout=120)
+        assert ctl.tick() == 1
+        assert host.max_wait_ms > 0.0
+        assert host.compiles_after_warmup() == 0
+    finally:
+        server.close()
+        writer.close()
+    path = str(tmp_path / "ctl.jsonl")
+    assert validate_jsonl(path) == []
+    retunes = [
+        r for r in load_records(path)
+        if r["kind"] == "fleet" and r["event"] == "retune"
+    ]
+    to_int8 = [r for r in retunes if r.get("precision_to") == "int8"]
+    assert to_int8 and to_int8[0]["precision_from"] == "bf16"
+    assert to_int8[0]["parity_top1"] == server.parity_top1
+    assert all(r["compiles_after_warmup"] == 0 for r in retunes)
+    assert any(r.get("precision_to") == "bf16" for r in retunes)
+    # Non-precision retunes carry NO precision fields (v6-shaped).
+    plain = [r for r in retunes if "precision_to" not in r]
+    assert all("parity_top1" not in r for r in plain)
+
+
+# ----------------------------------------------------- config / schema / tools
+
+
+def test_config_validation_precision_knobs():
+    from mpi_pytorch_tpu.config import Config
+
+    Config(serve_precision="int8").validate_config()
+    Config(serve_precision="both").validate_config()
+    Config(
+        serve_precision="both", fused_head_eval=True, serve_topk=1
+    ).validate_config()
+    with pytest.raises(ValueError, match="serve_precision"):
+        Config(serve_precision="fp8").validate_config()
+    # The --fused-head-eval mismatch: fused int8 streams argmax only and
+    # a switchable server must keep one response shape — rejected, not
+    # silently downgraded like the bf16-only path.
+    with pytest.raises(ValueError, match="argmax only"):
+        Config(
+            serve_precision="int8", fused_head_eval=True, serve_topk=5
+        ).validate_config()
+    with pytest.raises(ValueError, match="quantize_calib"):
+        Config(quantize_calib=0).validate_config()
+
+
+def test_quant_record_schema_v7():
+    from mpi_pytorch_tpu.obs.schema import SCHEMA_VERSION, validate_record
+
+    assert SCHEMA_VERSION >= 7
+    serve = {
+        "kind": "serve", "ts": 1.0, "bucket": 8, "requests": 5,
+        "queue_depth": 0, "fill_ratio": 0.6, "queue_wait_ms": 1.0,
+        "device_ms": 2.0, "precision": "int8",
+    }
+    assert validate_record(serve) == []
+    bench = {
+        "kind": "serve_bench", "ts": 1.0, "mode": "open", "buckets": "1,8",
+        "max_wait_ms": 2.0, "requests": 10, "p50_ms": 1.0, "p95_ms": 2.0,
+        "p99_ms": 3.0, "images_per_sec": 100.0, "precision": "int8",
+        "parity_top1": 0.97,
+    }
+    assert validate_record(bench) == []
+    retune = {
+        "kind": "fleet", "ts": 1.0, "event": "retune", "host": "h0",
+        "precision_from": "bf16", "precision_to": "int8",
+        "parity_top1": 0.97, "p99_ms": 9.0, "target_p99_ms": 5.0,
+        "compiles_after_warmup": 0,
+    }
+    assert validate_record(retune) == []
+    parity = {
+        "kind": "quant_parity", "ts": 1.0, "precision": "int8",
+        "top1_agree": 0.99, "samples": 64, "top5_agree": None,
+        "max_logit_drift": 0.03, "model": "resnet18",
+    }
+    assert validate_record(parity) == []
+    assert validate_record({"kind": "quant_parity", "ts": 1.0})  # required
+    bad = dict(serve, precision=8)
+    assert validate_record(bad)
+
+
+def test_quantize_eval_report(tmp_path):
+    """The --quantize-eval offline oracle: report fields present, record
+    schema-clean, rendered by report_run."""
+    import dataclasses
+
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.evaluate import quantize_eval_report
+    from mpi_pytorch_tpu.obs.schema import validate_jsonl
+
+    cfg = Config(
+        model_name="resnet18", num_classes=64, width=32, height=32,
+        synthetic_data=True, compute_dtype="float32", quantize_eval=True,
+        quantize_calib=8, checkpoint_dir=str(tmp_path / "none"),
+        metrics_file=str(tmp_path / "qe.jsonl"), log_file="",
+        eval_log_file="",
+    )
+    cfg.validate_config()
+    report = quantize_eval_report(cfg)
+    assert report["kind"] == "quant_parity"
+    assert 0.0 <= report["top1_agree"] <= 1.0
+    assert report["samples"] == 8 and report["max_logit_drift"] > 0
+    assert validate_jsonl(str(tmp_path / "qe.jsonl")) == []
+
+    import io
+    from contextlib import redirect_stdout
+
+    from tools import report_run
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert report_run.main([str(tmp_path / "qe.jsonl")]) == 0
+    assert "QUANT parity" in buf.getvalue()
+
+
+def test_check_regression_keys_precision_separately(tmp_path):
+    """An int8 row must never compare against a bf16 baseline: precision
+    is part of the serve trend-line identity (the fleet_hosts fix shape)."""
+    from tools import check_regression
+
+    def row(precision=None, p99=10.0):
+        r = {
+            "kind": "serve_bench", "mode": "open", "buckets": "1,8",
+            "max_wait_ms": 2.0, "offered_rps": 400.0, "model": "resnet18",
+            "p99_ms": p99, "images_per_sec": 100.0,
+        }
+        if precision:
+            r["precision"] = precision
+        return r
+
+    base = tmp_path / "base.json"
+    new = tmp_path / "new.json"
+    # Baseline: fast bf16 point. New: SAME sweep point served int8, much
+    # slower — a different trend line, NOT a regression.
+    base.write_text(json.dumps(row("bf16", 10.0)) + "\n")
+    new.write_text(json.dumps(row("int8", 50.0)) + "\n")
+    assert check_regression.check_serve(str(new), str(base), 10.0) == []
+    # Same precision regressing IS caught.
+    new.write_text(json.dumps(row("bf16", 50.0)) + "\n")
+    assert check_regression.check_serve(str(new), str(base), 10.0)
+    # Pre-v7 rows (no field) still pair with each other.
+    base.write_text(json.dumps(row(None, 10.0)) + "\n")
+    new.write_text(json.dumps(row(None, 50.0)) + "\n")
+    assert check_regression.check_serve(str(new), str(base), 10.0)
+
+
+def test_report_run_renders_precision_fields(tmp_path, capsys):
+    from tools import report_run
+
+    path = tmp_path / "m.jsonl"
+    records = [
+        {"kind": "serve_bench", "ts": 1.0, "mode": "open", "buckets": "1,8",
+         "max_wait_ms": 2.0, "requests": 10, "p50_ms": 1.0, "p95_ms": 2.0,
+         "p99_ms": 3.0, "images_per_sec": 100.0, "precision": "int8",
+         "parity_top1": 0.97},
+        {"kind": "fleet", "ts": 2.0, "event": "retune", "host": "h0",
+         "max_wait_ms_from": 2.0, "max_wait_ms_to": 2.0,
+         "buckets_from": "1,8", "buckets_to": "1,8",
+         "precision_from": "bf16", "precision_to": "int8",
+         "parity_top1": 0.97, "p99_ms": 9.0, "target_p99_ms": 5.0,
+         "compiles_after_warmup": 0},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+    assert report_run.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "precision" in out
+    assert "bf16 → int8" in out
+    assert "0.97" in out

@@ -29,10 +29,19 @@ admission control, and each row gains ``fleet_hosts`` plus a ``per_host``
 fill/latency breakdown from the hosts' registry snapshots — how evenly
 the router actually spread the load.
 
+``--precision bf16,int8`` sweeps the serving precision (ISSUE 11): both
+values build ONE server holding both startup-compiled executable sets
+and switch live between them (``set_precision`` — the same no-compile
+lever the fleet controller retunes), so the bf16 and int8 points share
+params, warmup, and load shape. Rows carry ``precision``, and int8 rows
+carry ``parity_top1`` — the startup int8-vs-bf16 top-1 agreement the
+throughput claim is conditioned on.
+
 Run: ``python tools/bench_serve.py --smoke [--out docs/serve_bench.json]``
      ``python tools/bench_serve.py --bucket-sets "1,8,32,128;1,32,512" \
         --max-wait-ms 2,5,10 --requests 2000 --rps 0,500,2000``
      ``python tools/bench_serve.py --smoke --fleet 3``
+     ``python tools/bench_serve.py --smoke --precision bf16,int8``
 """
 
 from __future__ import annotations
@@ -242,6 +251,11 @@ def main() -> int:
     ap.add_argument("--fused-head", action="store_true",
                     help="serve through ops.fused_head_ce.head_predict "
                     "(TPU; forces topk=1)")
+    ap.add_argument("--precision", default="bf16",
+                    help="comma list over {bf16,int8}; both values build "
+                    "ONE server holding both startup-compiled executable "
+                    "sets and sweep by switching live (no recompile); "
+                    "int8 rows carry the startup parity_top1 stamp")
     ap.add_argument("--out", default="",
                     help="also write rows to this JSONL file (overwritten)")
     ap.add_argument("--smoke", action="store_true",
@@ -279,6 +293,20 @@ def main() -> int:
     pool = _image_pool(32, (args.image, args.image), args.seed)
     waits = [float(w) for w in args.max_wait_ms.split(",") if w.strip()]
     rates = [float(r) for r in args.rps.split(",") if r.strip()]
+    precisions = [p.strip() for p in args.precision.split(",") if p.strip()]
+    bad_prec = sorted(set(precisions) - {"bf16", "int8"})
+    if not precisions or bad_prec:
+        print(f"unknown --precision value(s): {bad_prec}", file=sys.stderr)
+        return 2
+    # Any int8 point needs the bf16 set too — it is the parity REFERENCE:
+    # an int8 row without its parity_top1 stamp is half a row (the v7
+    # schema contract), so an int8-only sweep still builds both sets and
+    # just doesn't drive the bf16 one. A bf16-only sweep builds one set.
+    serve_precision = "both" if "int8" in precisions else "bf16"
+    # Stamp rows only when the precision axis is LIVE (non-bf16 involved):
+    # a default pure-bf16 run keeps v6-identical rows, so its trend lines
+    # keep pairing with pre-v7 baselines (the serve-record rule).
+    stamp_precision = "int8" in precisions
     for bucket_set in [b for b in args.bucket_sets.split(";") if b.strip()]:
         cfg = Config(
             model_name=args.model, num_classes=args.num_classes,
@@ -287,6 +315,7 @@ def main() -> int:
             serve_max_wait_ms=waits[0], serve_queue_depth=args.queue_depth,
             serve_topk=args.topk, fused_head_eval=args.fused_head,
             serve_fleet_hosts=max(0, args.fleet),
+            serve_precision=serve_precision,
             metrics_file="", log_file="", eval_log_file="",
         )
         cfg.validate_config()
@@ -295,22 +324,29 @@ def main() -> int:
         else:
             server = InferenceServer(cfg, load_checkpoint=False)
         try:
-            for wait_ms in waits:
-                server.set_max_wait_ms(wait_ms)
-                for rps in rates:
-                    mode = "open" if rps > 0 else "closed"
-                    row = run_point(
-                        server, pool, mode=mode, requests=args.requests,
-                        concurrency=args.concurrency, rps=rps,
-                        seed=args.seed, timeout_s=args.timeout_s,
-                        fleet_hosts=max(0, args.fleet),
-                    )
-                    row.update(
-                        model=args.model, buckets=bucket_set,
-                        max_wait_ms=wait_ms, chips=jax.device_count(),
-                    )
-                    print(json.dumps(row), flush=True)
-                    out_rows.append(row)
+            for precision in precisions:
+                if server.precision != precision:
+                    server.set_precision(precision)
+                for wait_ms in waits:
+                    server.set_max_wait_ms(wait_ms)
+                    for rps in rates:
+                        mode = "open" if rps > 0 else "closed"
+                        row = run_point(
+                            server, pool, mode=mode, requests=args.requests,
+                            concurrency=args.concurrency, rps=rps,
+                            seed=args.seed, timeout_s=args.timeout_s,
+                            fleet_hosts=max(0, args.fleet),
+                        )
+                        row.update(
+                            model=args.model, buckets=bucket_set,
+                            max_wait_ms=wait_ms, chips=jax.device_count(),
+                        )
+                        if stamp_precision:
+                            row["precision"] = precision
+                        if precision == "int8" and server.parity_top1 is not None:
+                            row["parity_top1"] = server.parity_top1
+                        print(json.dumps(row), flush=True)
+                        out_rows.append(row)
         finally:
             server.close()
 

@@ -98,13 +98,16 @@ def check_bench(root: str, tol_pct: float) -> list[str]:
 
 
 def _serve_key(row: dict) -> tuple:
-    # fleet_hosts joined the sweep-point identity in schema v5: an N-host
-    # fleet row is a different trend line than a single-server row at the
-    # same (mode, buckets, wait, rps) — old rows (no field) key as None on
-    # both sides, so pre-v5 baselines keep comparing unchanged.
+    # fleet_hosts joined the sweep-point identity in schema v5, precision
+    # in v7: an N-host fleet row — or an int8 row — is a different trend
+    # line than a single-server/bf16 row at the same (mode, buckets, wait,
+    # rps), so an int8 point can never be "a regression" against a bf16
+    # baseline (or vice versa). Old rows (no field) key as None on both
+    # sides, so pre-v5/v7 baselines keep comparing unchanged.
     return (
         row.get("mode"), row.get("buckets"), row.get("max_wait_ms"),
         row.get("offered_rps"), row.get("model"), row.get("fleet_hosts"),
+        row.get("precision"),
     )
 
 

@@ -233,7 +233,8 @@ def summarize(records: list[dict]) -> dict:
             {k: r.get(k) for k in (
                 "mode", "buckets", "max_wait_ms", "offered_rps", "requests",
                 "rejected", "p50_ms", "p95_ms", "p99_ms", "images_per_sec",
-                "compiles_after_warmup", "fleet_hosts",
+                "compiles_after_warmup", "fleet_hosts", "precision",
+                "parity_top1",
             )}
             for r in serve_bench
         ]
@@ -265,9 +266,19 @@ def summarize(records: list[dict]) -> dict:
                 "event", "host", "detail", "redispatched", "spare",
                 "max_wait_ms_from", "max_wait_ms_to", "buckets_from",
                 "buckets_to", "p99_ms", "target_p99_ms",
-                "compiles_after_warmup",
+                "compiles_after_warmup", "precision_from", "precision_to",
+                "parity_top1",
             )}
             for f in fleet_events
+        ]
+    quant = by_kind.get("quant_parity", [])
+    if quant:
+        summary["quant_parity"] = [
+            {k: q.get(k) for k in (
+                "precision", "model", "samples", "top1_agree", "top5_agree",
+                "max_logit_drift",
+            )}
+            for q in quant
         ]
     anomalies = by_kind.get("anomaly", [])
     if anomalies:
@@ -444,14 +455,27 @@ def render(path: str, records: list[dict], summary: dict) -> str:
             [[k, v] for k, v in sv["batches_by_bucket"].items()],
         ))
     if "serve_bench" in summary:
-        out += ["", "serve bench rows:", table(
-            ["mode", "buckets", "wait_ms", "rps", "reqs", "p50", "p95",
-             "p99", "img/s", "compiles"],
-            [[r["mode"], r["buckets"], r["max_wait_ms"], r.get("offered_rps"),
-              r["requests"], r["p50_ms"], r["p95_ms"], r["p99_ms"],
-              r["images_per_sec"], r.get("compiles_after_warmup")]
-             for r in summary["serve_bench"]],
-        )]
+        rows = summary["serve_bench"]
+        headers = ["mode", "buckets", "wait_ms", "rps", "reqs", "p50", "p95",
+                   "p99", "img/s", "compiles"]
+        cells = [[r["mode"], r["buckets"], r["max_wait_ms"], r.get("offered_rps"),
+                  r["requests"], r["p50_ms"], r["p95_ms"], r["p99_ms"],
+                  r["images_per_sec"], r.get("compiles_after_warmup")]
+                 for r in rows]
+        if any(r.get("precision") for r in rows):
+            # The v7 precision axis: only rendered when some row carries
+            # it, so pre-v7 streams print the same table as before.
+            headers.append("precision")
+            for row, r in zip(cells, rows):
+                row.append(r.get("precision"))
+        out += ["", "serve bench rows:", table(headers, cells)]
+        for r in rows:
+            if r.get("parity_top1") is not None:
+                out.append(
+                    f"  int8 parity: top-1 agreement {r['parity_top1']} "
+                    f"vs bf16 ({r['buckets']} @ {r['max_wait_ms']} ms)"
+                )
+                break  # the stamp is the startup measurement — one line
     if "fleet_routing" in summary:
         fr = summary["fleet_routing"]
         out += ["", (
@@ -476,14 +500,33 @@ def render(path: str, records: list[dict], summary: dict) -> str:
                 f"FLEET retune: host {f.get('host')} — max_wait "
                 f"{_fmt(f.get('max_wait_ms_from'))} → "
                 f"{_fmt(f.get('max_wait_ms_to'))} ms, buckets "
-                f"{f.get('buckets_from')} → {f.get('buckets_to')} "
-                f"(p99 {_fmt(f.get('p99_ms'))} ms vs target "
+                f"{f.get('buckets_from')} → {f.get('buckets_to')}"
+            )
+            if f.get("precision_to"):
+                line += (
+                    f", precision {f.get('precision_from')} → "
+                    f"{f.get('precision_to')}"
+                    + (f" (parity top-1 {f['parity_top1']})"
+                       if f.get("parity_top1") is not None else "")
+                )
+            line += (
+                f" (p99 {_fmt(f.get('p99_ms'))} ms vs target "
                 f"{_fmt(f.get('target_p99_ms'))}; compiles "
                 f"{f.get('compiles_after_warmup')})"
             )
         else:
             line = f"FLEET {f['event']}: {f.get('host')} {f.get('detail') or ''}"
         out += ["", line]
+    for q in summary.get("quant_parity", []):
+        out += ["", (
+            f"QUANT parity ({q.get('model') or 'model'}, {q['precision']}): "
+            f"top-1 agreement {q['top1_agree']}"
+            + ("" if q.get("top5_agree") is None
+               else f", top-5 {q['top5_agree']}")
+            + ("" if q.get("max_logit_drift") is None
+               else f", max logit drift {q['max_logit_drift']}")
+            + f" over {q['samples']} sample(s)"
+        )]
     for r in summary.get("resumes", []):
         frm = r.get("from_mesh") or (
             f"{r['from_devices']} devices" if r.get("from_devices") is not None

@@ -157,17 +157,29 @@ def main() -> None:
     sb = _load_jsonl(os.path.join(out, "serve_bench.json"))
     if sb:
         print("## serving latency vs load (tools/bench_serve.py)\n")
-        print("| mode | buckets | wait ms | offered rps | p50 ms | p95 ms | "
-              "p99 ms | img/s | fill | rejected | compiles |")
-        print("|---|---|---|---|---|---|---|---|---|---|---|")
+        print("| mode | buckets | wait ms | offered rps | prec | fleet | "
+              "p50 ms | p95 ms | p99 ms | img/s | fill | rejected | "
+              "compiles |")
+        print("|---|---|---|---|---|---|---|---|---|---|---|---|---|")
         for r in sb:
             rps = r.get("offered_rps")
             print(
                 f"| {r['mode']} | {_cell(r['buckets'])} | {r['max_wait_ms']} | "
-                f"{'—' if rps is None else rps} | {r['p50_ms']} | "
+                f"{'—' if rps is None else rps} | "
+                f"{r.get('precision') or 'bf16'} | "
+                f"{r.get('fleet_hosts') or '—'} | {r['p50_ms']} | "
                 f"{r['p95_ms']} | {r['p99_ms']} | {r['images_per_sec']:,.0f} | "
                 f"{r.get('mean_fill_ratio', '?')} | {r.get('rejected', '?')} | "
                 f"{r.get('compiles_after_warmup', '?')} |"
+            )
+        parities = {
+            r["parity_top1"] for r in sb if r.get("parity_top1") is not None
+        }
+        if parities:
+            print(
+                "\nint8 rows: startup top-1 parity vs bf16 = "
+                + ", ".join(str(p) for p in sorted(parities))
+                + " (ops/quantize.py; offline oracle: evaluate --quantize-eval)"
             )
         print()
 
