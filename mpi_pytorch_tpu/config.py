@@ -337,6 +337,41 @@ class Config:
     serve_target_p99_ms: float = 0.0
     serve_retune_interval_s: float = 2.0
 
+    # --- remote fleet transport + autoscaler (serve/fleet/remote.py,
+    # serve/fleet/autoscaler.py, serve/host.py — ISSUE 12) ---
+    # The serving-host PROCESS entrypoint (python -m mpi_pytorch_tpu.serve.host)
+    # binds its wire surface (POST /submit, GET /result/<id>, /control,
+    # /metricsz, /healthz) on this port; 0 = ephemeral (read it back from
+    # serve_port_file).
+    serve_port: int = 0
+    # Readiness handshake: after warmup the host process atomically writes
+    # this JSON file ({"port", "pid", "host_index"}) — the supervisor's
+    # spawn handshake. "" = no file (the SERVE_HOST_READY stdout line and
+    # --serve-port remain).
+    serve_port_file: str = ""
+    # This process's fleet-host identity (the hN name, the kill-gate /
+    # inject_faults target). -1 = standalone serving (no fleet identity).
+    serve_host_index: int = -1
+    # RemoteHost wire discipline: connect-ish timeout for submit/probe/
+    # control calls, read timeout for result long-polls, and the bounded
+    # jittered retry budget for IDEMPOTENT probes (submit is never
+    # retried — a failed submit feeds the router's drain streak, which is
+    # what preserves exactly-once re-dispatch).
+    serve_connect_timeout_s: float = 2.0
+    serve_read_timeout_s: float = 30.0
+    serve_probe_retries: int = 2
+    # True starts the FleetAutoscaler: grow/shrink the host set from
+    # registry metrics (admission-reject rate, p99 vs --serve-target-p99-ms,
+    # queue-depth trend), bounded by the min/max host counts and the
+    # cooldown below so it can't flap; every action a kind="fleet"
+    # scale_up/scale_down/restart record (schema v8).
+    serve_autoscale: bool = False
+    serve_fleet_min_hosts: int = 1
+    serve_fleet_max_hosts: int = 8
+    serve_scale_cooldown_s: float = 30.0
+    # Front-door rejects/s that trigger a scale-up.
+    serve_scale_reject_rate: float = 0.5
+
     # --- validation semantics (main.py:104-112 validates on the TRAIN split) ---
     val_on_train: bool = True
 
@@ -674,7 +709,7 @@ class Config:
         if self.serve_fleet_hosts == 0:
             for knob in (
                 "serve_fleet_spare", "serve_target_p99_ms",
-                "serve_admission_tokens",
+                "serve_admission_tokens", "serve_autoscale",
             ):
                 if getattr(self, knob):
                     raise ValueError(
@@ -707,6 +742,60 @@ class Config:
                 f"serve_retune_interval_s must be > 0, "
                 f"got {self.serve_retune_interval_s}"
             )
+        # --- remote transport / autoscaler (ISSUE 12) ---
+        if self.serve_port < 0:
+            raise ValueError(
+                f"serve_port must be >= 0 (0 = ephemeral), got "
+                f"{self.serve_port}"
+            )
+        if self.serve_connect_timeout_s <= 0 or self.serve_read_timeout_s <= 0:
+            raise ValueError(
+                "serve_connect_timeout_s and serve_read_timeout_s must be "
+                f"> 0, got {self.serve_connect_timeout_s}/"
+                f"{self.serve_read_timeout_s}"
+            )
+        if self.serve_probe_retries < 0:
+            raise ValueError(
+                f"serve_probe_retries must be >= 0 (0 = single attempt), "
+                f"got {self.serve_probe_retries}"
+            )
+        if not self.serve_autoscale:
+            # The silently-ignored rule again: the scaler bounds are only
+            # read by FleetAutoscaler.
+            defaults = {
+                "serve_fleet_min_hosts": 1, "serve_fleet_max_hosts": 8,
+                "serve_scale_cooldown_s": 30.0,
+                "serve_scale_reject_rate": 0.5,
+            }
+            for knob, default in defaults.items():
+                if getattr(self, knob) != default:
+                    raise ValueError(
+                        f"{knob} configures the fleet autoscaler and needs "
+                        "--serve-autoscale true (without it the knob would "
+                        "be silently ignored)"
+                    )
+        else:
+            if self.serve_fleet_min_hosts < 1:
+                raise ValueError(
+                    f"serve_fleet_min_hosts must be >= 1, got "
+                    f"{self.serve_fleet_min_hosts}"
+                )
+            if self.serve_fleet_max_hosts < self.serve_fleet_min_hosts:
+                raise ValueError(
+                    f"serve_fleet_max_hosts ({self.serve_fleet_max_hosts}) "
+                    f"must be >= serve_fleet_min_hosts "
+                    f"({self.serve_fleet_min_hosts})"
+                )
+            if self.serve_scale_cooldown_s < 0:
+                raise ValueError(
+                    f"serve_scale_cooldown_s must be >= 0, got "
+                    f"{self.serve_scale_cooldown_s}"
+                )
+            if self.serve_scale_reject_rate < 0:
+                raise ValueError(
+                    f"serve_scale_reject_rate must be >= 0, got "
+                    f"{self.serve_scale_reject_rate}"
+                )
         if self.resume_retries < 0:
             raise ValueError(
                 f"resume_retries must be >= 0 (0 = one attempt, no retry), "

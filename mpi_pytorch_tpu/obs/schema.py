@@ -27,8 +27,8 @@ Record kinds (every record also carries ``ts``, the epoch-seconds stamp
 | rollback  | epoch, reason                                       | step, restored_epoch, rollbacks, lr_scale, path, detail |
 | metrics   | counters, gauges, histograms                        | merged_hosts |
 | alert     | rule, severity                                      | metric, value, threshold, streak, action, detail, epoch, step |
-| route     | host, requests                                      | share, score, queue_depth, inflight, window_s |
-| fleet     | event                                               | host, detail, redispatched, spare, max_wait_ms_from/to, buckets_from/to, p99_ms, target_p99_ms, compiles_after_warmup |
+| route     | host, requests                                      | share, score, queue_depth, inflight, window_s, transport |
+| fleet     | event                                               | host, detail, redispatched, spare, max_wait_ms_from/to, buckets_from/to, p99_ms, target_p99_ms, compiles_after_warmup, hosts_from/to, reason, reject_rate, queue_depth, restarts, transport |
 
 ``serve`` is the per-flush record the online inference server writes
 (serve/server.py: one coalesced batch dispatched to a bucket executable);
@@ -99,7 +99,17 @@ from typing import Any, Mapping
 #      delta on the record); and the ``quant_parity`` kind — one offline
 #      int8-vs-bf16 parity report from ``evaluate --quantize-eval``
 #      (top-1/top-5 agreement + max logit drift on a fixed sample).
-SCHEMA_VERSION = 7
+#   8: the remote-fleet generation (ISSUE 12): ``fleet`` records grow the
+#      autoscaler/supervisor events ``scale_up``/``scale_down``/
+#      ``restart`` with their evidence fields (``hosts_from``/``hosts_to``
+#      host counts, ``reason``, the front-door ``reject_rate`` rejects/s,
+#      the summed ``queue_depth``, the supervisor's cumulative
+#      ``restarts``); and ``route``/``fleet``/``serve_bench`` records may
+#      carry ``transport`` ("http" when the row came from real serving
+#      processes over the wire — stamped only when the axis is live, so
+#      in-process streams stay byte-identical to prior generations, and
+#      ``check_regression`` keys it into the serve trend-line identity).
+SCHEMA_VERSION = 8
 
 _NUM = (int, float)
 _INT = (int,)
@@ -193,6 +203,10 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # startup int8-vs-bf16 top-1 agreement the accuracy claim rests
         # on (a throughput row without its parity stamp is half a row).
         "precision": (str,), "parity_top1": _NUM,
+        # v8: which transport served the row ("http" = real serving
+        # processes over the wire) — a remote row is a different trend
+        # line than an in-process one (check_regression keys it).
+        "transport": (str,),
     },
     "resume": {
         "from_devices": _INT, "from_mesh": (str,), "to_mesh": (str,),
@@ -214,6 +228,9 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
     "route": {
         "share": _NUM, "score": _NUM, "queue_depth": _INT, "inflight": _INT,
         "window_s": _NUM,
+        # v8: the host's transport ("http" = a real serving process over
+        # the wire; absent = in-process LocalHost, streams unchanged).
+        "transport": (str,),
     },
     "fleet": {
         "host": (str,), "detail": (str,), "redispatched": _INT,
@@ -225,6 +242,13 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # agreement stamped as the retune's accuracy evidence.
         "precision_from": (str,), "precision_to": (str,),
         "parity_top1": _NUM,
+        # v8: the autoscaler/supervisor events (scale_up / scale_down /
+        # restart): host counts before/after, the policy's reason, the
+        # front-door reject rate and summed queue depth it acted on, the
+        # supervisor's cumulative restart count, and the transport.
+        "hosts_from": _INT, "hosts_to": _INT, "reason": (str,),
+        "reject_rate": _NUM, "queue_depth": _INT, "restarts": _INT,
+        "transport": (str,),
     },
     # v6: which step the rollback triggered at, what it restored (the
     # checkpoint's filed epoch + path), how many rollbacks this run has

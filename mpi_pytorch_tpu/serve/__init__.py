@@ -21,6 +21,7 @@ path); tune it with ``docs/SERVING.md``.
 
 from mpi_pytorch_tpu.serve.batcher import (
     DynamicBatcher,
+    HostUnavailableError,
     PendingRequest,
     PreprocessError,
     QueueFullError,
@@ -32,25 +33,34 @@ from mpi_pytorch_tpu.serve.batcher import (
 from mpi_pytorch_tpu.serve.executables import BucketExecutables
 from mpi_pytorch_tpu.serve.server import InferenceServer, local_replica_mesh
 from mpi_pytorch_tpu.serve.fleet import (
+    FleetAutoscaler,
     FleetController,
     FleetRouter,
     FleetServer,
+    HostSupervisor,
     LocalHost,
     NoLiveHostError,
+    RemoteFleet,
+    RemoteHost,
 )
 
 __all__ = [
     "BucketExecutables",
     "DynamicBatcher",
+    "FleetAutoscaler",
     "FleetController",
     "FleetRouter",
     "FleetServer",
+    "HostSupervisor",
+    "HostUnavailableError",
     "InferenceServer",
     "LocalHost",
     "NoLiveHostError",
     "PendingRequest",
     "PreprocessError",
     "QueueFullError",
+    "RemoteFleet",
+    "RemoteHost",
     "ServeError",
     "ServerClosedError",
     "local_replica_mesh",

@@ -59,6 +59,17 @@ class ServerClosedError(ServeError):
     """The server is closed (or closing) and accepts no new requests."""
 
 
+class HostUnavailableError(ServeError):
+    """The HOST, not the request, failed: connection refused, connect/read
+    timeout, a 5xx from the serving process, a process that died mid-poll.
+    The dispatch-failure taxonomy's transport leg (ISSUE 12): the fleet
+    router treats this exactly like ``ServerClosedError`` — count it
+    against the host's failure streak and re-dispatch the request —
+    never like a request-fault ``ServeError``, which propagates to the
+    caller (re-dispatching a poison request would just poison another
+    host's flush)."""
+
+
 class PreprocessError(ServeError):
     """A preprocess worker crashed (or raised an unexpected non-ServeError)
     while preparing THIS request — the typed per-request failure the caller

@@ -13,11 +13,29 @@ of N ``serve.InferenceServer`` replicas — the millions-of-users path.
   compile invariant holds through every retune, asserted).
 - ``server.py``: ``FleetServer`` — the in-process N-host harness
   (threads, shared executable set) the bench/CI/tests drive.
+- ``remote.py``: the REAL-process transport (ISSUE 12) — ``RemoteHost``
+  (the ``HostHandle`` twin over HTTP: wire retry/timeout/backoff, the
+  429 → ``QueueFullError`` round trip, transport failures classified
+  host-shaped), ``HostSupervisor`` (restart dead serving processes with
+  exponential backoff, re-admit after warm-probe success), and
+  ``RemoteFleet`` (N ``python -m mpi_pytorch_tpu.serve.host``
+  subprocesses behind the unchanged router).
+- ``autoscaler.py``: ``FleetAutoscaler`` — grow/shrink the host set from
+  registry metrics (admission-reject rate, p99 vs target, queue-depth
+  trend), bounded by min/max host counts and a cooldown; warm spawns
+  ride the persistent compilation cache.
 
-Telemetry: ``kind="route"`` / ``kind="fleet"`` records (schema v5).
+Telemetry: ``kind="route"`` / ``kind="fleet"`` records (schema v8:
+scale_up/scale_down/restart events, transport stamps).
 """
 
+from mpi_pytorch_tpu.serve.fleet.autoscaler import FleetAutoscaler
 from mpi_pytorch_tpu.serve.fleet.controller import FleetController
+from mpi_pytorch_tpu.serve.fleet.remote import (
+    HostSupervisor,
+    RemoteFleet,
+    RemoteHost,
+)
 from mpi_pytorch_tpu.serve.fleet.router import (
     FleetRouter,
     LocalHost,
@@ -26,9 +44,13 @@ from mpi_pytorch_tpu.serve.fleet.router import (
 from mpi_pytorch_tpu.serve.fleet.server import FleetServer
 
 __all__ = [
+    "FleetAutoscaler",
     "FleetController",
     "FleetRouter",
     "FleetServer",
+    "HostSupervisor",
     "LocalHost",
     "NoLiveHostError",
+    "RemoteFleet",
+    "RemoteHost",
 ]

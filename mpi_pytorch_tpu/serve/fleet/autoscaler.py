@@ -1,0 +1,340 @@
+"""Registry-driven fleet autoscaler: grow/shrink the host set from the
+telemetry the fleet already publishes (ISSUE 12 / ROADMAP item 2).
+
+The serving-side twin of PR 7's elastic training: where elastic resume
+re-shapes a TRAINING world around preemption, this loop re-shapes the
+SERVING world around load — the close of the millions-of-users path the
+reference's fixed N-rank pipeline (arXiv 1603.02339's lineage) never had.
+
+Signals, per tick, all read from surfaces that already exist:
+
+- **admission-reject rate** — the router's front-door rejection counter,
+  differenced against the last tick (rejects/s). The front door rejects
+  only when the FLEET-WIDE budget is exhausted, so a sustained rate is
+  the cleanest "the fleet is too small" signal there is.
+- **p99 vs target** — the worst per-host cumulative sketch p99
+  (``serve/request_latency_ms``) from the merged registry snapshots, the
+  same percentile the ``FleetController`` steers on. The controller owns
+  the PER-HOST knobs (wait/buckets/precision); this loop owns the host
+  COUNT — it only acts on latency when the queue-depth trend confirms
+  the fleet is genuinely filling up, so the two loops cannot fight over
+  a transient.
+- **queue-depth trend** — the sum of host queue depths over a sliding
+  window; monotone growth means the backlog is structural.
+
+Policy (deliberately boring — the bounds are the feature):
+
+- scale **up** when rejects flow or (p99 breaches AND the queue trend
+  rises), below ``max_hosts``: ``spawn_fn()`` brings up a host WARMED
+  from the persistent compilation cache (the spawner asserts zero
+  steady-state compiles before handing it over) and the router admits it.
+- scale **down** after ``idle_ticks`` consecutive quiet ticks (no
+  rejects, empty queues, p99 under half target), above ``min_hosts``:
+  the router drains the COLDEST host (fewest outstanding + least
+  dispatched) and ``retire_fn`` reaps its process.
+- a **cooldown** between actions bounds the loop's slew rate — scaling
+  can lag, it must never flap.
+- ``rolling_restart()`` walks the fleet host-by-host through the
+  supervisor's drain → restart → warm → re-admit path (config push,
+  binary upgrade) without dropping below N-1 live hosts.
+
+Every action writes a schema-stamped ``kind="fleet"`` record
+(``event="scale_up" | "scale_down" | "restart"``, schema v8) carrying the
+evidence it acted on — hosts_from/to, reject rate, p99, queue depth.
+Drive it with ``tick()`` (tests, a fake clock) or ``start()``/``stop()``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from mpi_pytorch_tpu.serve.batcher import ServeError
+
+
+class FleetAutoscaler:
+    """Scale the host set up/down from registry metrics, bounded and
+    cooled down; every action a ``kind="fleet"`` record."""
+
+    def __init__(
+        self,
+        router,
+        *,
+        spawn_fn,
+        retire_fn=None,
+        restart_fn=None,
+        target_p99_ms: float = 0.0,
+        min_hosts: int = 1,
+        max_hosts: int = 8,
+        cooldown_s: float = 30.0,
+        reject_rate_up: float = 0.5,
+        idle_ticks: int = 2,
+        trend_window: int = 3,
+        interval_s: float = 2.0,
+        latency_metric: str = "serve/request_latency_ms",
+        metrics=None,
+        transport: str | None = None,
+        logger=None,
+        clock=time.monotonic,
+    ):
+        from mpi_pytorch_tpu.utils.logging import run_logger
+
+        if min_hosts < 1:
+            raise ValueError(f"min_hosts must be >= 1, got {min_hosts}")
+        if max_hosts < min_hosts:
+            raise ValueError(
+                f"max_hosts ({max_hosts}) must be >= min_hosts ({min_hosts})"
+            )
+        self._router = router
+        self._spawn_fn = spawn_fn  # () -> HostHandle, warmed
+        # (host) -> None: detach the host from supervision/process
+        # management — called BEFORE the router drains it, so the
+        # supervisor never reads the deliberate shutdown as a death.
+        self._retire_fn = retire_fn
+        self._restart_fn = restart_fn  # (host) -> None, rolling unit
+        self.target_p99_ms = float(target_p99_ms)
+        self.min_hosts = int(min_hosts)
+        self.max_hosts = int(max_hosts)
+        self.cooldown_s = float(cooldown_s)
+        self.reject_rate_up = float(reject_rate_up)
+        self.idle_ticks = int(idle_ticks)
+        self._interval_s = float(interval_s)
+        self._latency_metric = latency_metric
+        self._metrics = metrics
+        self._transport = transport
+        self._logger = logger or run_logger()
+        self._clock = clock
+        self._last_rejects = 0
+        self._last_tick_t: float | None = None
+        self._last_action_t: float | None = None
+        self._idle_streak = 0
+        self._queue_trend: collections.deque = collections.deque(
+            maxlen=max(2, int(trend_window))
+        )
+        self.actions: list[str] = []  # event kinds, append-only (tests/CI)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------- signals
+
+    def _signals(self) -> dict:
+        now = self._clock()
+        rejects = self._router.front_door_rejections
+        dt = (
+            now - self._last_tick_t
+            if self._last_tick_t is not None else None
+        )
+        reject_rate = (
+            (rejects - self._last_rejects) / dt if dt and dt > 0 else 0.0
+        )
+        self._last_rejects = rejects
+        self._last_tick_t = now
+
+        p99 = None
+        queue_depth = 0
+        for host in self._router.active_hosts():
+            try:
+                snap = host.snapshot()
+            except ServeError:
+                continue  # the router's probe loop owns unreachable hosts
+            hist = snap.get("histograms", {}).get(self._latency_metric)
+            if hist and hist.get("count"):
+                p99 = max(p99 or 0.0, hist["p99"])
+            qd = snap.get("gauges", {}).get("serve/queue_depth") or 0
+            queue_depth += int(qd)
+        self._queue_trend.append(queue_depth)
+        trend = list(self._queue_trend)
+        rising = (
+            len(trend) == self._queue_trend.maxlen
+            and all(b > a for a, b in zip(trend, trend[1:]))
+            and trend[-1] > 0
+        )
+        return {
+            "reject_rate": reject_rate,
+            "p99_ms": p99,
+            "queue_depth": queue_depth,
+            "queue_rising": rising,
+        }
+
+    # ------------------------------------------------------------- the tick
+
+    def tick(self) -> str | None:
+        """Evaluate once; returns the action taken ("scale_up" /
+        "scale_down") or None. Signal state updates every tick — cooldown
+        suppresses ACTIONS, never observation."""
+        hosts = self._router.active_hosts()
+        n = len(hosts)
+        sig = self._signals()
+
+        breach = self.target_p99_ms > 0 and (
+            sig["p99_ms"] is not None and sig["p99_ms"] > self.target_p99_ms
+        )
+        pressure = (
+            sig["reject_rate"] > self.reject_rate_up
+            or (breach and sig["queue_rising"])
+        )
+        idle = (
+            sig["reject_rate"] <= 0
+            and sig["queue_depth"] == 0
+            and not breach
+        )
+        self._idle_streak = self._idle_streak + 1 if idle else 0
+
+        if pressure and n >= self.max_hosts:
+            self._logger.warning(
+                "autoscaler: pressure at the max_hosts=%d bound "
+                "(reject_rate %.2f/s, p99 %s ms) — cannot scale further",
+                self.max_hosts, sig["reject_rate"],
+                "-" if sig["p99_ms"] is None else f"{sig['p99_ms']:.1f}",
+            )
+        in_cooldown = (
+            self._last_action_t is not None
+            and self._clock() - self._last_action_t < self.cooldown_s
+        )
+        if in_cooldown:
+            return None
+        if pressure and n < self.max_hosts:
+            return self._scale_up(n, sig)
+        if (
+            n > self.min_hosts
+            and self._idle_streak >= self.idle_ticks
+        ):
+            return self._scale_down(n, sig, hosts)
+        return None
+
+    def _record(self, event: str, n_from: int, n_to: int, sig: dict,
+                host_name: str | None, reason: str,
+                compiles: int | None = None) -> None:
+        self.actions.append(event)
+        self._last_action_t = self._clock()
+        self._idle_streak = 0
+        self._logger.info(
+            "autoscaler: %s %d → %d host(s) — %s", event, n_from, n_to,
+            reason,
+        )
+        if self._metrics is None:
+            return
+        record = {
+            "kind": "fleet", "event": event,
+            "hosts_from": n_from, "hosts_to": n_to,
+            "reason": reason,
+            "reject_rate": round(sig["reject_rate"], 4),
+            "queue_depth": sig["queue_depth"],
+        }
+        if host_name is not None:
+            record["host"] = host_name
+        if sig["p99_ms"] is not None:
+            record["p99_ms"] = round(sig["p99_ms"], 3)
+        if self.target_p99_ms > 0:
+            record["target_p99_ms"] = self.target_p99_ms
+        if compiles is not None:
+            record["compiles_after_warmup"] = compiles
+        if self._transport is not None:
+            record["transport"] = self._transport
+        self._metrics.write(record)
+
+    def _scale_up(self, n: int, sig: dict) -> str | None:
+        reason = (
+            f"admission rejects at {sig['reject_rate']:.2f}/s"
+            if sig["reject_rate"] > self.reject_rate_up
+            else f"p99 {sig['p99_ms']:.1f} ms over target "
+                 f"{self.target_p99_ms:.1f} with rising queues"
+        )
+        try:
+            host = self._spawn_fn()
+        except Exception as e:  # noqa: BLE001 — a failed spawn must not kill the loop
+            self._logger.warning("autoscaler: scale-up spawn failed: %s", e)
+            return None
+        compiles = None
+        try:
+            compiles = int(host.compiles_after_warmup())
+        except ServeError:
+            pass
+        if compiles:
+            self._logger.error(
+                "autoscaler: new host %s shows %d steady-state compile(s) "
+                "— the warm-start invariant is broken", host.name, compiles,
+            )
+        self._router.add_host(host)
+        self._record("scale_up", n, n + 1, sig, host.name, reason,
+                     compiles=compiles)
+        return "scale_up"
+
+    def _scale_down(self, n: int, sig: dict, hosts) -> str | None:
+        stats = self._router.stats()
+        outstanding = stats.get("outstanding_by_host", {})
+        dispatched = stats.get("dispatched_by_host", {})
+        coldest = min(
+            hosts,
+            key=lambda h: (
+                outstanding.get(h.name, 0), dispatched.get(h.name, 0)
+            ),
+        )
+        # Detach from supervision BEFORE initiating the shutdown: the
+        # retired host's process exits as part of the drain, and a still-
+        # supervising loop would read that exit as a death and resurrect
+        # the host the fleet just decided to shed.
+        if self._retire_fn is not None:
+            try:
+                self._retire_fn(coldest)
+            except Exception as e:  # noqa: BLE001 — still drain it
+                self._logger.warning(
+                    "autoscaler: detach of %s failed: %s", coldest.name, e,
+                )
+        retired = self._router.retire_host(coldest.name, wait_s=30.0)
+        if retired is None:
+            # Raced a failover: the host is gone either way (and already
+            # detached) — the failover record tells that story.
+            return None
+        self._record(
+            "scale_down", n, n - 1, sig, coldest.name,
+            f"idle for {self._idle_streak} tick(s); retiring coldest",
+        )
+        return "scale_down"
+
+    # ------------------------------------------------------ rolling restart
+
+    def rolling_restart(self, reason: str = "rolling restart") -> int:
+        """Drain → restart → warm → re-admit every active host in turn
+        (needs ``restart_fn``; the supervisor's ``restart_host`` is the
+        canonical one). Returns how many hosts were cycled."""
+        if self._restart_fn is None:
+            raise ServeError(
+                "rolling_restart needs a restart_fn (the supervisor's "
+                "restart-host path)"
+            )
+        cycled = 0
+        for host in list(self._router.active_hosts()):
+            n = len(self._router.active_hosts())
+            self._restart_fn(host)
+            cycled += 1
+            sig = {
+                "reject_rate": 0.0, "p99_ms": None,
+                "queue_depth": 0, "queue_rising": False,
+            }
+            self._record("restart", n, n, sig, host.name, reason)
+        return cycled
+
+    # ----------------------------------------------------------- background
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-autoscaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — scaling must not kill serving
+                self._logger.warning("autoscaler tick failed: %s", e)

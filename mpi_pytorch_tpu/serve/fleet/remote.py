@@ -1,0 +1,1016 @@
+"""Remote fleet transport (ISSUE 12 / ROADMAP item 2): drive REAL serving
+processes over HTTP, with the failure machinery exercised across a real
+process boundary.
+
+PR 9's fleet was in-process: ``LocalHost`` wraps an ``InferenceServer``
+in threads, so "kill a host" never meant killing a process and the
+drain → exactly-once-redispatch → spare-promotion state machine had only
+ever seen simulated death. This module is the ``/metricsz``-shaped twin
+that surface was deliberately built for:
+
+- **``RemoteHost``** — the ``HostHandle`` over HTTP. ``submit`` POSTs the
+  request bytes (``.npy`` on the wire) and long-polls the result on a
+  bounded poller pool; probes (``/metricsz``, ``/healthz``) get bounded
+  JITTERED retries because they are idempotent — ``submit`` gets NONE,
+  because a submit retry could double-enqueue and the router's
+  K-consecutive-failure drain streak is the designed response to submit
+  failure (exactly-once re-dispatch stays with the router, where the
+  claim ledger lives). Connection-refused, connect/read timeouts, and
+  5xx all classify into ``HostUnavailableError`` — the same
+  dispatch-failure taxonomy the router already scores — while a wire 429
+  re-raises a faithful ``QueueFullError`` (``retry_after_ms`` intact) and
+  a 400 re-raises the request-fault ``ServeError`` that must propagate to
+  the caller, not re-dispatch.
+- **``HostSupervisor``** — process lifecycle. Watches each serving
+  subprocess; on death, restarts it with exponential backoff and
+  re-admits it into the router only after warm-probe success (the
+  ``/healthz`` handshake: process ready, executables warmed, zero
+  steady-state compiles) — drain → restart → warm → re-admit, the
+  weight-rollout drain machinery's failure-path twin. Warm start rides
+  the persistent compilation cache (``--compilation-cache-dir``): a
+  restarted host's warmup compiles are cache hits, so recovery costs
+  placement + warmup execution, not XLA.
+- **``RemoteFleet``** — the N-process harness: spawns
+  ``python -m mpi_pytorch_tpu.serve.host`` per host (+ optional warm
+  spare), fronts them with the unchanged ``FleetRouter``/
+  ``FleetController``, wires the supervisor and (``--serve-autoscale``)
+  the ``FleetAutoscaler``. The router never knows the transport — that
+  was the point of the handle.
+
+Chaos: ``MPT_FAULT_SERVE_KILL_HOST``/``_AFTER`` generalize — the router's
+kill gate now lands on ``RemoteHost.kill()``, which SIGKILLs the serving
+SUBPROCESS mid-traffic (``tools/inject_faults.py kill-serve-host`` is the
+by-hand drill). The ``_dryrun_remote_fleet`` CI leg and
+``tests/test_remote_fleet.py`` assert zero lost accepted requests, one
+failover record, supervisor re-admission, and zero steady-state compiles
+through real process death.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import Future, ThreadPoolExecutor
+
+import numpy as np
+
+from mpi_pytorch_tpu.serve.batcher import (
+    HostUnavailableError,
+    QueueFullError,
+    ServeError,
+    ServerClosedError,
+)
+
+
+class _PendingResult(Exception):
+    """Internal: the result long-poll sliced out (HTTP 408) — re-poll."""
+
+
+def _classify_http_error(e: urllib.error.HTTPError) -> Exception:
+    """Wire status → the typed in-process exception it stands for."""
+    try:
+        payload = json.loads(e.read().decode())
+    except Exception:  # noqa: BLE001 — a broken body is still a status
+        payload = {}
+    detail = payload.get("detail") or payload.get("error") or str(e)
+    if e.code == 429:
+        return QueueFullError(detail, retry_after_ms=payload.get("retry_after_ms"))
+    if e.code == 503:
+        return ServerClosedError(detail)
+    if e.code == 408:
+        return _PendingResult()
+    if e.code == 404:
+        # /result for an id this process never issued: a RESTARTED host
+        # forgot its predecessor's requests — host-shaped, re-dispatch.
+        err = HostUnavailableError(f"unknown on host (restarted?): {detail}")
+        err.status = e.code
+        return err
+    if 400 <= e.code < 500:
+        err = ServeError(detail)
+        err.status = e.code
+        return err
+    err = HostUnavailableError(f"HTTP {e.code}: {detail}")
+    err.status = e.code
+    return err
+
+
+class RemoteHost:
+    """``HostHandle`` twin over HTTP — what the router drives when each
+    serving host is its own process (or machine)."""
+
+    transport = "http"
+
+    def __init__(
+        self,
+        base_url: str,
+        *,
+        name: str,
+        index: int,
+        pid: int | None = None,
+        connect_timeout_s: float = 2.0,
+        read_timeout_s: float = 30.0,
+        probe_retries: int = 2,
+        poll_slice_s: float = 5.0,
+        result_timeout_s: float = 120.0,
+        pollers: int = 8,
+        facts_ttl_s: float = 0.2,
+        seed: int = 0,
+        logger=None,
+    ):
+        from mpi_pytorch_tpu.utils.logging import run_logger
+
+        self.base_url = base_url.rstrip("/")
+        self.name = name
+        self.index = index
+        self._logger = logger or run_logger()
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.read_timeout_s = float(read_timeout_s)
+        self.probe_retries = int(probe_retries)
+        self.poll_slice_s = float(poll_slice_s)
+        self.result_timeout_s = float(result_timeout_s)
+        self._facts_ttl_s = float(facts_ttl_s)
+        self._rng = random.Random(seed)
+        self._closed = False
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, pollers),
+            thread_name_prefix=f"remote-{name}",
+        )
+        self._facts_lock = threading.Lock()
+        self._facts_cache: dict | None = None
+        self._facts_t = -1.0
+        # First probe pins the static facts (capacity, compiled buckets,
+        # pid) — constructing a RemoteHost against a dead endpoint is a
+        # loud typed failure, not a handle that fails later.
+        facts = self._healthz(retries=self.probe_retries)
+        self.pid = pid if pid is not None else facts.get("pid")
+        self.queue_capacity = int(facts.get("queue_capacity") or 0)
+        self.buckets = tuple(facts.get("buckets") or ())
+        self.topk = facts.get("topk")
+
+    # --------------------------------------------------------- wire plumbing
+
+    def _request(
+        self, method: str, path: str, body: bytes | None = None, *,
+        timeout: float, retries: int = 0, ctype: str = "application/json",
+    ) -> bytes:
+        """One wire call with bounded jittered retries on TRANSPORT
+        failures only (the idempotent-probe discipline — callers pass
+        ``retries=0`` for submit). Typed statuses raise immediately."""
+        url = self.base_url + path
+        last: Exception | None = None
+        for attempt in range(retries + 1):
+            try:
+                req = urllib.request.Request(
+                    url, data=body, method=method,
+                    headers={"Content-Type": ctype} if body is not None else {},
+                )
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return resp.read()
+            except urllib.error.HTTPError as e:
+                exc = _classify_http_error(e)
+                if isinstance(exc, HostUnavailableError) and attempt < retries:
+                    last = exc
+                else:
+                    raise exc from None
+            except (urllib.error.URLError, ConnectionError, socket.timeout,
+                    TimeoutError, OSError) as e:
+                reason = getattr(e, "reason", e)
+                last = HostUnavailableError(
+                    f"{self.name} unreachable at {url}: {reason}"
+                )
+                if attempt >= retries:
+                    raise last from None
+            time.sleep(
+                0.05 * (2 ** attempt) * (0.5 + self._rng.random())
+            )
+        raise last  # pragma: no cover — loop always raises or returns
+
+    def _request_json(self, method, path, payload=None, *, timeout,
+                      retries=0) -> dict:
+        body = None if payload is None else json.dumps(payload).encode()
+        data = self._request(method, path, body, timeout=timeout,
+                             retries=retries)
+        return json.loads(data.decode()) if data else {}
+
+    def _healthz(self, retries: int | None = None) -> dict:
+        facts = self._request_json(
+            "GET", "/healthz", timeout=self.connect_timeout_s,
+            retries=self.probe_retries if retries is None else retries,
+        )
+        with self._facts_lock:
+            self._facts_cache = facts
+            self._facts_t = time.monotonic()
+        return facts
+
+    def _facts(self) -> dict:
+        """The last /healthz payload, refreshed when stale — the cheap
+        read behind the property surface (a controller tick reads several
+        properties; one probe serves them all)."""
+        with self._facts_lock:
+            fresh = (
+                self._facts_cache is not None
+                and time.monotonic() - self._facts_t <= self._facts_ttl_s
+            )
+            if fresh:
+                return self._facts_cache
+        return self._healthz()
+
+    # ------------------------------------------------------------- requests
+
+    def submit(self, image) -> Future:
+        """POST the request bytes; the future resolves from the result
+        long-poll. NO wire retries: a submit is not idempotent, and a
+        failed submit is exactly the signal the router's drain streak
+        and re-dispatch machinery exist to consume."""
+        if self._closed:
+            raise ServerClosedError(f"remote host {self.name} is closed")
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(image), allow_pickle=False)
+        resp = json.loads(self._request(
+            "POST", "/submit", buf.getvalue(),
+            timeout=self.connect_timeout_s, retries=0,
+            ctype="application/octet-stream",
+        ).decode())
+        rid = resp["req_id"]
+        fut: Future = Future()
+        try:
+            self._pool.submit(self._poll_result, rid, fut)
+        except RuntimeError as e:  # pool shut down under us (kill/close)
+            raise HostUnavailableError(
+                f"remote host {self.name} poller is shut down: {e}"
+            ) from None
+        return fut
+
+    def _poll_result(self, rid: int, fut: Future) -> None:
+        deadline = time.monotonic() + self.result_timeout_s
+        transport_strikes = 0
+        while True:
+            try:
+                data = self._request(
+                    "GET", f"/result/{rid}?timeout_s={self.poll_slice_s}",
+                    timeout=self.poll_slice_s + self.read_timeout_s,
+                    retries=0,
+                )
+                fut.set_result(np.load(io.BytesIO(data), allow_pickle=False))
+                return
+            except _PendingResult:
+                transport_strikes = 0
+                if time.monotonic() > deadline:
+                    fut.set_exception(HostUnavailableError(
+                        f"{self.name}: no result for req {rid} within "
+                        f"{self.result_timeout_s}s"
+                    ))
+                    return
+            except HostUnavailableError as e:
+                # The poll is idempotent → bounded retries before the
+                # host-shaped verdict reaches the router.
+                transport_strikes += 1
+                if (
+                    transport_strikes > self.probe_retries
+                    or time.monotonic() > deadline
+                    or self._closed
+                ):
+                    fut.set_exception(e)
+                    return
+                time.sleep(0.05 * (2 ** transport_strikes)
+                           * (0.5 + self._rng.random()))
+            except Exception as e:  # noqa: BLE001 — typed request faults et al
+                fut.set_exception(e)
+                return
+
+    def predict_batch(self, images, timeout: float | None = None):
+        futs = [self.submit(im) for im in images]
+        return np.stack([f.result(timeout=timeout) for f in futs])
+
+    # ----------------------------------------------------- telemetry / control
+
+    def snapshot(self) -> dict:
+        return self._request_json(
+            "GET", "/metricsz", timeout=self.connect_timeout_s,
+            retries=self.probe_retries,
+        )
+
+    def alive(self) -> bool:
+        try:
+            return self._healthz().get("status") == "ok"
+        except ServeError:
+            return False
+
+    def qsize(self) -> int:
+        try:
+            return int(self._facts().get("queue_depth") or 0)
+        except ServeError:
+            return 0
+
+    def stats(self) -> dict:
+        return self._request_json(
+            "GET", "/statsz", timeout=self.connect_timeout_s,
+            retries=self.probe_retries,
+        )
+
+    def compiles_after_warmup(self) -> int:
+        return int(self._facts().get("compiles_after_warmup") or 0)
+
+    @property
+    def active_buckets(self) -> tuple:
+        return tuple(self._facts().get("active_buckets") or self.buckets)
+
+    @property
+    def max_wait_ms(self) -> float:
+        return float(self._facts().get("max_wait_ms") or 0.0)
+
+    @property
+    def precision(self) -> str:
+        return self._facts().get("precision") or "bf16"
+
+    @property
+    def precisions(self) -> tuple:
+        return tuple(self._facts().get("precisions") or (self.precision,))
+
+    @property
+    def parity_top1(self):
+        return self._facts().get("parity_top1")
+
+    def _control(self, op: str, value=None) -> None:
+        payload = {"op": op}
+        if value is not None:
+            payload["value"] = value
+        # Control sets are idempotent → the probe retry budget applies.
+        self._request_json(
+            "POST", "/control", payload, timeout=self.connect_timeout_s,
+            retries=self.probe_retries,
+        )
+        with self._facts_lock:
+            # A knob just moved: the next property read must not serve
+            # the pre-retune healthz from the facts cache.
+            self._facts_t = -1.0
+
+    def set_max_wait_ms(self, v: float) -> None:
+        self._control("set_max_wait_ms", float(v))
+
+    def set_active_buckets(self, buckets) -> None:
+        self._control("set_active_buckets", [int(b) for b in buckets])
+
+    def set_precision(self, precision: str) -> None:
+        self._control("set_precision", str(precision))
+
+    # ------------------------------------------------------------ lifecycle
+
+    def kill(self) -> None:
+        """The hard-death path, generalized to a real process: SIGKILL the
+        serving subprocess (the ``MPT_FAULT_SERVE_KILL_HOST`` gate's
+        strike lands here). Falls back to a no-drain wire shutdown when
+        the pid is unknown (a true remote machine)."""
+        self._closed = True
+        try:
+            if self.pid:
+                os.kill(int(self.pid), signal.SIGKILL)
+            else:
+                self._request_json(
+                    "POST", "/control", {"op": "shutdown", "drain": False},
+                    timeout=self.connect_timeout_s, retries=0,
+                )
+        except (OSError, ServeError):
+            pass  # already dead — which is the goal
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def close(self, drain: bool = True) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._request_json(
+                "POST", "/control", {"op": "shutdown", "drain": bool(drain)},
+                timeout=self.connect_timeout_s, retries=0,
+            )
+        except ServeError as e:
+            self._logger.warning(
+                "remote host %s shutdown call failed: %s", self.name, e
+            )
+        # Give in-flight result polls a moment to deliver the drain's
+        # resolutions, then cut the poller pool.
+        self._pool.shutdown(wait=drain, cancel_futures=not drain)
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: restart dead serving processes with backoff, re-admit warm.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Supervised:
+    index: int
+    proc: object  # subprocess.Popen-shaped (poll/terminate/kill) or None
+    host: RemoteHost
+    spare: bool = False  # re-admission preserves the host's role
+    restarts: int = 0
+    state: str = "live"  # live | dead | restarting
+    next_restart_t: float = 0.0
+    last_start_t: float = 0.0
+
+
+class HostSupervisor:
+    """Watch serving subprocesses; restart with exponential backoff and
+    re-admit after warm-probe success (drain → restart → warm → re-admit).
+
+    The router handles the SERVING side of a death on its own (probe/
+    dispatch failures → drain → re-dispatch → spare promotion); this loop
+    owns the PROCESS side: bring the corpse back, verify it is warm
+    (``/healthz`` ok + zero steady-state compiles — the persistent
+    compilation cache is what makes that fast), then hand it back to the
+    router as a fresh active host. Every re-admission writes a
+    ``kind="fleet"`` ``event="restart"`` record (schema v8).
+    """
+
+    def __init__(
+        self,
+        spawn_fn,
+        *,
+        router,
+        metrics=None,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float = 30.0,
+        reset_after_s: float = 60.0,
+        interval_s: float = 0.5,
+        logger=None,
+        clock=time.monotonic,
+    ):
+        from mpi_pytorch_tpu.utils.logging import run_logger
+
+        self._spawn_fn = spawn_fn  # (index) -> (proc, RemoteHost), warm
+        self._router = router
+        self._metrics = metrics
+        self._backoff_base_s = float(backoff_base_s)
+        self._backoff_max_s = float(backoff_max_s)
+        self._reset_after_s = float(reset_after_s)
+        self._interval_s = float(interval_s)
+        self._logger = logger or run_logger()
+        self._clock = clock
+        self._entries: dict[int, _Supervised] = {}
+        self._lock = threading.Lock()
+        self.restarts_total = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def manage(self, index: int, proc, host: RemoteHost,
+               spare: bool = False) -> None:
+        with self._lock:
+            self._entries[index] = _Supervised(
+                index=index, proc=proc, host=host, spare=spare,
+                last_start_t=self._clock(),
+            )
+
+    def unmanage(self, index: int):
+        with self._lock:
+            return self._entries.pop(index, None)
+
+    def entry(self, index: int) -> _Supervised | None:
+        with self._lock:
+            return self._entries.get(index)
+
+    def procs(self) -> list:
+        with self._lock:
+            return [e.proc for e in self._entries.values()
+                    if e.proc is not None]
+
+    def _backoff(self, restarts: int) -> float:
+        return min(
+            self._backoff_base_s * (2 ** restarts), self._backoff_max_s
+        )
+
+    def tick(self) -> int:
+        """One supervision pass; returns how many hosts were re-admitted.
+        Drivable directly (tests, fake clocks) or via start()/stop().
+        State transitions happen under the supervisor lock, so a
+        concurrent ``restart_host`` (the rolling-restart path) and the
+        background loop can never both restart one entry."""
+        readmitted = 0
+        with self._lock:
+            entries = list(self._entries.values())
+        now = self._clock()
+        for e in entries:
+            claimed = False
+            with self._lock:
+                if e.state == "live":
+                    if e.proc is not None and e.proc.poll() is not None:
+                        backoff = self._backoff(e.restarts)
+                        e.state = "dead"
+                        e.next_restart_t = now + backoff
+                        self._logger.warning(
+                            "supervisor: host %s process died (rc=%s) — "
+                            "restart #%d in %.2fs",
+                            e.host.name, e.proc.poll(), e.restarts + 1,
+                            backoff,
+                        )
+                    elif (
+                        e.restarts
+                        and now - e.last_start_t > self._reset_after_s
+                    ):
+                        e.restarts = 0  # stable long enough: forgive history
+                elif e.state == "dead" and now >= e.next_restart_t:
+                    e.state = "restarting"  # claim, then work off-lock
+                    claimed = True
+            if claimed:
+                readmitted += self._restart(e)
+        return readmitted
+
+    def _restart(self, e: _Supervised, detail: str | None = None) -> int:
+        """Spawn + warm-probe + re-admit one CLAIMED entry (``e.state``
+        must already be "restarting" — tick()/restart_host own the
+        claim)."""
+        e.restarts += 1
+        proc = host = None
+        try:
+            proc, host = self._spawn_fn(e.index)
+            # Warm probe: the handshake already implies warmup ran; what
+            # re-admission additionally demands is ZERO steady-state
+            # compiles (the persistent-cache warm start made the warmup
+            # cheap; a host that would compile under traffic must not
+            # rejoin rotation).
+            facts = host._healthz()
+            if facts.get("status") != "ok":
+                raise HostUnavailableError(
+                    f"restarted host {host.name} unhealthy: {facts}"
+                )
+            compiles = int(facts.get("compiles_after_warmup") or 0)
+            if compiles != 0:
+                raise HostUnavailableError(
+                    f"restarted host {host.name} shows {compiles} "
+                    "steady-state compile(s) at warm probe"
+                )
+        except Exception as err:  # noqa: BLE001 — schedule the next attempt
+            # A spawned-but-unfit process must not outlive the failed
+            # attempt: it is healthy enough to hold devices/memory, and
+            # nothing else tracks it.
+            if host is not None:
+                try:
+                    host.kill()
+                except Exception:  # noqa: BLE001 — it is being discarded
+                    pass
+            if proc is not None:
+                _terminate(proc)
+            backoff = self._backoff(e.restarts)
+            with self._lock:
+                e.state = "dead"
+                e.next_restart_t = self._clock() + backoff
+            self._logger.warning(
+                "supervisor: restart of host index %d failed (%s) — "
+                "next attempt in %.2fs", e.index, err, backoff,
+            )
+            return 0
+        with self._lock:
+            e.proc, e.host = proc, host
+            e.last_start_t = self._clock()
+            e.state = "live"
+        self.restarts_total += 1
+        self._router.add_host(host, spare=e.spare)
+        self._logger.info(
+            "supervisor: host %s restarted (attempt %d) and re-admitted "
+            "after warm probe", host.name, e.restarts,
+        )
+        if self._metrics is not None:
+            self._metrics.write({
+                "kind": "fleet", "event": "restart", "host": host.name,
+                "detail": detail or f"supervisor restart #{e.restarts}",
+                "restarts": e.restarts, "compiles_after_warmup": 0,
+                "transport": host.transport,
+            })
+        return 1
+
+    def restart_host(self, index: int, *, reason: str = "rolling",
+                     drain_wait_s: float = 30.0) -> None:
+        """Rolling-restart one LIVE host: drain → terminate → spawn →
+        warm → re-admit (the autoscaler's rolling-restart unit). The
+        entry is claimed ("restarting") BEFORE the old process is
+        touched, so the background loop cannot race a second restart of
+        the same index while the drain/terminate window is open."""
+        with self._lock:
+            e = self._entries.get(index)
+            if e is None:
+                raise KeyError(f"no supervised host with index {index}")
+            if e.state != "live":
+                raise HostUnavailableError(
+                    f"host index {index} is {e.state}; a rolling restart "
+                    "needs a live host (the supervisor already owns its "
+                    "recovery)"
+                )
+            e.state = "restarting"
+        old = e.host
+        self._router.retire_host(old.name, wait_s=drain_wait_s)
+        if e.proc is not None:
+            _terminate(e.proc)
+        if not self._restart(e, detail=f"rolling restart ({reason})"):
+            raise HostUnavailableError(
+                f"rolling restart of host index {index} failed ({reason})"
+            )
+
+    # ---------------------------------------------------------- background
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-supervisor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — supervision must not die
+                self._logger.warning("supervisor tick failed: %s", e)
+
+
+def _terminate(proc, grace_s: float = 10.0) -> None:
+    """TERM, wait, KILL — the polite process reap."""
+    if proc.poll() is not None:
+        return
+    try:
+        proc.terminate()
+        proc.wait(timeout=grace_s)
+    except Exception:  # noqa: BLE001 — escalate
+        try:
+            proc.kill()
+            proc.wait(timeout=grace_s)
+        except Exception:  # noqa: BLE001 — nothing left to do
+            pass
+
+
+# ---------------------------------------------------------------------------
+# RemoteFleet: N serving PROCESSES behind the unchanged router.
+# ---------------------------------------------------------------------------
+
+# Config fields that must NOT flow to a serving-host child: fleet-side
+# knobs (the child is one host, not a fleet — they would fail its
+# validation), per-process outputs the fleet assigns itself, and the
+# wire/port identity the spawner owns.
+_CHILD_EXCLUDE = frozenset({
+    "serve_fleet_hosts", "serve_fleet_spare", "serve_admission_tokens",
+    "serve_target_p99_ms", "serve_retune_interval_s",
+    "serve_probe_interval_ms", "serve_fail_probes",
+    "serve_autoscale", "serve_fleet_min_hosts", "serve_fleet_max_hosts",
+    "serve_scale_cooldown_s", "serve_scale_reject_rate",
+    "metrics_file", "log_file", "eval_log_file", "trace_file",
+    "serve_port", "serve_port_file", "serve_host_index",
+    "serve_metrics_port", "flight_dir",
+})
+
+
+def child_host_args(cfg, index: int, port_file: str,
+                    metrics_file: str) -> list[str]:
+    """CLI argv for one ``python -m mpi_pytorch_tpu.serve.host`` child:
+    the cfg's diff against defaults (so children and fleet agree on the
+    model/bucket/precision world) plus the per-process identity."""
+    from mpi_pytorch_tpu.config import Config
+
+    default = Config()
+    args: list[str] = []
+
+    def _emit(flag_name: str, value, ftype) -> None:
+        flag = f"--{flag_name.replace('_', '-')}"
+        if ftype in (bool, "bool"):
+            args.extend([flag, "true" if value else "false"])
+        else:
+            args.extend([flag, str(value)])
+
+    for f in dataclasses.fields(Config):
+        if f.name in _CHILD_EXCLUDE:
+            continue
+        value = getattr(cfg, f.name)
+        if dataclasses.is_dataclass(value):
+            sub_default = getattr(default, f.name)
+            for sf in dataclasses.fields(value):
+                sv = getattr(value, sf.name)
+                if sv != getattr(sub_default, sf.name):
+                    _emit(f"{f.name}.{sf.name}", sv, sf.type)
+            continue
+        if f.type not in (bool, "bool", int, "int", float, "float",
+                          str, "str"):
+            continue  # non-CLI fields (tuples) — parse_config skips them too
+        if value != getattr(default, f.name):
+            _emit(f.name, value, f.type)
+    args.extend([
+        "--serve-host-index", str(index),
+        "--serve-port", "0",
+        "--serve-port-file", port_file,
+        "--metrics-file", metrics_file,
+        "--log-file", "",
+        "--eval-log-file", "",
+    ])
+    return args
+
+
+class RemoteFleet:
+    """N ``serve.host`` subprocesses (+ optional warm spare) behind the
+    transport-agnostic ``FleetRouter`` — one handle, same surface as the
+    in-process ``FleetServer``, but every host is a real process whose
+    death the supervisor survives."""
+
+    def __init__(
+        self,
+        cfg,
+        *,
+        n_hosts: int | None = None,
+        spare: bool | None = None,
+        workdir: str | None = None,
+        env: dict | None = None,
+        python: str = sys.executable,
+        spawn_timeout_s: float = 300.0,
+        logger=None,
+    ):
+        import tempfile
+
+        from mpi_pytorch_tpu.serve.fleet.autoscaler import FleetAutoscaler
+        from mpi_pytorch_tpu.serve.fleet.controller import FleetController
+        from mpi_pytorch_tpu.serve.fleet.router import FleetRouter
+        from mpi_pytorch_tpu.utils.logging import MetricsWriter, run_logger
+
+        n = int(n_hosts if n_hosts is not None else cfg.serve_fleet_hosts)
+        if n < 1:
+            raise ServeError(
+                f"a remote fleet needs at least one host, got n_hosts={n}"
+            )
+        self.cfg = cfg
+        self._logger = logger or run_logger()
+        self._python = python
+        self._spawn_timeout_s = float(spawn_timeout_s)
+        self.workdir = workdir or tempfile.mkdtemp(prefix="mpt_remote_fleet_")
+        os.makedirs(self.workdir, exist_ok=True)
+        self._env = dict(os.environ)
+        if env:
+            self._env.update(env)
+        self._repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )))
+        self._metrics = MetricsWriter(cfg.metrics_file)
+        self._next_index = 0
+        self._closed = False
+
+        want_spare = bool(cfg.serve_fleet_spare if spare is None else spare)
+        total = n + (1 if want_spare else 0)
+        indices = list(range(total))
+        self._next_index = total
+        spawned: dict[int, tuple] = {}
+        try:
+            # Warm-start ordering: with a persistent compilation cache the
+            # FIRST host pays the cold compiles and populates the cache;
+            # every later spawn (including failover restarts and scale-ups)
+            # warms from it in parallel.
+            if cfg.compilation_cache_dir and total > 1:
+                spawned[indices[0]] = self._spawn(indices[0])
+                rest = indices[1:]
+            else:
+                rest = indices
+            if rest:
+                with ThreadPoolExecutor(max_workers=len(rest)) as pool:
+                    futs = {i: pool.submit(self._spawn, i) for i in rest}
+                    for i, fut in futs.items():
+                        spawned[i] = fut.result()
+        except BaseException:
+            for proc, host in spawned.values():
+                try:
+                    host.kill()
+                except Exception:  # noqa: BLE001
+                    pass
+                _terminate(proc)
+            self._metrics.close()
+            raise
+
+        hosts = [spawned[i][1] for i in indices[:n]]
+        spare_host = spawned[indices[n]][1] if want_spare else None
+        warmup_payload = np.zeros((*cfg.image_size, 3), np.uint8)
+        self.router = FleetRouter(
+            hosts, spare_host,
+            metrics=self._metrics,
+            admission_tokens=cfg.serve_admission_tokens,
+            probe_interval_s=cfg.serve_probe_interval_ms / 1e3,
+            fail_probes=cfg.serve_fail_probes,
+            warmup_payload=warmup_payload,
+            logger=self._logger,
+        )
+        self.supervisor = HostSupervisor(
+            self._spawn, router=self.router, metrics=self._metrics,
+            logger=self._logger,
+        )
+        for i in indices:
+            self.supervisor.manage(
+                i, *spawned[i], spare=(want_spare and i == indices[n]),
+            )
+        self.supervisor.start()
+        self.controller = None
+        if cfg.serve_target_p99_ms > 0:
+            self.controller = FleetController(
+                self.router.active_hosts,
+                target_p99_ms=cfg.serve_target_p99_ms,
+                metrics=self._metrics,
+                interval_s=cfg.serve_retune_interval_s,
+                max_wait_ms_cap=max(
+                    cfg.serve_max_wait_ms * 4.0, cfg.serve_max_wait_ms + 1.0
+                ),
+                logger=self._logger,
+            )
+            self.controller.start()
+        self.autoscaler = None
+        if cfg.serve_autoscale:
+            self.autoscaler = FleetAutoscaler(
+                self.router,
+                spawn_fn=self._scale_spawn,
+                retire_fn=self._scale_retire,
+                target_p99_ms=cfg.serve_target_p99_ms,
+                min_hosts=cfg.serve_fleet_min_hosts,
+                max_hosts=cfg.serve_fleet_max_hosts,
+                cooldown_s=cfg.serve_scale_cooldown_s,
+                reject_rate_up=cfg.serve_scale_reject_rate,
+                interval_s=cfg.serve_retune_interval_s,
+                metrics=self._metrics,
+                transport="http",
+                logger=self._logger,
+            )
+            self.autoscaler.start()
+        self._logger.info(
+            "remote fleet: %d subprocess host(s)%s behind the router "
+            "(budget %d, workdir %s)",
+            n, " + warm spare" if want_spare else "", self.router.budget,
+            self.workdir,
+        )
+
+    # -------------------------------------------------------------- spawning
+
+    def _spawn(self, index: int):
+        """One serving-host subprocess: spawn, wait for the readiness
+        handshake (port file), return (proc, RemoteHost)."""
+        from mpi_pytorch_tpu.serve.http import wait_port_file
+
+        port_file = os.path.join(self.workdir, f"host{index}.port.json")
+        try:
+            os.remove(port_file)
+        except FileNotFoundError:
+            pass
+        metrics_file = os.path.join(self.workdir, f"host{index}.jsonl")
+        log_path = os.path.join(self.workdir, f"host{index}.log")
+        argv = [self._python, "-m", "mpi_pytorch_tpu.serve.host"]
+        argv += child_host_args(self.cfg, index, port_file, metrics_file)
+        log_fh = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                argv, env=self._env, cwd=self._repo,
+                stdout=log_fh, stderr=subprocess.STDOUT,
+            )
+        finally:
+            log_fh.close()
+        try:
+            ready = wait_port_file(port_file, self._spawn_timeout_s, proc)
+            host = RemoteHost(
+                f"http://127.0.0.1:{ready['port']}",
+                name=f"h{index}", index=index, pid=ready["pid"],
+                connect_timeout_s=self.cfg.serve_connect_timeout_s,
+                read_timeout_s=self.cfg.serve_read_timeout_s,
+                probe_retries=self.cfg.serve_probe_retries,
+                logger=self._logger,
+            )
+        except BaseException:
+            _terminate(proc)
+            tail = ""
+            try:
+                with open(log_path, "rb") as f:
+                    tail = f.read()[-2048:].decode(errors="replace")
+            except OSError:
+                pass
+            self._logger.error(
+                "remote fleet: host %d failed to come up; log tail:\n%s",
+                index, tail,
+            )
+            raise
+        return proc, host
+
+    def _scale_spawn(self):
+        index = self._next_index
+        self._next_index += 1
+        proc, host = self._spawn(index)
+        self.supervisor.manage(index, proc, host)
+        return host
+
+    def _scale_retire(self, host) -> None:
+        """Autoscaler detach hook — runs BEFORE the router's drain, so
+        the supervisor stops watching the process before its deliberate
+        exit could read as a death. The reap happens in the background
+        (the child only exits once the drain's wire shutdown lands)."""
+        entry = self.supervisor.unmanage(host.index)
+        if entry is None or entry.proc is None:
+            return
+
+        def _reap() -> None:
+            try:
+                entry.proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                _terminate(entry.proc)
+
+        threading.Thread(
+            target=_reap, name="fleet-scale-reap", daemon=True
+        ).start()
+
+    # -------------------------------------------------------------- requests
+
+    def submit(self, image):
+        return self.router.submit(image)
+
+    def predict_batch(self, images, timeout: float | None = None):
+        return self.router.predict_batch(images, timeout=timeout)
+
+    # ------------------------------------------------------------- inspection
+
+    def hosts(self) -> list:
+        return self.router.active_hosts()
+
+    def host_snapshots(self) -> dict:
+        return {h.name: h.snapshot() for h in self.router.active_hosts()}
+
+    def set_max_wait_ms(self, max_wait_ms: float) -> None:
+        for h in self.router.active_hosts():
+            h.set_max_wait_ms(max_wait_ms)
+        spare = self.router.spare_host()
+        if spare is not None:
+            spare.set_max_wait_ms(max_wait_ms)
+
+    @property
+    def precision(self) -> str:
+        hosts = self.router.active_hosts()
+        return hosts[0].precision if hosts else "bf16"
+
+    @property
+    def parity_top1(self):
+        hosts = self.router.active_hosts()
+        return hosts[0].parity_top1 if hosts else None
+
+    def set_precision(self, precision: str) -> None:
+        for h in self.router.active_hosts():
+            h.set_precision(precision)
+        spare = self.router.spare_host()
+        if spare is not None:
+            spare.set_precision(precision)
+
+    def stats(self) -> dict:
+        hosts = {}
+        for h in self.router.active_hosts():
+            try:
+                hosts[h.name] = h.stats()
+            except ServeError:
+                continue  # a host dying mid-inspection is not an error here
+        return {
+            "hosts": hosts,
+            "router": self.router.stats(),
+            "served": sum(s.get("served", 0) for s in hosts.values()),
+            "rejected": sum(s.get("rejected", 0) for s in hosts.values()),
+            "padded_rows": sum(
+                s.get("padded_rows", 0) for s in hosts.values()
+            ),
+            "compiles_after_warmup": max(
+                (s.get("compiles_after_warmup", 0) for s in hosts.values()),
+                default=0,
+            ),
+        }
+
+    # ------------------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        if self.controller is not None:
+            self.controller.stop()
+        self.supervisor.stop()
+        # Router close drains every host handle (wire shutdown → children
+        # exit); then reap whatever lingers.
+        self.router.close()
+        for proc in self.supervisor.procs():
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                _terminate(proc)
+        self._metrics.close()
+
+    def __enter__(self) -> "RemoteFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -55,6 +55,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 
 from mpi_pytorch_tpu.serve.batcher import (
+    HostUnavailableError,
     QueueFullError,
     ServeError,
     ServerClosedError,
@@ -103,10 +104,13 @@ class _Flight:
 
 class LocalHost:
     """HostHandle over an in-process ``InferenceServer`` — the concrete
-    transport of the local N-host fleet (threads, one process). A remote
-    transport would implement the same surface over HTTP: ``snapshot``
-    is ``/metricsz``, ``alive`` is ``/healthz``, ``submit`` the request
-    endpoint. The router only ever talks through this interface."""
+    transport of the local N-host fleet (threads, one process). The
+    remote twin (``serve/fleet/remote.RemoteHost``, ISSUE 12) implements
+    the same surface over HTTP: ``snapshot`` is ``/metricsz``, ``alive``
+    is ``/healthz``, ``submit`` the request endpoint. The router only
+    ever talks through this interface — it is transport-agnostic."""
+
+    transport = "local"
 
     def __init__(self, server):
         self.server = server
@@ -228,6 +232,11 @@ class FleetRouter:
         self._warmup_payload = warmup_payload
         self._rng = random.Random(seed)
         self._closed = False
+        # Auto budget (admission_tokens=0) tracks the host set live: a
+        # scale-up adds its queue capacity to the front door, a retire
+        # removes it. An explicit budget is an operator decision and
+        # stays fixed through scaling.
+        self._auto_budget = not int(admission_tokens)
         self.budget = int(admission_tokens) or sum(
             h.queue_capacity for h in self._active
         )
@@ -241,6 +250,7 @@ class FleetRouter:
         self._done_t: float | None = None
         self._probe_interval_s = float(probe_interval_s)
         self._probe_ticks = 0
+        self._kill_gate_fired = False
         self._window_t = time.monotonic()
         self._probe_stop = threading.Event()
         self._probe_thread = threading.Thread(
@@ -396,14 +406,15 @@ class FleetRouter:
             self._finish(entry, result=fut.result())
             return
         if isinstance(exc, ServeError) and not isinstance(
-            exc, (ServerClosedError, QueueFullError)
+            exc, (ServerClosedError, QueueFullError, HostUnavailableError)
         ):
             # The REQUEST's own fault (bad shape, preprocess crash on its
             # payload): propagate — re-dispatching a poison request would
             # just poison another host's flush.
             self._finish(entry, error=exc)
             return
-        # Host-shaped failure (closed mid-flight, device error): count it
+        # Host-shaped failure (closed mid-flight, device error, transport
+        # failure to a remote host — ``HostUnavailableError``): count it
         # against the host and re-dispatch the request — the no-accepted-
         # request-lost contract.
         self._note_dispatch_failure(host)
@@ -504,6 +515,16 @@ class FleetRouter:
             if promoted is not None:
                 self._active.append(promoted)
                 self._spare = None
+            if self._auto_budget:
+                # The auto budget tracks ACTIVE capacity: the drained
+                # host's share leaves with it (else every kill+re-admit
+                # cycle would inflate the front door past what the fleet
+                # can hold), the promoted spare's share joins.
+                self.budget -= host.queue_capacity
+                self._tokens -= host.queue_capacity
+                if promoted is not None:
+                    self.budget += promoted.queue_capacity
+                    self._tokens += promoted.queue_capacity
         self._logger.warning(
             "fleet: draining host %s (%s) — re-dispatching %d in-flight "
             "request(s)%s",
@@ -548,6 +569,13 @@ class FleetRouter:
             return
         if env_int("MPT_FAULT_SERVE_KILL_HOST", -1) != host.index:
             return
+        with self._lock:
+            # One strike per router lifetime: a supervisor-restarted host
+            # reuses its index with a FRESH dispatch counter, and the
+            # drill must not kill the recovery it exists to exercise.
+            if self._kill_gate_fired:
+                return
+            self._kill_gate_fired = True
         if self._metrics is not None:
             self._metrics.write({
                 "kind": "fault",
@@ -664,7 +692,7 @@ class FleetRouter:
         window_s = now - self._window_t
         with self._lock:
             hosts = list(self._active)
-            rows = []
+            rows, row_hosts = [], []
             total = sum(
                 self._state[h.name].window_requests for h in hosts
             ) or 1
@@ -672,21 +700,113 @@ class FleetRouter:
                 st = self._state[h.name]
                 if st.window_requests == 0 and not force:
                     continue
-                rows.append({
+                row = {
                     "kind": "route",
                     "host": h.name,
                     "requests": st.window_requests,
                     "share": round(st.window_requests / total, 4),
                     "score": None if st.score is None
                     else round(st.score, 3),
-                    "queue_depth": h.qsize(),
                     "inflight": st.outstanding,
                     "window_s": round(window_s, 3),
-                })
+                }
+                transport = getattr(h, "transport", "local")
+                if transport != "local":
+                    # Schema-v8: stamp only when the axis is live, so
+                    # in-process streams stay byte-identical to v5.
+                    row["transport"] = transport
+                rows.append(row)
+                row_hosts.append(h)
                 st.window_requests = 0
             self._window_t = now
-        for row in rows:
+        for row, h in zip(rows, row_hosts):
+            # Queue depth is read OUTSIDE the lock: on a remote transport
+            # it is a wire call, and a dead host must cost a probe
+            # timeout, never a stalled router lock.
+            try:
+                row["queue_depth"] = h.qsize()
+            except Exception:  # noqa: BLE001 — the probe loop owns failures
+                row["queue_depth"] = 0
             self._metrics.write(row)
+
+    # ------------------------------------------------------- fleet membership
+
+    def add_host(self, host, *, spare: bool = False) -> None:
+        """Admit ``host`` into rotation (or as the warm spare when none is
+        standing). The supervisor's re-admission and the autoscaler's
+        scale-up both land here: the name is cleared from the dead set
+        (a restarted host reuses its identity) and, under an auto
+        admission budget, the front door grows by its queue capacity."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("fleet router is shut down")
+            self._dead.discard(host.name)
+            self._state[host.name] = _HostState()
+            if spare and (
+                self._spare is None or self._spare.name == host.name
+            ):
+                # Reclaim (or refresh) the spare slot: a restarted spare
+                # must REPLACE its own dead handle, never leave the
+                # router holding a reference a failover would promote.
+                self._spare = host
+                role = "warm spare"
+            else:
+                self._active = [
+                    h for h in self._active if h.name != host.name
+                ] + [host]
+                role = "rotation"
+                if self._auto_budget:
+                    self.budget += host.queue_capacity
+                    self._tokens += host.queue_capacity
+        self._logger.info(
+            "fleet: host %s admitted into %s (%s transport)",
+            host.name, role, getattr(host, "transport", "local"),
+        )
+
+    def retire_host(self, name: str, *, wait_s: float = 0.0,
+                    grace_s: float = 30.0):
+        """Gracefully retire one ACTIVE host: out of rotation immediately
+        (no new dispatches), in-flight requests finish normally on it,
+        then it is closed — the scale-down / rolling-restart drain, NOT
+        the failure path (nothing is re-dispatched, nothing marked dead).
+        ``wait_s > 0`` drains inline (bounded); otherwise a background
+        thread waits up to ``grace_s``. Returns the host, or None if no
+        active host carries the name."""
+        with self._lock:
+            host = next(
+                (h for h in self._active if h.name == name), None
+            )
+            if host is None:
+                return None
+            self._active = [h for h in self._active if h.name != name]
+            if self._auto_budget:
+                self.budget -= host.queue_capacity
+                self._tokens -= host.queue_capacity
+        self._logger.info("fleet: retiring host %s (graceful drain)", name)
+
+        def _drain_close(bound_s: float) -> None:
+            deadline = time.monotonic() + bound_s
+            while time.monotonic() < deadline:
+                with self._lock:
+                    st = self._state.get(name)
+                    if st is None or st.outstanding <= 0:
+                        break
+                time.sleep(0.05)
+            try:
+                host.close()
+            except Exception as e:  # noqa: BLE001 — it is out of rotation
+                self._logger.warning(
+                    "fleet: retired-host close failed: %s", e
+                )
+
+        if wait_s > 0:
+            _drain_close(wait_s)
+        else:
+            threading.Thread(
+                target=_drain_close, args=(grace_s,), name="fleet-retire",
+                daemon=True,
+            ).start()
+        return host
 
     # ------------------------------------------------------------ inspection
 
@@ -714,6 +834,10 @@ class FleetRouter:
                 "spare_warmups": self._spare_warmups,
                 "dispatched_by_host": {
                     name: st.dispatched_total
+                    for name, st in sorted(self._state.items())
+                },
+                "outstanding_by_host": {
+                    name: st.outstanding
                     for name, st in sorted(self._state.items())
                 },
             }

@@ -148,6 +148,39 @@ class FleetServer:
                 logger=self._logger,
             )
             self.controller.start()
+        self.autoscaler = None
+        if cfg.serve_autoscale:
+            # The in-process twin of the remote autoscaler wiring: a local
+            # scale-up is a new InferenceServer over the SHARED warmed
+            # executable sets (zero compiles by construction — the same
+            # invariant the remote path buys from the persistent cache).
+            import itertools
+
+            from mpi_pytorch_tpu.serve.fleet.autoscaler import FleetAutoscaler
+
+            host_seq = itertools.count(total)
+
+            def _spawn_local():
+                server = InferenceServer(
+                    cfg, executables=self._exe, metrics=self._metrics,
+                    host_index=next(host_seq),
+                )
+                self._servers.append(server)
+                return LocalHost(server)
+
+            self.autoscaler = FleetAutoscaler(
+                self.router,
+                spawn_fn=_spawn_local,
+                target_p99_ms=cfg.serve_target_p99_ms,
+                min_hosts=cfg.serve_fleet_min_hosts,
+                max_hosts=cfg.serve_fleet_max_hosts,
+                cooldown_s=cfg.serve_scale_cooldown_s,
+                reject_rate_up=cfg.serve_scale_reject_rate,
+                interval_s=cfg.serve_retune_interval_s,
+                metrics=self._metrics,
+                logger=self._logger,
+            )
+            self.autoscaler.start()
         self._closed = False
         self._logger.info(
             "fleet: %d host(s)%s behind the router (budget %d, probe "
@@ -235,6 +268,8 @@ class FleetServer:
         if self._closed:
             return
         self._closed = True
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         if self.controller is not None:
             self.controller.stop()
         # Router close drains every host (spare included); each host

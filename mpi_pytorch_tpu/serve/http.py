@@ -1,4 +1,5 @@
-"""Lightweight HTTP exposition for the serve replica's live telemetry.
+"""Lightweight HTTP exposition for the serve replica's live telemetry —
+and, extended with routes, the wire surface of a remote serving host.
 
 One daemon ``ThreadingHTTPServer`` per ``InferenceServer`` (opt-in:
 ``--serve-metrics-port``), serving three read-only endpoints off the live
@@ -12,6 +13,24 @@ item 1's fleet controller polls without touching the record stream:
 - ``/healthz``  — liveness JSON from the server's stats callback (queue
   depth, compiles-after-warmup, served/rejected counters).
 
+``serve/host.py`` mounts additional routes (``POST /submit``,
+``GET /result/<id>``, ``POST /control``) on the same server to make a
+serving process drivable over the wire — the ``RemoteHost`` transport
+(ISSUE 12). Because that turns this from a scrape endpoint into a
+request-path surface facing untrusted clients, the server is hardened:
+
+- **per-request read timeout** (``read_timeout_s``): a client that opens
+  a connection and never finishes its request is cut off instead of
+  pinning a handler thread forever;
+- **bounded request body** (``max_body_bytes``): a POST must declare a
+  ``Content-Length`` (else 411) within the bound (else 413) before a
+  single body byte is read;
+- **graceful shutdown**: ``close()`` stops ACCEPTING first, then waits up
+  to ``drain_grace_s`` for in-flight handlers to drain before tearing the
+  socket down — a hung client can delay ``close()`` by at most the grace
+  period, never wedge it (previously a handler stuck on a dead client
+  held ``close()`` hostage).
+
 The handler never blocks the serve path: every read is a registry
 snapshot under its own small locks; request handling runs on the HTTP
 server's threads. Binds 127.0.0.1 by default — exposure beyond the host
@@ -22,46 +41,182 @@ not a default.
 from __future__ import annotations
 
 import json
+import socket
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
 class ObsHTTPServer:
-    """Serve /metrics, /metricsz, /healthz for one registry."""
+    """Serve /metrics, /metricsz, /healthz (plus mounted routes) for one
+    registry.
 
-    def __init__(self, registry, healthz=None, port: int = 0, host: str = "127.0.0.1"):
+    Extra routes: ``get_routes`` / ``post_routes`` map a path — or a
+    prefix ending in ``/`` — to ``fn(path, query, body) -> (status,
+    content_type, body_bytes, extra_headers)``. A route raising is a 500;
+    routes that want typed client errors return them as statuses.
+    """
+
+    def __init__(
+        self,
+        registry,
+        healthz=None,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        *,
+        metricsz=None,
+        get_routes=None,
+        post_routes=None,
+        read_timeout_s: float = 10.0,
+        max_body_bytes: int = 64 << 20,
+        drain_grace_s: float = 10.0,
+    ):
         self.registry = registry
         self.healthz = healthz
+        self._metricsz = metricsz or (lambda: registry.snapshot())
+        self._get_routes = dict(get_routes or {})
+        self._post_routes = dict(post_routes or {})
+        self._drain_grace_s = float(drain_grace_s)
+        self._accepting = True
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._drained = threading.Event()
+        self._drained.set()
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (http.server API)
+            # Socket timeout per request: BaseHTTPRequestHandler applies
+            # it to the connection, and handle_one_request converts a
+            # timed-out request line into a closed connection — the
+            # hung-client bound.
+            timeout = float(read_timeout_s)
+            protocol_version = "HTTP/1.1"
+
+            def _reply(self, status, ctype, body, headers=None):
                 try:
-                    if self.path.split("?")[0] == "/metrics":
-                        body = outer.registry.prometheus_text().encode()
-                        ctype = "text/plain; version=0.0.4; charset=utf-8"
-                    elif self.path.split("?")[0] == "/metricsz":
-                        body = json.dumps(outer.registry.snapshot()).encode()
-                        ctype = "application/json"
-                    elif self.path.split("?")[0] == "/healthz":
-                        payload = outer.healthz() if outer.healthz else {"status": "ok"}
-                        body = json.dumps(payload).encode()
-                        ctype = "application/json"
-                    else:
-                        self.send_error(404)
-                        return
-                except Exception as e:  # noqa: BLE001 — a scrape must not kill serving
-                    self.send_error(500, str(e))
+                    self.send_response(status)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    for k, v in (headers or {}).items():
+                        self.send_header(k, str(v))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except OSError:
+                    # The client vanished mid-write (a long-poll whose
+                    # poller timed out): nothing to salvage, and the
+                    # serve path must not hear about it.
+                    self.close_connection = True
+
+            def _json(self, status, payload, headers=None):
+                self._reply(
+                    status, "application/json",
+                    json.dumps(payload).encode(), headers,
+                )
+
+            def _route(self, routes, path):
+                fn = routes.get(path)
+                if fn is not None:
+                    return fn
+                for prefix, candidate in routes.items():
+                    if prefix.endswith("/") and path.startswith(prefix):
+                        return candidate
+                return None
+
+            def _read_body(self):
+                """The bounded-body read, or None after replying with the
+                typed refusal (411 undeclared / 413 oversized / 408 slow)."""
+                length = self.headers.get("Content-Length")
+                if length is None:
+                    self._json(411, {"error": "length_required"})
+                    return None
+                try:
+                    length = int(length)
+                except ValueError:
+                    self._json(400, {"error": "bad_content_length"})
+                    return None
+                if length < 0 or length > outer._max_body_bytes:
+                    self._json(413, {
+                        "error": "body_too_large",
+                        "max_bytes": outer._max_body_bytes,
+                    })
+                    self.close_connection = True
+                    return None
+                try:
+                    return self.rfile.read(length)
+                except (TimeoutError, socket.timeout):
+                    # Declared a body, never sent it: cut the connection
+                    # (the read-timeout half of the hung-client bound).
+                    self.close_connection = True
+                    return None
+
+            def _handle(self, method):
+                if not outer._accepting:
+                    self._json(503, {"error": "shutting_down"})
+                    self.close_connection = True
                     return
-                self.send_response(200)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                with outer._inflight_lock:
+                    outer._inflight += 1
+                    outer._drained.clear()
+                try:
+                    self._dispatch(method)
+                finally:
+                    with outer._inflight_lock:
+                        outer._inflight -= 1
+                        if outer._inflight == 0:
+                            outer._drained.set()
+
+            def _dispatch(self, method):
+                path, _, query = self.path.partition("?")
+                try:
+                    if method == "GET":
+                        if path == "/metrics":
+                            self._reply(
+                                200,
+                                "text/plain; version=0.0.4; charset=utf-8",
+                                outer.registry.prometheus_text().encode(),
+                            )
+                            return
+                        if path == "/metricsz":
+                            self._json(200, outer._metricsz())
+                            return
+                        if path == "/healthz":
+                            payload = (
+                                outer.healthz() if outer.healthz
+                                else {"status": "ok"}
+                            )
+                            self._json(200, payload)
+                            return
+                        fn = self._route(outer._get_routes, path)
+                        if fn is not None:
+                            self._reply(*fn(path, query, None))
+                            return
+                        self._json(404, {"error": "not_found"})
+                        return
+                    # POST
+                    fn = self._route(outer._post_routes, path)
+                    if fn is None:
+                        self._json(404, {"error": "not_found"})
+                        return
+                    body = self._read_body()
+                    if body is None:
+                        return
+                    self._reply(*fn(path, query, body))
+                except Exception as e:  # noqa: BLE001 — a request must not kill serving
+                    self._json(500, {
+                        "error": "internal",
+                        "detail": f"{type(e).__name__}: {e}",
+                    })
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                self._handle("GET")
+
+            def do_POST(self):  # noqa: N802 (http.server API)
+                self._handle("POST")
 
             def log_message(self, *args):  # silence per-request stderr noise
                 pass
 
+        self._max_body_bytes = int(max_body_bytes)
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
@@ -74,6 +229,35 @@ class ObsHTTPServer:
         return f"http://{self.host}:{self.port}{path}"
 
     def close(self) -> None:
+        """Stop accepting, drain in-flight handlers (bounded by
+        ``drain_grace_s``), then tear the listener down. Idempotent."""
+        self._accepting = False
         self._httpd.shutdown()
+        # In-flight handlers run on daemon threads the shutdown above does
+        # not touch; give them the grace period to finish their replies.
+        self._drained.wait(timeout=self._drain_grace_s)
         self._httpd.server_close()
         self._thread.join(timeout=5)
+
+
+def wait_port_file(path: str, timeout_s: float, proc=None) -> dict:
+    """Poll for the atomic port file a serving host writes when ready
+    (``serve/host.py``) and return its payload. ``proc`` (optional
+    ``subprocess.Popen``) short-circuits the wait when the host died
+    before ever becoming ready."""
+    import os
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if os.path.isfile(path):
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except ValueError:
+                pass  # racing the atomic rename's predecessor — retry
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(
+                f"serving host exited rc={proc.returncode} before ready"
+            )
+        time.sleep(0.05)
+    raise TimeoutError(f"serving host never wrote {path} in {timeout_s}s")

@@ -846,6 +846,19 @@ class InferenceServer:
             "rejected": stats["rejected"],
             "buckets": stats["buckets"],
             "precision": stats["precision"],
+            # The RemoteHost probe facts (ISSUE 12): everything a
+            # transport twin needs to mirror the LocalHost surface
+            # without a second endpoint — static facts (capacity,
+            # compiled sets, identity) plus the live knob positions the
+            # controller reads back between retunes.
+            "queue_capacity": self.cfg.serve_queue_depth,
+            "max_wait_ms": self.max_wait_ms,
+            "active_buckets": list(self.active_buckets),
+            "precisions": list(self.precisions),
+            "parity_top1": self.parity_top1,
+            "topk": stats["topk"],
+            "host_index": self.host_index,
+            "pid": os.getpid(),
         }
 
     def _shutdown_sinks(self) -> None:

@@ -112,7 +112,12 @@ FAULT_GATES: dict[str, str] = {
         "fleet-host index the serve kill gate targets (with "
         "MPT_FAULT_SERVE_KILL_AFTER) — the router hard-kills that host "
         "mid-traffic so the failover path (drain, re-dispatch in-flight "
-        "by req_id, promote the warm spare) runs deterministically"
+        "by req_id, promote the warm spare) runs deterministically. "
+        "Generalized across transports (ISSUE 12): on an in-process "
+        "fleet the strike closes the host without drain; on a REMOTE "
+        "fleet it SIGKILLs the serving SUBPROCESS (RemoteHost.kill), so "
+        "the drill is real process death — tools/inject_faults.py "
+        "kill-serve-host is the by-hand equivalent"
     ),
     "MPT_FAULT_SERVE_KILL_AFTER": (
         "kill the MPT_FAULT_SERVE_KILL_HOST host after this many requests "

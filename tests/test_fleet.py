@@ -663,6 +663,52 @@ def test_check_regression_keys_fleet_rows_separately(tmp_path):
     assert mod._serve_key(base_row) != mod._serve_key(fleet_row)
 
 
+def test_fleet_server_local_autoscale_spawns_from_shared_executables(
+    fleet_cfg, shared_exe, tmp_path
+):
+    """ISSUE 12: the in-process twin of the remote autoscaler wiring — a
+    local scale-up is a new InferenceServer over the SHARED warmed
+    executable set (zero compiles by construction), admitted into the
+    router with the admission budget growing to match."""
+    from mpi_pytorch_tpu.obs.schema import load_records, validate_jsonl
+
+    metrics_file = str(tmp_path / "autoscale.jsonl")
+    fleet = _make_fleet(
+        fleet_cfg, shared_exe, serve_autoscale=True,
+        serve_fleet_min_hosts=1, serve_fleet_max_hosts=3,
+        serve_scale_cooldown_s=0.0, serve_scale_reject_rate=0.5,
+        serve_retune_interval_s=3600.0,  # drive tick() manually
+        metrics_file=metrics_file,
+    )
+    try:
+        assert fleet.autoscaler is not None
+        budget_before = fleet.router.budget
+        fleet.autoscaler.tick()  # baseline the signal deltas
+        time.sleep(0.02)
+        fleet.router.front_door_rejections += 100  # reject pressure
+        assert fleet.autoscaler.tick() == "scale_up"
+        hosts = fleet.hosts()
+        assert len(hosts) == 3, [h.name for h in hosts]
+        assert fleet.router.budget == budget_before + (
+            fleet.cfg.serve_queue_depth
+        )
+        # The scaled-up host serves real traffic with zero compiles.
+        preds = fleet.predict_batch(_images(8, seed=21), timeout=120)
+        assert preds.shape == (8, 3)
+        for name, s in fleet.stats()["hosts"].items():
+            assert s["compiles_after_warmup"] == 0, (name, s)
+    finally:
+        fleet.close()
+    assert validate_jsonl(metrics_file) == []
+    ups = [
+        r for r in load_records(metrics_file)
+        if r["kind"] == "fleet" and r["event"] == "scale_up"
+    ]
+    assert len(ups) == 1
+    assert ups[0]["hosts_from"] == 2 and ups[0]["hosts_to"] == 3
+    assert ups[0]["compiles_after_warmup"] == 0
+
+
 def test_fleet_rejects_shared_fixed_metrics_port(fleet_cfg):
     import dataclasses
 

@@ -119,7 +119,15 @@ def closed_loop(server, pool, requests: int, concurrency: int, timeout_s: float)
 
 def open_loop(server, pool, requests: int, rps: float, seed: int, timeout_s: float):
     """Seeded-Poisson arrivals at ``rps``; latency measured per request
-    from its (intended) submit; full-queue submissions count as rejected."""
+    from its (intended) submit; full-queue submissions count as rejected.
+
+    The client HONORS the rejection's ``retry_after_ms`` hint (ISSUE 12
+    satellite): after a hinted 429/QueueFullError, no submission goes out
+    before the hint expires — arrivals due inside the backoff window are
+    deferred to its edge (still counted at their deferred submit time),
+    instead of hammering a host that just said "not before T". A
+    saturated sweep point therefore measures the BACKPRESSURE PROTOCOL's
+    throughput, not a retry storm's."""
     from mpi_pytorch_tpu.serve import QueueFullError
 
     rng = np.random.default_rng(seed)
@@ -128,18 +136,25 @@ def open_loop(server, pool, requests: int, rps: float, seed: int, timeout_s: flo
     lock = threading.Lock()
     futures = []
     rejected = 0
+    backoff_until = 0.0
     t0 = time.monotonic()
     next_t = t0
     for i in range(requests):
         next_t += gaps[i]
+        if next_t < backoff_until:
+            next_t = backoff_until  # defer to the hint's edge, don't hammer
         delay = next_t - time.monotonic()
         if delay > 0:
             time.sleep(delay)
         t_submit = time.monotonic()
         try:
             fut = server.submit(pool[i % len(pool)])
-        except QueueFullError:
+        except QueueFullError as e:
             rejected += 1
+            if e.retry_after_ms:
+                backoff_until = max(
+                    backoff_until, time.monotonic() + e.retry_after_ms / 1e3
+                )
             continue
 
         def _done(f, t_submit=t_submit):
@@ -246,6 +261,13 @@ def main() -> int:
                     "shared executable set) through the load-aware router "
                     "instead of a single server; rows gain fleet_hosts + "
                     "the per_host fill/latency breakdown")
+    ap.add_argument("--transport", default="local",
+                    choices=("local", "remote"),
+                    help="remote (needs --fleet N): each host is a REAL "
+                    "python -m mpi_pytorch_tpu.serve.host subprocess and "
+                    "requests cross the wire (serve/fleet/remote.py); rows "
+                    "gain transport='http' so check_regression never "
+                    "compares them against in-process baselines")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--timeout-s", type=float, default=120.0)
     ap.add_argument("--fused-head", action="store_true",
@@ -287,7 +309,19 @@ def main() -> int:
         jax.config.update("jax_platforms", platform.split(",")[0].strip())
 
     from mpi_pytorch_tpu.config import Config
-    from mpi_pytorch_tpu.serve import FleetServer, InferenceServer
+    from mpi_pytorch_tpu.serve import FleetServer, InferenceServer, RemoteFleet
+
+    if args.transport == "remote" and args.fleet <= 0:
+        print("--transport remote needs --fleet N (N >= 1)", file=sys.stderr)
+        return 2
+    cache_dir = ""
+    if args.transport == "remote":
+        # Remote hosts are fresh processes: a shared persistent
+        # compilation cache is what keeps an N-host build at ~one compile
+        # set (the warm-start recipe, docs/SERVING.md "Remote fleet").
+        import tempfile
+
+        cache_dir = tempfile.mkdtemp(prefix="mpt_bench_remote_cache_")
 
     out_rows = []
     pool = _image_pool(32, (args.image, args.image), args.seed)
@@ -316,10 +350,13 @@ def main() -> int:
             serve_topk=args.topk, fused_head_eval=args.fused_head,
             serve_fleet_hosts=max(0, args.fleet),
             serve_precision=serve_precision,
+            compilation_cache_dir=cache_dir,
             metrics_file="", log_file="", eval_log_file="",
         )
         cfg.validate_config()
-        if args.fleet > 0:
+        if args.transport == "remote":
+            server = RemoteFleet(cfg)
+        elif args.fleet > 0:
             server = FleetServer(cfg, load_checkpoint=False)
         else:
             server = InferenceServer(cfg, load_checkpoint=False)
@@ -341,6 +378,8 @@ def main() -> int:
                             model=args.model, buckets=bucket_set,
                             max_wait_ms=wait_ms, chips=jax.device_count(),
                         )
+                        if args.transport == "remote":
+                            row["transport"] = "http"
                         if stamp_precision:
                             row["precision"] = precision
                         if precision == "int8" and server.parity_top1 is not None:

@@ -25,6 +25,7 @@ CLI::
 
     python tools/inject_faults.py corrupt-latest --checkpoint-dir ckpt [--mode truncate]
     python tools/inject_faults.py kill --pid 1234 [--signal TERM]
+    python tools/inject_faults.py kill-serve-host --host-index 1 [--metrics-file m.jsonl]
     python tools/inject_faults.py list-gates
 
 The end-to-end chaos drive (kill an 8-device CPU-mesh run mid-step, resume
@@ -78,6 +79,73 @@ def kill(pid: int, sig: str = "KILL") -> None:
     """Deliver ``SIG<sig>`` to ``pid`` — the external-kill half of the
     harness (SIGKILL = hard crash, SIGTERM = graceful-preemption drill)."""
     os.kill(pid, getattr(signal, f"SIG{sig.upper()}"))
+
+
+def find_serve_host_pids(host_index: int | None = None) -> list[int]:
+    """PIDs of live ``python -m mpi_pytorch_tpu.serve.host`` processes on
+    this machine, optionally filtered to ``--serve-host-index N`` — the
+    target finder of the ``kill-serve-host`` chaos drill (scans
+    ``/proc/*/cmdline``; own pid excluded)."""
+    pids: list[int] = []
+    me = os.getpid()
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit() or int(entry) == me:
+            continue
+        try:
+            with open(f"/proc/{entry}/cmdline", "rb") as f:
+                argv = f.read().decode(errors="replace").split("\0")
+        except OSError:
+            continue  # raced a process exit
+        if "mpi_pytorch_tpu.serve.host" not in argv:
+            continue
+        if host_index is not None:
+            try:
+                flag_at = argv.index("--serve-host-index")
+                if argv[flag_at + 1] != str(host_index):
+                    continue
+            except (ValueError, IndexError):
+                continue
+        pids.append(int(entry))
+    return sorted(pids)
+
+
+def kill_serve_host(
+    host_index: int, sig: str = "KILL", metrics_file: str = "",
+) -> list[int]:
+    """The by-hand twin of the generalized ``MPT_FAULT_SERVE_KILL_HOST``
+    gate (ISSUE 12): find the serving SUBPROCESS carrying
+    ``--serve-host-index N``, announce the strike with a ``kind="fault"``
+    record (a gate never strikes silently — the inject_faults
+    discipline), then SIGKILL it. The fleet's router/supervisor must then
+    drain, re-dispatch, promote the spare, and restart the corpse —
+    which is exactly what the drill exists to watch."""
+    pids = find_serve_host_pids(host_index)
+    if not pids:
+        raise ProcessLookupError(
+            f"no live serve-host process with --serve-host-index "
+            f"{host_index} (is the fleet up, and on THIS machine?)"
+        )
+    writer = None
+    if metrics_file:
+        from mpi_pytorch_tpu.utils.logging import MetricsWriter
+
+        writer = MetricsWriter(metrics_file)
+    try:
+        for pid in pids:
+            if writer is not None:
+                writer.write({
+                    "kind": "fault",
+                    "reason": "injected_host_kill",
+                    "detail": (
+                        f"serve host index {host_index} pid {pid} "
+                        f"SIG{sig.upper()} (kill-serve-host)"
+                    ),
+                })
+            kill(pid, sig)
+    finally:
+        if writer is not None:
+            writer.close()
+    return pids
 
 
 def fault_env(
@@ -135,6 +203,19 @@ def main(argv=None) -> int:
     p_kill.add_argument("--pid", type=int, required=True)
     p_kill.add_argument("--signal", default="KILL", dest="sig")
 
+    p_ksh = sub.add_parser(
+        "kill-serve-host",
+        help="SIGKILL the serving subprocess with this --serve-host-index "
+        "(announce-then-strike; the remote-fleet chaos drill by hand)",
+    )
+    p_ksh.add_argument("--host-index", type=int, required=True)
+    p_ksh.add_argument("--signal", default="KILL", dest="sig")
+    p_ksh.add_argument(
+        "--metrics-file", default="",
+        help="append the announcing kind='fault' record here (the fleet's "
+        "shared stream, so the strike is on the record it disrupts)",
+    )
+
     sub.add_parser("list-gates", help="print the registered MPT_FAULT_* gates")
 
     args = parser.parse_args(argv)
@@ -144,6 +225,12 @@ def main(argv=None) -> int:
     elif args.cmd == "kill":
         kill(args.pid, args.sig)
         print(f"sent SIG{args.sig.upper()} to {args.pid}")
+    elif args.cmd == "kill-serve-host":
+        pids = kill_serve_host(args.host_index, args.sig, args.metrics_file)
+        print(
+            f"sent SIG{args.sig.upper()} to serve host index "
+            f"{args.host_index} (pid(s) {', '.join(map(str, pids))})"
+        )
     else:
         from mpi_pytorch_tpu.utils.env import FAULT_GATES
 

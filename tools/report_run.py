@@ -267,7 +267,8 @@ def summarize(records: list[dict]) -> dict:
                 "max_wait_ms_from", "max_wait_ms_to", "buckets_from",
                 "buckets_to", "p99_ms", "target_p99_ms",
                 "compiles_after_warmup", "precision_from", "precision_to",
-                "parity_top1",
+                "parity_top1", "hosts_from", "hosts_to", "reason",
+                "reject_rate", "queue_depth", "restarts", "transport",
             )}
             for f in fleet_events
         ]
@@ -513,6 +514,28 @@ def render(path: str, records: list[dict], summary: dict) -> str:
                 f" (p99 {_fmt(f.get('p99_ms'))} ms vs target "
                 f"{_fmt(f.get('target_p99_ms'))}; compiles "
                 f"{f.get('compiles_after_warmup')})"
+            )
+        elif f["event"] in ("scale_up", "scale_down"):
+            line = (
+                f"FLEET {f['event']}: {f.get('hosts_from')} → "
+                f"{f.get('hosts_to')} host(s)"
+                + (f" ({f.get('host')})" if f.get("host") else "")
+                + (f" — {f['reason']}" if f.get("reason") else "")
+            )
+            evidence = []
+            if f.get("reject_rate") is not None:
+                evidence.append(f"rejects {f['reject_rate']}/s")
+            if f.get("p99_ms") is not None:
+                evidence.append(f"p99 {_fmt(f['p99_ms'])} ms")
+            if f.get("queue_depth") is not None:
+                evidence.append(f"queue {f['queue_depth']}")
+            if evidence:
+                line += f" [{', '.join(evidence)}]"
+        elif f["event"] == "restart":
+            line = (
+                f"FLEET restart: host {f.get('host')} re-admitted"
+                + (f" ({f['detail']})" if f.get("detail") else "")
+                + (f" — {f['reason']}" if f.get("reason") else "")
             )
         else:
             line = f"FLEET {f['event']}: {f.get('host')} {f.get('detail') or ''}"
