@@ -519,6 +519,27 @@ class Config:
     # back from InferenceServer.metrics_port). Serves /metrics (Prometheus
     # text), /metricsz (JSON registry snapshot), /healthz.
     serve_metrics_port: int = 0
+    # --- fleet-wide distributed tracing + collector (ISSUE 13) ---
+    # > 0 turns on cross-process tracing at the fleet front door: every
+    # admitted request is minted a W3C-traceparent-style trace id that
+    # threads router → wire → host queue/preprocess/device → result, and
+    # the value is the HEAD-sample keep fraction for ordinary traces —
+    # tail sampling keeps every slow/failed/rejected/re-dispatched trace
+    # regardless. 0 (default) = tracing fully off: serve records and
+    # hot-path behavior are byte-identical to the untraced build.
+    trace_sample_rate: float = 0.0
+    # Tail-sampling slow threshold (ms): a trace whose end-to-end root
+    # exceeds this is kept in full. 0 = no slow criterion.
+    trace_slow_ms: float = 0.0
+    # > 0 runs the FleetCollector (obs/collector.py) on this cadence:
+    # scrape every host's /metricsz + /tracez, estimate per-host clock
+    # offsets from probe-RTT midpoints, detect counter resets across
+    # restarts, and emit schema-v9 kind="timeline" records. 0 = off.
+    serve_collect_interval_s: float = 0.0
+    # Where the collector appends KEPT trace spans (JSONL, one span per
+    # line) — the input of tools/trace_report.py. "" = don't persist
+    # spans (phase stats and timelines still collect).
+    fleet_trace_file: str = ""
     # Sanitizer (SURVEY §5 race-detection row): XLA collectives are
     # deterministic by construction, so the debug surface that remains is
     # numerics — this flag turns every NaN-producing op into an immediate
@@ -710,14 +731,37 @@ class Config:
             for knob in (
                 "serve_fleet_spare", "serve_target_p99_ms",
                 "serve_admission_tokens", "serve_autoscale",
+                "trace_sample_rate", "trace_slow_ms",
+                "serve_collect_interval_s", "fleet_trace_file",
             ):
                 if getattr(self, knob):
                     raise ValueError(
                         f"{knob} configures the serve fleet and needs "
-                        "serve_fleet_hosts > 0 (it is read by FleetServer "
-                        "only — without a fleet it would be silently "
-                        "ignored)"
+                        "serve_fleet_hosts > 0 (it is read by the fleet "
+                        "harness only — without a fleet it would be "
+                        "silently ignored)"
                     )
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ValueError(
+                f"trace_sample_rate must be in [0, 1] (the head-sample "
+                f"keep fraction), got {self.trace_sample_rate}"
+            )
+        if self.trace_slow_ms < 0:
+            raise ValueError(
+                f"trace_slow_ms must be >= 0 (0 = no slow criterion), "
+                f"got {self.trace_slow_ms}"
+            )
+        if self.serve_collect_interval_s < 0:
+            raise ValueError(
+                f"serve_collect_interval_s must be >= 0 (0 = collector "
+                f"off), got {self.serve_collect_interval_s}"
+            )
+        if self.fleet_trace_file and self.serve_collect_interval_s <= 0:
+            raise ValueError(
+                "fleet_trace_file is written by the FleetCollector — set "
+                "serve_collect_interval_s > 0 (without the collector the "
+                "file would silently stay empty)"
+            )
         if self.serve_probe_interval_ms <= 0:
             raise ValueError(
                 f"serve_probe_interval_ms must be > 0, "

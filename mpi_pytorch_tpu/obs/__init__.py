@@ -13,6 +13,14 @@ anomaly flight recorder dumping the last-N-records ring whenever a fault
 or alert fires (``flight.py``). The registry's snapshot surface is the
 contract ROADMAP item 1's fleet controller reads.
 
+Above the per-process layers sits the FLEET layer (ISSUE 13):
+W3C-``traceparent``-style cross-process trace propagation with a bounded
+span-export ring (``context.py`` — the ``/tracez`` surface), and the
+central collector scraping every host's metrics + spans with clock-offset
+estimation, counter-reset detection, tail-based trace sampling, and
+schema-v9 ``kind="timeline"`` records (``collector.py``);
+``tools/trace_report.py`` assembles the end-to-end request waterfalls.
+
 Everything here is host-side and backend-agnostic: importing this package
 never initializes jax (the tools import the schema without a device), and
 the tracer/health hooks are inert unless the corresponding config knob is
@@ -20,6 +28,14 @@ set — telemetry is opt-in per run, except the NaN sentinel, which defaults
 on (training on a NaN'd loss is never the right outcome).
 """
 
+from mpi_pytorch_tpu.obs.collector import FleetCollector
+from mpi_pytorch_tpu.obs.context import (
+    SpanRecorder,
+    TraceContext,
+    format_traceparent,
+    mint_trace,
+    parse_traceparent,
+)
 from mpi_pytorch_tpu.obs.flight import FlightRecorder
 from mpi_pytorch_tpu.obs.health import (
     NonFiniteLossError,
@@ -35,14 +51,20 @@ from mpi_pytorch_tpu.obs.schema import validate_jsonl, validate_record
 from mpi_pytorch_tpu.obs.trace import Tracer
 
 __all__ = [
+    "FleetCollector",
     "FlightRecorder",
     "Heartbeat",
     "MetricsRegistry",
     "NonFiniteLossError",
     "SLOMonitor",
+    "SpanRecorder",
     "StepHealth",
+    "TraceContext",
     "Tracer",
     "compile_count",
+    "format_traceparent",
+    "mint_trace",
+    "parse_traceparent",
     "device_bytes_in_use",
     "ensure_compile_listener",
     "flag_stragglers",

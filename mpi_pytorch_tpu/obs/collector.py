@@ -1,0 +1,605 @@
+"""FleetCollector: the central scrape loop over every serving host's
+telemetry (ISSUE 13 tentpole 2).
+
+PR 12 made the fleet real processes; observability stayed per-process —
+N JSONL streams with unsynchronized clocks and no cross-host joins. The
+collector is the fleet-side aggregator the large-system characterization
+work (arXiv 1711.00705, 1810.11112) says you end up needing: at scale
+the dominant perf question is *attribution*, and attribution needs one
+merged, skew-corrected view. One loop, four jobs:
+
+- **Metric time series.** Every tick scrapes each host's ``/metricsz``
+  snapshot into bounded per-(host, metric) rings with retention.
+  Counters become per-interval RATES; the snapshot's monotonic ``seq``
+  + process ``start_ts`` (the v9 scrape-ambiguity fix) distinguish a
+  counter RESET (host restart — re-baseline, count it, never a negative
+  rate) from an impossible negative delta (logged loudly). Emitted
+  periodically as schema-v9 ``kind="timeline"`` records.
+- **Clock offsets.** Each tick probes every host's wall clock and takes
+  the offset from the probe's RTT midpoint; the estimate kept per host
+  is the one measured on the SMALLEST recent RTT (the classic NTP-style
+  bound: offset error ≤ RTT/2, so the tightest probe wins). Host span
+  timestamps are corrected by this offset at ingest, which is what makes
+  a cross-process waterfall orderable.
+- **Trace collection + tail sampling.** Each tick drains every host's
+  ``/tracez`` span ring (cursor per host, reset when the host's recorder
+  generation changes — a restarted process starts a fresh seq space)
+  plus the front door's own recorder. Spans group by trace id; when a
+  trace's ROOT span (the router's ``route/request``) has arrived and the
+  trace has lingered long enough for stragglers, the TAIL decision runs:
+  keep the full span tree when the request failed / was rejected / was
+  re-dispatched / ran slow (``slow_ms``) / was pinned by a fleet event,
+  else head-sample at ``sample_rate`` (deterministic by trace-id hash).
+  Kept spans append to the fleet trace file (JSONL, one span per line —
+  ``tools/trace_report.py`` assembles the waterfalls).
+- **Event pinning.** ``tap()`` wraps the shared ``MetricsWriter`` the
+  way the flight recorder does: any ``kind="fleet"``/``"fault"``/
+  ``"rollback"`` record passing through pins every currently-open trace
+  (the implicated ones are exactly those in flight when the event hit),
+  and — when a ``FlightRecorder`` is attached — drops a pinned-trace
+  evidence note into the flight ring so the dump links event → victim
+  trace ids.
+
+The collector is transport-agnostic: a target is anything with
+``name`` plus (optionally) ``snapshot()`` / ``traces(since)`` /
+``clock_probe()`` — ``LocalHost`` and ``RemoteHost`` both qualify, and
+the tests drive it with jax-free fakes. Everything runs OFF the serve
+path: scrapes happen on the collector thread, and a dead host costs a
+caught exception, never a stalled router.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+
+from mpi_pytorch_tpu.obs.context import SpanRecorder, head_keep
+
+# Metrics tracked into timeline rings: gauges are sampled as-is, counters
+# as per-interval rates (units: events/s).
+_TIMELINE_GAUGES = ("serve/queue_depth", "serve/compiles_after_warmup")
+_TIMELINE_COUNTERS = (
+    "serve/requests", "serve/served", "serve/rejected", "serve/failed",
+)
+_PIN_KINDS = ("fleet", "fault", "rollback")
+_ROOT_SPAN = "route/request"
+
+
+class _HostScrape:
+    """Per-host collector state: counter baselines, reset detection,
+    trace cursor, clock offset."""
+
+    __slots__ = (
+        "name", "seq", "start_ts", "counters", "trace_cursor",
+        "trace_start_ts", "offset_s", "offset_rtt_s", "offset_t",
+        "resets", "last_scrape_t",
+    )
+
+    def __init__(self, name: str):
+        self.name = name
+        self.seq: float | None = None
+        self.start_ts: float | None = None
+        self.counters: dict[str, float] = {}
+        self.trace_cursor = 0
+        self.trace_start_ts: float | None = None
+        self.offset_s = 0.0
+        self.offset_rtt_s = math.inf
+        self.offset_t = -math.inf
+        self.resets = 0
+        self.last_scrape_t: float | None = None
+
+
+class _OpenTrace:
+    __slots__ = ("spans", "root", "pinned", "last_update", "first_seen")
+
+    def __init__(self, now: float):
+        self.spans: list[dict] = []
+        self.root: dict | None = None
+        self.pinned = False
+        self.last_update = now
+        self.first_seen = now
+
+
+class FleetCollector:
+    """Scrape loop + tail sampler + timeline emitter over a host set."""
+
+    def __init__(
+        self,
+        hosts_fn,
+        *,
+        spans: SpanRecorder | None = None,
+        metrics=None,
+        trace_out: str = "",
+        sample_rate: float = 0.0,
+        slow_ms: float = 0.0,
+        interval_s: float = 0.5,
+        retention_s: float = 300.0,
+        timeline_every: int = 20,
+        trace_linger_s: float = 0.5,
+        trace_max_open: int = 4096,
+        offset_refresh_s: float = 30.0,
+        flight=None,
+        logger=None,
+        clock=time.monotonic,
+    ):
+        from mpi_pytorch_tpu.utils.logging import run_logger
+
+        self._hosts_fn = hosts_fn
+        self._spans = spans  # the front door's own recorder (router process)
+        self._metrics = metrics
+        self.trace_out = trace_out
+        self._sample_rate = float(sample_rate)
+        self._slow_ms = float(slow_ms)
+        self._interval_s = float(interval_s)
+        self._retention_s = float(retention_s)
+        self._timeline_every = max(1, int(timeline_every))
+        self._trace_linger_s = float(trace_linger_s)
+        self._trace_max_open = int(trace_max_open)
+        self._offset_refresh_s = float(offset_refresh_s)
+        self._flight = flight
+        self._logger = logger or run_logger()
+        self._clock = clock
+        self._lock = threading.Lock()
+        # Serializes whole collection passes: tick() is called both by
+        # the background loop AND directly (bench_serve forces a scrape
+        # per sweep point) — concurrent passes would read the same
+        # cursors and ingest every span twice.
+        self._tick_lock = threading.Lock()
+        self._hosts: dict[str, _HostScrape] = {}
+        self._local_cursor = 0
+        # (host, metric) -> deque[(wall_ts, value)] with retention.
+        self._series: dict[tuple[str, str], deque] = {}
+        self._traces: dict[str, _OpenTrace] = {}
+        # span name -> recent durations (ms), bounded: a long-lived fleet
+        # with no drain_phase_stats() caller must not leak — the window
+        # semantics ("percentiles over recent spans") survive the cap.
+        self._phase: dict[str, deque] = {}
+        self._phase_cap = 8192
+        self._trace_fh = None
+        self._ticks = 0
+        self.stats = {
+            "scrapes": 0, "scrape_errors": 0, "spans_seen": 0,
+            "spans_dropped_by_ring": 0, "traces_kept": 0,
+            "traces_dropped": 0, "traces_pinned": 0, "resets": 0,
+            "negative_deltas": 0, "timeline_records": 0,
+        }
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if trace_out:
+            os.makedirs(os.path.dirname(trace_out) or ".", exist_ok=True)
+            self._trace_fh = open(trace_out, "a", buffering=1)
+
+    # ------------------------------------------------------------------ tap
+
+    def tap(self, writer):
+        """Wrap a ``MetricsWriter``-shaped sink: fleet/fault/rollback
+        records pin the currently-open traces on their way through (the
+        flight-recorder tap pattern — one seam wires every event source)."""
+        return _TappedWriter(writer, self)
+
+    def note_event(self, record: dict) -> None:
+        """Pin every open trace: a failover / injected fault / rollback
+        implicates exactly the requests in flight when it landed, and a
+        pinned trace survives tail sampling unconditionally."""
+        if record.get("kind") not in _PIN_KINDS:
+            return
+        with self._lock:
+            pinned = [t for t, ot in self._traces.items() if not ot.pinned]
+            for t in pinned:
+                self._traces[t].pinned = True
+            self.stats["traces_pinned"] += len(pinned)
+        if self._flight is not None and pinned:
+            # Link event → victim traces in the flight evidence: the ring
+            # already holds the event record itself; this note names the
+            # trace ids whose full span trees the tail sampler will keep.
+            self._flight.record({
+                "kind": "metrics", "counters": {}, "gauges": {},
+                "histograms": {}, "ts": time.time(),
+                "pinned_traces": pinned[:64],
+                "pinned_by": {
+                    "kind": record.get("kind"),
+                    "event": record.get("event") or record.get("reason"),
+                    "host": record.get("host"),
+                },
+            })
+
+    # ----------------------------------------------------------------- loop
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-collector", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — collection must not die
+                self._logger.warning("fleet collector tick failed: %s", e)
+
+    def stop(self, final: bool = True) -> None:
+        """Stop the loop; ``final=True`` runs one last scrape (hosts are
+        still up — call BEFORE the router closes them), forces every open
+        trace through the tail decision, and flushes the timelines."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if final:
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001
+                self._logger.warning("fleet collector final scrape: %s", e)
+            self._finalize_traces(force=True)
+            self._emit_timelines()
+        if self._trace_fh is not None:
+            self._trace_fh.close()
+            self._trace_fh = None
+
+    # ----------------------------------------------------------------- tick
+
+    def tick(self) -> None:
+        """One collection pass: scrape metrics + clocks + spans from every
+        live host, ingest the front door's own spans, advance the tail
+        sampler, and periodically emit timeline records. Drivable directly
+        (tests, the dryrun leg, bench's per-sweep-point scrape) or via the
+        background loop — passes serialize on the tick lock."""
+        with self._tick_lock:
+            self._tick_locked()
+
+    def _tick_locked(self) -> None:
+        now_wall = time.time()
+        try:
+            hosts = list(self._hosts_fn() or [])
+        except Exception as e:  # noqa: BLE001
+            self._logger.warning("fleet collector hosts_fn failed: %s", e)
+            hosts = []
+        for host in hosts:
+            st = self._hosts.setdefault(host.name, _HostScrape(host.name))
+            self._scrape_metrics(host, st, now_wall)
+            self._probe_clock(host, st)
+            self._scrape_traces(host, st)
+        if self._spans is not None:
+            out = self._spans.export(self._local_cursor)
+            self._local_cursor = out["next_seq"]
+            self.stats["spans_dropped_by_ring"] += out["dropped"]
+            self._ingest_spans(out["spans"], offset_s=0.0)
+        self._finalize_traces()
+        self._ticks += 1
+        if self._ticks % self._timeline_every == 0:
+            self._emit_timelines()
+
+    # ------------------------------------------------------------- metrics
+
+    def _scrape_metrics(self, host, st: _HostScrape, now_wall: float) -> None:
+        snapshot_fn = getattr(host, "snapshot", None)
+        if snapshot_fn is None:
+            return
+        try:
+            snap = snapshot_fn()
+        except Exception:  # noqa: BLE001 — a dead host skips this tick
+            self.stats["scrape_errors"] += 1
+            return
+        self.stats["scrapes"] += 1
+        seq = snap.get("seq")
+        start_ts = snap.get("start_ts")
+        # Reset detection (the /metricsz scrape-ambiguity satellite): a
+        # fresh process start_ts, or a seq that went BACKWARDS, means the
+        # counters restarted from zero — re-baseline, never book the drop
+        # as a negative rate. Old snapshots without the fields fall back
+        # to value-decrease detection per counter.
+        reset = False
+        if start_ts is not None and st.start_ts is not None:
+            reset = start_ts != st.start_ts
+        if not reset and seq is not None and st.seq is not None:
+            reset = seq < st.seq
+        if reset:
+            st.counters = {}
+            st.trace_cursor = 0  # the span seq space restarted too
+            st.resets += 1
+            self.stats["resets"] += 1
+            self._logger.info(
+                "collector: host %s restarted (counter baselines reset)",
+                st.name,
+            )
+        st.seq, st.start_ts = seq, start_ts
+        gauges = snap.get("gauges", {})
+        counters = snap.get("counters", {})
+        for name in _TIMELINE_GAUGES:
+            v = gauges.get(name)
+            if v is not None:
+                self._push_point(st.name, name, now_wall, float(v))
+        dt = None
+        if st.last_scrape_t is not None:
+            dt = max(now_wall - st.last_scrape_t, 1e-6)
+        for name in _TIMELINE_COUNTERS:
+            v = counters.get(name)
+            if v is None:
+                continue
+            v = float(v)
+            prev = st.counters.get(name)
+            st.counters[name] = v
+            if prev is None or dt is None:
+                continue  # baseline tick (fresh host or post-reset)
+            delta = v - prev
+            if delta < 0:
+                # No seq/start_ts evidence of a restart, yet the counter
+                # fell: re-baseline loudly — it must never become a
+                # negative rate on the timeline.
+                self.stats["negative_deltas"] += 1
+                self._logger.warning(
+                    "collector: counter %s on %s fell %s -> %s with no "
+                    "restart evidence — re-baselined", name, st.name, prev, v,
+                )
+                continue
+            self._push_point(st.name, name + ":rate", now_wall, delta / dt)
+        st.last_scrape_t = now_wall
+
+    def _push_point(self, host: str, metric: str, ts: float, v: float) -> None:
+        key = (host, metric)
+        ring = self._series.get(key)
+        if ring is None:
+            ring = self._series[key] = deque()
+        ring.append((round(ts, 3), round(v, 6)))
+        horizon = ts - self._retention_s
+        while ring and ring[0][0] < horizon:
+            ring.popleft()
+
+    # --------------------------------------------------------------- clocks
+
+    def _probe_clock(self, host, st: _HostScrape) -> None:
+        probe = getattr(host, "clock_probe", None)
+        if probe is None:
+            return
+        try:
+            rtt_s, offset_s = probe()
+        except Exception:  # noqa: BLE001
+            return
+        now = self._clock()
+        # Keep the tightest recent probe: offset error is bounded by
+        # RTT/2, so a smaller RTT is strictly better evidence; refresh
+        # even from a looser probe once the estimate has aged out.
+        if (
+            rtt_s <= st.offset_rtt_s
+            or now - st.offset_t > self._offset_refresh_s
+        ):
+            st.offset_rtt_s = rtt_s
+            st.offset_s = offset_s
+            st.offset_t = now
+
+    def offset_ms(self, host_name: str) -> float:
+        st = self._hosts.get(host_name)
+        return round(1e3 * st.offset_s, 3) if st is not None else 0.0
+
+    # --------------------------------------------------------------- traces
+
+    def _scrape_traces(self, host, st: _HostScrape) -> None:
+        traces_fn = getattr(host, "traces", None)
+        if traces_fn is None:
+            return
+        try:
+            out = traces_fn(st.trace_cursor)
+        except Exception:  # noqa: BLE001 — a dead host skips this tick
+            self.stats["scrape_errors"] += 1
+            return
+        gen = out.get("start_ts")
+        if (
+            st.trace_start_ts is not None
+            and gen is not None
+            and gen != st.trace_start_ts
+            and st.trace_cursor
+        ):
+            # A restarted host's recorder began a fresh seq space; our
+            # cursor belongs to the dead generation — rewind and re-read.
+            st.trace_cursor = 0
+            try:
+                out = traces_fn(0)
+            except Exception:  # noqa: BLE001
+                self.stats["scrape_errors"] += 1
+                return
+        st.trace_start_ts = gen
+        st.trace_cursor = out.get("next_seq", st.trace_cursor)
+        self.stats["spans_dropped_by_ring"] += out.get("dropped", 0)
+        self._ingest_spans(out.get("spans", ()), offset_s=st.offset_s)
+
+    def _ingest_spans(self, spans, offset_s: float) -> None:
+        if not spans:
+            return
+        now = self._clock()
+        with self._lock:
+            for s in spans:
+                s = dict(s)
+                s.pop("seq", None)
+                if offset_s:
+                    # Skew correction at ingest: host wall clocks map onto
+                    # the collector's time base, so cross-host spans order
+                    # correctly in the assembled waterfall.
+                    s["t0"] = round(s["t0"] - offset_s, 6)
+                    s["t1"] = round(s["t1"] - offset_s, 6)
+                    s["clock_offset_ms"] = round(1e3 * offset_s, 3)
+                self.stats["spans_seen"] += 1
+                dur = 1e3 * (s["t1"] - s["t0"])
+                ring = self._phase.get(s["name"])
+                if ring is None:
+                    ring = self._phase[s["name"]] = deque(
+                        maxlen=self._phase_cap
+                    )
+                ring.append(dur)
+                trace = s.get("trace")
+                if not trace:
+                    continue
+                ot = self._traces.get(trace)
+                if ot is None:
+                    if len(self._traces) >= self._trace_max_open:
+                        self._evict_oldest_locked()
+                    ot = self._traces[trace] = _OpenTrace(now)
+                ot.spans.append(s)
+                ot.last_update = now
+                if s["name"] == _ROOT_SPAN:
+                    ot.root = s
+
+    def _evict_oldest_locked(self) -> None:
+        oldest = min(self._traces, key=lambda t: self._traces[t].last_update)
+        self._traces.pop(oldest)
+        self.stats["traces_dropped"] += 1
+
+    def _keep(self, ot: _OpenTrace) -> bool:
+        if ot.pinned:
+            return True
+        root = ot.root
+        if root is None:
+            # Never completed at the front door (process death took the
+            # root, or the ring lapped it): exactly the shape worth keeping.
+            return True
+        attrs = root.get("attrs") or {}
+        if attrs.get("status") != "ok":
+            return True  # failed or rejected
+        if attrs.get("redispatches"):
+            return True
+        if self._slow_ms > 0 and 1e3 * (root["t1"] - root["t0"]) > self._slow_ms:
+            return True
+        for s in ot.spans:
+            # A failed attempt ANYWHERE in the tree keeps the trace even
+            # when the request recovered inline (a submit-failure retried
+            # inside one dispatch pass never increments redispatches).
+            a = s.get("attrs") or {}
+            if str(a.get("outcome", "")).startswith("failed"):
+                return True
+        return head_keep(root["trace"], self._sample_rate)
+
+    def _finalize_traces(self, force: bool = False) -> None:
+        now = self._clock()
+        done: list[tuple[str, _OpenTrace]] = []
+        with self._lock:
+            for trace, ot in list(self._traces.items()):
+                ripe = (
+                    ot.root is not None
+                    and now - ot.last_update >= self._trace_linger_s
+                )
+                if force or ripe:
+                    done.append((trace, ot))
+                    del self._traces[trace]
+        for trace, ot in done:
+            if self._keep(ot):
+                self.stats["traces_kept"] += 1
+                if self._trace_fh is not None:
+                    for s in sorted(ot.spans, key=lambda s: s["t0"]):
+                        self._trace_fh.write(json.dumps(s) + "\n")
+            else:
+                self.stats["traces_dropped"] += 1
+
+    # ------------------------------------------------------------ timelines
+
+    def _emit_timelines(self) -> None:
+        if self._metrics is None:
+            return
+        with self._lock:
+            series = {k: list(v) for k, v in self._series.items() if v}
+        for (host, metric), points in sorted(series.items()):
+            st = self._hosts.get(host)
+            rec = {
+                "kind": "timeline",
+                "host": host,
+                "metric": metric,
+                "points": [[ts, v] for ts, v in points],
+                "window_s": round(points[-1][0] - points[0][0], 3),
+                "clock_offset_ms": self.offset_ms(host),
+                "resets": st.resets if st is not None else 0,
+            }
+            self._metrics.write(rec)
+            self.stats["timeline_records"] += 1
+
+    # ---------------------------------------------------------- phase stats
+
+    def drain_phase_stats(self) -> dict:
+        """Per-span-name duration percentiles since the last drain — the
+        ``bench_serve`` per-sweep-point breakdown. Computed over EVERY
+        scraped span (tail sampling only gates trace *retention*, so the
+        percentiles are unbiased)."""
+        with self._lock:
+            phase, self._phase = self._phase, {}
+        out = {}
+        for name, ring in sorted(phase.items()):
+            durs = sorted(ring)
+            n = len(durs)
+            out[name] = {
+                "count": n,
+                "p50_ms": round(durs[max(0, math.ceil(0.50 * n) - 1)], 3),
+                "p99_ms": round(durs[max(0, math.ceil(0.99 * n) - 1)], 3),
+            }
+        return out
+
+
+def wire_fleet_obs(cfg, raw_metrics, hosts_fn, logger=None):
+    """The shared fleet-harness tracing/collector wiring — ONE place for
+    the construction order both ``FleetServer`` and ``RemoteFleet`` need
+    (a fix applied to one transport must not silently diverge the other):
+
+    - a ``SpanRecorder`` for the front door's own spans when tracing is
+      on (``cfg.trace_sample_rate > 0``);
+    - a fleet-process ``FlightRecorder`` when ``cfg.flight_dir`` is set
+      alongside the collector, so event pinning leaves its note in the
+      ring the event's own auto-dump captures;
+    - the ``FleetCollector`` over ``hosts_fn`` when
+      ``cfg.serve_collect_interval_s > 0``;
+    - the tapped writer with the collector tap OUTERMOST: the pinned-
+      trace note must enter the flight ring BEFORE the event record
+      itself lands there and triggers the auto-dump.
+
+    Returns ``(spans, collector, fleet_flight, metrics_writer)`` — any of
+    the first three None when its knob is off; the caller must
+    ``collector.start()`` only after the router exists (``hosts_fn`` is
+    usually a closure over it), and on close run ``collector.stop(final=
+    True)`` then ``fleet_flight.close()`` BEFORE closing the hosts."""
+    spans = None
+    if cfg.trace_sample_rate > 0:
+        spans = SpanRecorder()
+    collector = flight = None
+    metrics = raw_metrics
+    if cfg.serve_collect_interval_s > 0:
+        if cfg.flight_dir:
+            from mpi_pytorch_tpu.obs.flight import FlightRecorder
+
+            flight = FlightRecorder(
+                cfg.flight_dir, capacity=cfg.flight_records
+            )
+        collector = FleetCollector(
+            hosts_fn,
+            spans=spans,
+            metrics=raw_metrics,
+            trace_out=cfg.fleet_trace_file,
+            sample_rate=cfg.trace_sample_rate,
+            slow_ms=cfg.trace_slow_ms,
+            interval_s=cfg.serve_collect_interval_s,
+            flight=flight,
+            logger=logger,
+        )
+        inner = flight.tap(raw_metrics) if flight is not None else raw_metrics
+        metrics = collector.tap(inner)
+    return spans, collector, flight, metrics
+
+
+class _TappedWriter:
+    """MetricsWriter front that shows every record to the collector's
+    event pinning before forwarding (the flight-recorder tap pattern)."""
+
+    def __init__(self, inner, collector: FleetCollector):
+        self._inner = inner
+        self._collector = collector
+
+    def write(self, record) -> None:
+        try:
+            self._collector.note_event(record)
+        except Exception:  # noqa: BLE001 — pinning must not block the stream
+            pass
+        self._inner.write(record)
+
+    def close(self) -> None:
+        self._inner.close()

@@ -1,0 +1,178 @@
+"""Cross-process trace context + span export ring (ISSUE 13 tentpole 1).
+
+The Chrome tracer (``obs/trace.py``) answers "where did THIS process's
+time go"; it cannot follow one request across the fleet — a trace id
+minted in the router process died at ``POST /submit`` and the serving
+host's spans carried no identity a collector could join on. This module
+is the propagation layer:
+
+- **``TraceContext``** — a W3C-``traceparent``-style context: a 128-bit
+  ``trace_id`` minted ONCE at the front door (the fleet router, or the
+  bench client) and carried unchanged through every hop, plus the
+  64-bit ``span_id`` of the current parent span. ``format_traceparent``
+  / ``parse_traceparent`` are the wire form (the ``Traceparent`` header
+  on ``POST /submit`` / ``GET /result``): ``00-<32hex>-<16hex>-<2hex>``,
+  flags bit 0 = sampled.
+- **``SpanRecorder``** — a bounded ring of FINISHED spans with a
+  monotonic per-span sequence number, exported incrementally by cursor
+  (``export(since)`` — the ``/tracez`` endpoint and the in-process twin
+  the ``FleetCollector`` scrapes). Span timestamps are WALL clock
+  (``time.time()``): cross-process assembly needs one time base, and the
+  collector's probe-RTT clock-offset estimate corrects the residual
+  inter-host skew (``obs/collector.py``).
+
+Span record shape (one JSON-able dict per finished span)::
+
+    {"trace": <32hex>, "span": <16hex>, "parent": <16hex>|None,
+     "name": "serve/device", "host": "h1", "pid": 12345,
+     "t0": <epoch s>, "t1": <epoch s>, "attrs": {...}, "seq": N}
+
+Everything here is stdlib-only and inert until someone mints a context:
+an untraced request never touches a recorder, so the
+no-hot-path-cost-when-off invariant holds by construction.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+_TRACEPARENT = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """One hop's view of a trace: the request's fleet-wide identity plus
+    the span the next hop should parent under."""
+
+    trace_id: str
+    span_id: str
+    sampled: bool = True
+
+    def child(self) -> "TraceContext":
+        """Same trace, fresh span id — the context a sub-operation (one
+        dispatch attempt, one wire call) passes downstream."""
+        return TraceContext(self.trace_id, new_span_id(), self.sampled)
+
+
+def mint_trace(sampled: bool = True) -> TraceContext:
+    """A fresh root context — called ONCE per request at the front door."""
+    return TraceContext(new_trace_id(), new_span_id(), sampled)
+
+
+def format_traceparent(ctx: TraceContext) -> str:
+    return f"00-{ctx.trace_id}-{ctx.span_id}-{'01' if ctx.sampled else '00'}"
+
+
+def parse_traceparent(header: str | None) -> TraceContext | None:
+    """Strict parse; anything malformed is None (an untraced request),
+    never an error — a bad header must not fail the request it rides."""
+    if not header:
+        return None
+    m = _TRACEPARENT.match(header.strip().lower())
+    if m is None:
+        return None
+    trace_id, span_id, flags = m.groups()
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None  # the W3C all-zero invalid ids
+    try:
+        sampled = bool(int(flags, 16) & 0x01)
+    except ValueError:  # pragma: no cover — regex already guarantees hex
+        return None
+    return TraceContext(trace_id, span_id, sampled)
+
+
+def head_keep(trace_id: str, rate: float) -> bool:
+    """The deterministic head-sampling decision: keep ~``rate`` of
+    traces by hashing the trace id (no RNG state, so every process —
+    and a re-run of the collector — agrees on the same subset)."""
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return int(trace_id[:8], 16) / 0x100000000 < rate
+
+
+class SpanRecorder:
+    """Bounded ring of finished spans, exported by cursor.
+
+    ``add`` is O(1) under one small lock; overwritten (never-exported)
+    spans are counted in ``dropped`` so a slow scraper knows the ring
+    lapped it instead of silently missing spans. ``start_ts`` identifies
+    the recorder's process generation: a restarted host starts a fresh
+    recorder, and the collector resets its cursor when ``start_ts``
+    changes (the seq space restarted with the process)."""
+
+    def __init__(self, capacity: int = 4096):
+        if capacity < 1:
+            raise ValueError(f"span capacity must be >= 1, got {capacity}")
+        self._capacity = capacity
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._next_seq = 0
+        self.start_ts = time.time()
+
+    def add(
+        self,
+        *,
+        name: str,
+        trace: str,
+        span: str | None = None,
+        parent: str | None = None,
+        t0: float,
+        t1: float,
+        host: str,
+        attrs: dict | None = None,
+    ) -> dict:
+        rec = {
+            "trace": trace,
+            "span": span or new_span_id(),
+            "parent": parent,
+            "name": name,
+            "host": host,
+            "pid": os.getpid(),
+            "t0": round(float(t0), 6),
+            "t1": round(float(t1), 6),
+        }
+        if attrs:
+            rec["attrs"] = dict(attrs)
+        with self._lock:
+            rec["seq"] = self._next_seq
+            self._next_seq += 1
+            self._ring.append(rec)
+        return rec
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def export(self, since: int = 0, limit: int = 4096) -> dict:
+        """Spans with ``seq >= since`` (up to ``limit``), the next cursor,
+        and how many spans the ring dropped before the cursor could see
+        them — the ``/tracez`` payload."""
+        since = max(0, int(since))
+        with self._lock:
+            oldest = self._next_seq - len(self._ring)
+            dropped = max(0, oldest - since)
+            spans = [s for s in self._ring if s["seq"] >= since][:limit]
+            next_seq = spans[-1]["seq"] + 1 if spans else max(since, oldest)
+        return {
+            "spans": spans,
+            "next_seq": next_seq,
+            "dropped": dropped,
+            "start_ts": self.start_ts,
+        }

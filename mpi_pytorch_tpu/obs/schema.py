@@ -27,8 +27,9 @@ Record kinds (every record also carries ``ts``, the epoch-seconds stamp
 | rollback  | epoch, reason                                       | step, restored_epoch, rollbacks, lr_scale, path, detail |
 | metrics   | counters, gauges, histograms                        | merged_hosts |
 | alert     | rule, severity                                      | metric, value, threshold, streak, action, detail, epoch, step |
-| route     | host, requests                                      | share, score, queue_depth, inflight, window_s, transport |
+| route     | host, requests                                      | share, score, queue_depth, inflight, window_s, transport, trace_ids |
 | fleet     | event                                               | host, detail, redispatched, spare, max_wait_ms_from/to, buckets_from/to, p99_ms, target_p99_ms, compiles_after_warmup, hosts_from/to, reason, reject_rate, queue_depth, restarts, transport |
+| timeline  | host, metric, points                                | window_s, clock_offset_ms, resets |
 
 ``serve`` is the per-flush record the online inference server writes
 (serve/server.py: one coalesced batch dispatched to a bucket executable);
@@ -109,7 +110,22 @@ from typing import Any, Mapping
 #      processes over the wire — stamped only when the axis is live, so
 #      in-process streams stay byte-identical to prior generations, and
 #      ``check_regression`` keys it into the serve trend-line identity).
-SCHEMA_VERSION = 8
+#   9: the distributed-tracing generation (ISSUE 13): the ``timeline``
+#      kind — one per-(host, metric) time-series window from the fleet
+#      collector (``obs/collector.py``: gauge samples / counter RATES as
+#      ``points`` [[ts, value], ...], the host's probe-RTT clock-offset
+#      estimate, and how many counter RESETS — host restarts — the
+#      collector absorbed instead of booking negative rates); optional
+#      ``trace_ids`` on ``serve`` flushes and ``route`` windows (the
+#      W3C-traceparent-style trace ids of the TRACED requests they
+#      carried — absent on untraced traffic, so tracing-off streams stay
+#      byte-identical to v8); optional ``trace_id`` on ``fault`` records
+#      (a fault gate firing inside a traced request names its victim
+#      trace, so chaos evidence joins the exact waterfall it disrupted);
+#      and optional ``per_phase`` on ``serve_bench`` rows (the
+#      collector-derived queue/preprocess/device/wire p50/p99 breakdown
+#      per sweep point).
+SCHEMA_VERSION = 9
 
 _NUM = (int, float)
 _INT = (int,)
@@ -155,6 +171,9 @@ REQUIRED: dict[str, dict[str, tuple]] = {
     "quant_parity": {
         "precision": (str,), "top1_agree": _NUM, "samples": _INT,
     },
+    # v9: one per-(host, metric) time-series window from the fleet
+    # collector (obs/collector.py) — points are [[wall_ts, value], ...].
+    "timeline": {"host": (str,), "metric": (str,), "points": (list,)},
 }
 
 OPTIONAL: dict[str, dict[str, tuple]] = {
@@ -190,6 +209,11 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # stamped when the server holds multiple precision sets or serves
         # non-bf16 (pure-bf16 servers keep v6-identical records).
         "precision": (str,),
+        # v9: the trace ids of the TRACED requests this flush carried —
+        # absent on untraced traffic (tracing-off streams stay
+        # byte-identical to v8; the no-hot-path-cost invariant's record
+        # half).
+        "trace_ids": (list,),
     },
     "serve_bench": {
         "model": (str,), "offered_rps": _NUM, "rejected": _INT,
@@ -207,6 +231,11 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # processes over the wire) — a remote row is a different trend
         # line than an in-process one (check_regression keys it).
         "transport": (str,),
+        # v9: the collector-derived per-phase latency breakdown for this
+        # sweep point (span name → {count, p50_ms, p99_ms} — the
+        # queue/preprocess/device/wire attribution; absent without a
+        # collector, so pre-v9 rows compare unchanged).
+        "per_phase": (dict,),
     },
     "resume": {
         "from_devices": _INT, "from_mesh": (str,), "to_mesh": (str,),
@@ -217,7 +246,14 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # step-in-epoch the run continues at when the cursor validates.
         "cursor_epoch": _INT, "cursor_step": _INT,
     },
-    "fault": {"epoch": _INT, "step": _INT, "detail": (str,), "streak": _INT},
+    # v9 trace_id: a fault gate that fired INSIDE a traced request (the
+    # router's kill gate striking a traced dispatch, a preprocess crash
+    # taking a traced flush) stamps the victim's trace id, so the chaos
+    # evidence links to the exact waterfall it disrupted.
+    "fault": {
+        "epoch": _INT, "step": _INT, "detail": (str,), "streak": _INT,
+        "trace_id": (str,),
+    },
     # v5: fleet routing/lifecycle fields. ``route`` is a per-host window:
     # requests dispatched there since the last record, the router's
     # smoothed load score and the host's queue/in-flight state when the
@@ -231,6 +267,9 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # v8: the host's transport ("http" = a real serving process over
         # the wire; absent = in-process LocalHost, streams unchanged).
         "transport": (str,),
+        # v9: the traced requests dispatched to this host in the window
+        # (bounded; absent when tracing is off — streams unchanged).
+        "trace_ids": (list,),
     },
     "fleet": {
         "host": (str,), "detail": (str,), "redispatched": _INT,
@@ -269,6 +308,12 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
     # v7: top5_agree is null for fused (argmax-only) contracts.
     "quant_parity": {
         "top5_agree": _NUM, "max_logit_drift": _NUM, "model": (str,),
+    },
+    # v9: window span of the points, the host's probe-RTT clock-offset
+    # estimate (ms — what skew-corrects its span timestamps), and how
+    # many counter resets (host restarts) the collector absorbed.
+    "timeline": {
+        "window_s": _NUM, "clock_offset_ms": _NUM, "resets": _INT,
     },
 }
 

@@ -110,6 +110,11 @@ class PendingRequest:
     # phase span it rides (preprocess/dispatch/fetch), so one request's
     # path threads through the trace end to end. -1 = untraced.
     req_id: int = -1
+    # Cross-process trace context (obs/context.TraceContext), minted at
+    # the fleet front door and carried over the wire as a traceparent
+    # header (ISSUE 13). None = untraced — the default, and the request
+    # then costs nothing on any tracing seam.
+    trace: Any = None
 
 
 class DynamicBatcher:

@@ -127,6 +127,7 @@ class RemoteHost:
         facts_ttl_s: float = 0.2,
         seed: int = 0,
         logger=None,
+        spans=None,
     ):
         from mpi_pytorch_tpu.utils.logging import run_logger
 
@@ -142,6 +143,10 @@ class RemoteHost:
         self._facts_ttl_s = float(facts_ttl_s)
         self._rng = random.Random(seed)
         self._closed = False
+        # Router-process span ring for the WIRE halves of a traced
+        # request (wire/submit POST, wire/result long-poll) — None keeps
+        # the transport fully inert for tracing (ISSUE 13).
+        self._spans = spans
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, pollers),
             thread_name_prefix=f"remote-{name}",
@@ -163,6 +168,7 @@ class RemoteHost:
     def _request(
         self, method: str, path: str, body: bytes | None = None, *,
         timeout: float, retries: int = 0, ctype: str = "application/json",
+        headers: dict | None = None,
     ) -> bytes:
         """One wire call with bounded jittered retries on TRANSPORT
         failures only (the idempotent-probe discipline — callers pass
@@ -171,9 +177,11 @@ class RemoteHost:
         last: Exception | None = None
         for attempt in range(retries + 1):
             try:
+                hdrs = dict(headers or {})
+                if body is not None:
+                    hdrs["Content-Type"] = ctype
                 req = urllib.request.Request(
-                    url, data=body, method=method,
-                    headers={"Content-Type": ctype} if body is not None else {},
+                    url, data=body, method=method, headers=hdrs,
                 )
                 with urllib.request.urlopen(req, timeout=timeout) as resp:
                     return resp.read()
@@ -228,40 +236,70 @@ class RemoteHost:
 
     # ------------------------------------------------------------- requests
 
-    def submit(self, image) -> Future:
+    def submit(self, image, trace=None) -> Future:
         """POST the request bytes; the future resolves from the result
         long-poll. NO wire retries: a submit is not idempotent, and a
         failed submit is exactly the signal the router's drain streak
-        and re-dispatch machinery exist to consume."""
+        and re-dispatch machinery exist to consume.
+
+        ``trace`` (optional ``obs.TraceContext``) rides the wire as a
+        W3C-style ``Traceparent`` header — the serving process parents
+        its queue/preprocess/device spans under it — and the wire halves
+        (this POST, the result long-poll) land as spans in the router
+        process's ring (ISSUE 13)."""
         if self._closed:
             raise ServerClosedError(f"remote host {self.name} is closed")
         buf = io.BytesIO()
         np.save(buf, np.asarray(image), allow_pickle=False)
+        headers = None
+        t_wire = 0.0
+        if trace is not None:
+            from mpi_pytorch_tpu.obs.context import format_traceparent
+
+            headers = {"Traceparent": format_traceparent(trace)}
+            t_wire = time.time()
         resp = json.loads(self._request(
             "POST", "/submit", buf.getvalue(),
             timeout=self.connect_timeout_s, retries=0,
-            ctype="application/octet-stream",
+            ctype="application/octet-stream", headers=headers,
         ).decode())
         rid = resp["req_id"]
+        if trace is not None and self._spans is not None:
+            self._spans.add(
+                name="wire/submit", trace=trace.trace_id,
+                parent=trace.span_id, t0=t_wire, t1=time.time(),
+                host="router", attrs={"host": self.name, "req_id": rid},
+            )
         fut: Future = Future()
         try:
-            self._pool.submit(self._poll_result, rid, fut)
+            self._pool.submit(self._poll_result, rid, fut, headers, trace)
         except RuntimeError as e:  # pool shut down under us (kill/close)
             raise HostUnavailableError(
                 f"remote host {self.name} poller is shut down: {e}"
             ) from None
         return fut
 
-    def _poll_result(self, rid: int, fut: Future) -> None:
+    def _poll_result(self, rid: int, fut: Future, headers=None,
+                     trace=None) -> None:
         deadline = time.monotonic() + self.result_timeout_s
         transport_strikes = 0
+        t_wire = time.time() if trace is not None else 0.0
         while True:
             try:
                 data = self._request(
                     "GET", f"/result/{rid}?timeout_s={self.poll_slice_s}",
                     timeout=self.poll_slice_s + self.read_timeout_s,
-                    retries=0,
+                    retries=0, headers=headers,
                 )
+                if trace is not None and self._spans is not None:
+                    # The delivery half of the wire phase: first poll →
+                    # result bytes in hand.
+                    self._spans.add(
+                        name="wire/result", trace=trace.trace_id,
+                        parent=trace.span_id, t0=t_wire, t1=time.time(),
+                        host="router",
+                        attrs={"host": self.name, "req_id": rid},
+                    )
                 fut.set_result(np.load(io.BytesIO(data), allow_pickle=False))
                 return
             except _PendingResult:
@@ -318,6 +356,30 @@ class RemoteHost:
             "GET", "/statsz", timeout=self.connect_timeout_s,
             retries=self.probe_retries,
         )
+
+    def traces(self, since: int = 0) -> dict:
+        """Drain the host's span-export ring from ``since`` — the
+        collector's /tracez scrape (idempotent read → probe retries)."""
+        return self._request_json(
+            "GET", f"/tracez?since={int(since)}",
+            timeout=self.connect_timeout_s, retries=self.probe_retries,
+        )
+
+    def clock_probe(self) -> tuple:
+        """(rtt_s, offset_s): the host's wall-clock offset estimated from
+        the probe's RTT midpoint — a fresh ``/healthz`` read (never the
+        facts cache: a cached ``time`` would book the cache age as clock
+        skew). Offset error is bounded by rtt/2, which is why the
+        collector keeps the tightest recent probe."""
+        t0 = time.time()
+        facts = self._request_json(
+            "GET", "/healthz", timeout=self.connect_timeout_s, retries=0,
+        )
+        t1 = time.time()
+        host_time = facts.get("time")
+        if host_time is None:
+            return (t1 - t0, 0.0)
+        return (t1 - t0, float(host_time) - (t0 + t1) / 2.0)
 
     def compiles_after_warmup(self) -> int:
         return int(self._facts().get("compiles_after_warmup") or 0)
@@ -671,6 +733,11 @@ _CHILD_EXCLUDE = frozenset({
     "metrics_file", "log_file", "eval_log_file", "trace_file",
     "serve_port", "serve_port_file", "serve_host_index",
     "serve_metrics_port", "flight_dir",
+    # Tracing/collector knobs are fleet-front-door-only (ISSUE 13): a
+    # serving child follows incoming Traceparent headers and exports its
+    # span ring over /tracez — it mints nothing and collects nothing.
+    "trace_sample_rate", "trace_slow_ms", "serve_collect_interval_s",
+    "fleet_trace_file",
 })
 
 
@@ -760,7 +827,20 @@ class RemoteFleet:
         self._repo = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))
         )))
-        self._metrics = MetricsWriter(cfg.metrics_file)
+        self._raw_metrics = MetricsWriter(cfg.metrics_file)
+        # Fleet-wide tracing + collector (ISSUE 13): the router process
+        # owns the front-door span ring (router spans + the RemoteHosts'
+        # wire spans); the collector scrapes it alongside every child's
+        # /metricsz + /tracez, and fleet/fault records passing through
+        # the tapped stream pin their in-flight traces. (flight_dir is
+        # child-excluded — children keep their own recorders.)
+        from mpi_pytorch_tpu.obs.collector import wire_fleet_obs
+
+        (self.spans, self.collector, self._fleet_flight,
+         self._metrics) = wire_fleet_obs(
+            cfg, self._raw_metrics,
+            lambda: self.router.active_hosts(), logger=self._logger,
+        )
         self._next_index = 0
         self._closed = False
 
@@ -791,7 +871,7 @@ class RemoteFleet:
                 except Exception:  # noqa: BLE001
                     pass
                 _terminate(proc)
-            self._metrics.close()
+            self._raw_metrics.close()
             raise
 
         hosts = [spawned[i][1] for i in indices[:n]]
@@ -805,7 +885,11 @@ class RemoteFleet:
             fail_probes=cfg.serve_fail_probes,
             warmup_payload=warmup_payload,
             logger=self._logger,
+            trace_sample_rate=cfg.trace_sample_rate,
+            spans=self.spans,
         )
+        if self.collector is not None:
+            self.collector.start()
         self.supervisor = HostSupervisor(
             self._spawn, router=self.router, metrics=self._metrics,
             logger=self._logger,
@@ -885,6 +969,7 @@ class RemoteFleet:
                 read_timeout_s=self.cfg.serve_read_timeout_s,
                 probe_retries=self.cfg.serve_probe_retries,
                 logger=self._logger,
+                spans=self.spans,
             )
         except BaseException:
             _terminate(proc)
@@ -999,6 +1084,14 @@ class RemoteFleet:
         if self.controller is not None:
             self.controller.stop()
         self.supervisor.stop()
+        # Collector stops BEFORE the router closes the children: the
+        # final scrape drains their /tracez rings over the wire, forces
+        # every open trace through the tail decision, and flushes the
+        # timelines.
+        if self.collector is not None:
+            self.collector.stop(final=True)
+        if self._fleet_flight is not None:
+            self._fleet_flight.close()
         # Router close drains every host handle (wire shutdown → children
         # exit); then reap whatever lingers.
         self.router.close()
@@ -1007,7 +1100,7 @@ class RemoteFleet:
                 proc.wait(timeout=15)
             except subprocess.TimeoutExpired:
                 _terminate(proc)
-        self._metrics.close()
+        self._raw_metrics.close()
 
     def __enter__(self) -> "RemoteFleet":
         return self

@@ -80,6 +80,9 @@ class _HostState:
     outstanding: int = 0  # router-tracked in-flight (po2 fallback input)
     dispatched_total: int = 0
     window_requests: int = 0  # dispatches since the last route record
+    # Trace ids of TRACED requests dispatched here this window (bounded;
+    # stamped on the route record — empty/absent when tracing is off).
+    window_traces: list = field(default_factory=list)
 
 
 @dataclass
@@ -92,6 +95,11 @@ class _Flight:
     future: Future
     host: str | None = None  # current assignment (None while re-dispatching)
     redispatches: int = 0
+    # Cross-process trace context minted at admission (None = untraced):
+    # the trace id every dispatch attempt, wire hop, and host-side span
+    # of this request carries (ISSUE 13).
+    trace: object = None
+    t_submit_wall: float = 0.0
     # True between a re-dispatch CLAIM and the new host assignment — the
     # claim marker that keeps a probe-driven drain and a concurrent
     # failure callback from both re-dispatching this flight (entry.host
@@ -118,12 +126,25 @@ class LocalHost:
         self.index = server.host_index
 
     # -- request path -------------------------------------------------
-    def submit(self, image) -> Future:
+    def submit(self, image, trace=None) -> Future:
+        if trace is not None:
+            return self.server.submit(image, trace=trace)
         return self.server.submit(image)
 
     # -- telemetry / control ------------------------------------------
     def snapshot(self) -> dict:
         return self.server.registry_snapshot()
+
+    def traces(self, since: int = 0) -> dict:
+        """The host's span-export ring (the collector's in-process scrape
+        — the /tracez twin)."""
+        return self.server.traces(since)
+
+    def clock_probe(self) -> tuple:
+        """(rtt_s, clock_offset_s). An in-process host shares the
+        collector's clock: zero RTT, zero offset — the mechanism exists
+        for the remote twin, where the probe measures real skew."""
+        return (0.0, 0.0)
 
     def alive(self) -> bool:
         return not self.server._batcher.closed
@@ -208,6 +229,8 @@ class FleetRouter:
         warmup_payload=None,
         logger=None,
         seed: int = 0,
+        trace_sample_rate: float = 0.0,
+        spans=None,
     ):
         if not hosts:
             raise ValueError("a fleet needs at least one serving host")
@@ -215,6 +238,20 @@ class FleetRouter:
 
         self._logger = logger or run_logger()
         self._metrics = metrics
+        # Distributed tracing (ISSUE 13): rate > 0 mints a W3C-style
+        # trace context per admitted request AT THE FRONT DOOR and
+        # records the router-side spans (admission, every dispatch
+        # attempt, the end-to-end root) into ``spans`` — the ring the
+        # fleet collector scrapes. The rate itself is the collector's
+        # HEAD-sample keep fraction; the router records everything so
+        # tail sampling can keep slow/failed/re-dispatched traces it
+        # could not have predicted. 0 (default) = fully inert.
+        self._trace_rate = float(trace_sample_rate)
+        if self._trace_rate > 0 and spans is None:
+            from mpi_pytorch_tpu.obs.context import SpanRecorder
+
+            spans = SpanRecorder()
+        self.spans = spans
         self._lock = threading.Lock()
         self._active = list(hosts)
         self._spare = spare
@@ -269,17 +306,43 @@ class FleetRouter:
         overflow — and ``NoLiveHostError`` when every host is drained."""
         if self._closed:
             raise ServerClosedError("fleet router is shut down")
+        trace = None
+        if self._trace_rate > 0:
+            from mpi_pytorch_tpu.obs.context import mint_trace
+
+            trace = mint_trace()
         with self._lock:
             if self._tokens <= 0:
                 self.front_door_rejections += 1
+                hint = self._retry_hint_locked()
+                if trace is not None:
+                    # A rejected request still leaves a (zero-length)
+                    # root span: tail sampling keeps every rejection.
+                    now = time.time()
+                    self.spans.add(
+                        name="route/request", trace=trace.trace_id,
+                        span=trace.span_id, t0=now, t1=now, host="router",
+                        attrs={"status": "rejected", "redispatches": 0,
+                               "retry_after_ms": hint},
+                    )
                 raise QueueFullError(
                     f"fleet admission budget exhausted ({self.budget} "
                     "in flight); retry later",
-                    retry_after_ms=self._retry_hint_locked(),
+                    retry_after_ms=hint,
                 )
             self._tokens -= 1
-            entry = _Flight(next(self._ids), image, Future())
+            entry = _Flight(
+                next(self._ids), image, Future(),
+                trace=trace, t_submit_wall=time.time() if trace else 0.0,
+            )
             self._inflight[entry.fid] = entry
+        if trace is not None:
+            # The admission phase: token acquired, host not yet picked.
+            self.spans.add(
+                name="route/admission", trace=trace.trace_id,
+                parent=trace.span_id, t0=entry.t_submit_wall,
+                t1=time.time(), host="router",
+            )
         try:
             self._dispatch(entry)
         except BaseException:
@@ -322,13 +385,31 @@ class FleetRouter:
                 st.dispatched_total += 1
                 st.window_requests += 1
                 dispatched_total = st.dispatched_total
-            self._maybe_kill_gate(host, dispatched_total)
+                if entry.trace is not None and len(st.window_traces) < 32:
+                    st.window_traces.append(entry.trace.trace_id)
+            self._maybe_kill_gate(host, dispatched_total, entry)
+            # One dispatch-attempt span per assignment (a re-dispatched
+            # request carries one per attempt — BOTH attempts survive in
+            # the trace): the child context's span id is what the host's
+            # spans parent under, across the wire or not.
+            d_ctx, d_t0, attempt = None, 0.0, entry.redispatches + 1
+            if entry.trace is not None:
+                d_ctx = entry.trace.child()
+                d_t0 = time.time()
             try:
-                hfut = host.submit(entry.payload)
+                if d_ctx is not None:
+                    hfut = host.submit(entry.payload, trace=d_ctx)
+                else:
+                    hfut = host.submit(entry.payload)
             except BaseException as e:  # noqa: BLE001 — per-host trouble
                 with self._lock:
                     self._state[host.name].outstanding -= 1
                     entry.host = None
+                if d_ctx is not None:
+                    self._record_dispatch_span(
+                        entry, d_ctx, d_t0, host, attempt,
+                        outcome=f"failed:{type(e).__name__}",
+                    )
                 if isinstance(e, QueueFullError):
                     # Host-level backpressure despite scoring (burst);
                     # spill to the next-best host, give up only when
@@ -350,9 +431,20 @@ class FleetRouter:
                     continue
                 raise
             hfut.add_done_callback(
-                lambda f, h=host: self._on_host_done(entry, h, f)
+                lambda f, h=host, c=d_ctx, t0=d_t0, a=attempt:
+                self._on_host_done(entry, h, f, c, t0, a)
             )
             return
+
+    def _record_dispatch_span(self, entry, d_ctx, d_t0, host, attempt,
+                              outcome):
+        self.spans.add(
+            name="route/dispatch", trace=d_ctx.trace_id, span=d_ctx.span_id,
+            parent=entry.trace.span_id, t0=d_t0, t1=time.time(),
+            host="router",
+            attrs={"host": host.name, "attempt": attempt,
+                   "outcome": outcome},
+        )
 
     def _pick(self, exclude: frozenset = frozenset()):
         """Lowest EWMA score among hosts with a FRESH snapshot; stale →
@@ -393,12 +485,18 @@ class FleetRouter:
                 (a, b), key=lambda h: self._state[h.name].outstanding
             )
 
-    def _on_host_done(self, entry: _Flight, host, fut) -> None:
+    def _on_host_done(self, entry: _Flight, host, fut, d_ctx=None,
+                      d_t0=0.0, attempt=1) -> None:
         exc = fut.exception()
         with self._lock:
             st = self._state.get(host.name)
             if st is not None:
                 st.outstanding = max(0, st.outstanding - 1)
+        if d_ctx is not None:
+            self._record_dispatch_span(
+                entry, d_ctx, d_t0, host, attempt,
+                outcome="ok" if exc is None else f"failed:{type(exc).__name__}",
+            )
         if exc is None:
             with self._lock:
                 if self._state.get(host.name) is not None:
@@ -435,6 +533,23 @@ class FleetRouter:
                     else 0.9 * self._done_rate + 0.1 * inst
                 )
             self._done_t = now
+        if entry.trace is not None:
+            # The end-to-end ROOT span — exactly one completion per
+            # trace (duplicate completions returned above). Its status/
+            # redispatches attrs are the tail sampler's keep evidence.
+            if error is None:
+                status = "ok"
+            elif isinstance(error, QueueFullError):
+                status = "rejected"
+            else:
+                status = f"failed:{type(error).__name__}"
+            self.spans.add(
+                name="route/request", trace=entry.trace.trace_id,
+                span=entry.trace.span_id, t0=entry.t_submit_wall,
+                t1=time.time(), host="router",
+                attrs={"status": status,
+                       "redispatches": entry.redispatches},
+            )
         if error is not None:
             entry.future.set_exception(error)
         else:
@@ -557,11 +672,14 @@ class FleetRouter:
         except Exception as e:  # noqa: BLE001 — it is already dead to us
             self._logger.warning("fleet: drained-host close failed: %s", e)
 
-    def _maybe_kill_gate(self, host, dispatched_total: int) -> None:
+    def _maybe_kill_gate(self, host, dispatched_total: int,
+                         entry: _Flight | None = None) -> None:
         """Deterministic chaos (registered serve fault gates): hard-kill
         the targeted host after its Nth dispatched request, announcing
         with a ``kind="fault"`` record first — the inject_faults.py
-        discipline (a gate never strikes silently)."""
+        discipline (a gate never strikes silently). When the striking
+        request is TRACED, the record stamps its trace id (schema v9), so
+        the chaos evidence links to the exact victim waterfall."""
         from mpi_pytorch_tpu.utils.env import env_int
 
         after = env_int("MPT_FAULT_SERVE_KILL_AFTER", 0)
@@ -577,11 +695,14 @@ class FleetRouter:
                 return
             self._kill_gate_fired = True
         if self._metrics is not None:
-            self._metrics.write({
+            rec = {
                 "kind": "fault",
                 "reason": "injected_host_kill",
                 "detail": f"host {host.name} after {after} dispatches",
-            })
+            }
+            if entry is not None and entry.trace is not None:
+                rec["trace_id"] = entry.trace.trace_id
+            self._metrics.write(rec)
         threading.Thread(
             target=self._safe_kill, args=(host,), name="fleet-kill-gate",
             daemon=True,
@@ -715,6 +836,11 @@ class FleetRouter:
                     # Schema-v8: stamp only when the axis is live, so
                     # in-process streams stay byte-identical to v5.
                     row["transport"] = transport
+                if st.window_traces:
+                    # Schema-v9: the traced requests this window carried
+                    # (absent when tracing is off — records unchanged).
+                    row["trace_ids"] = list(st.window_traces)
+                    st.window_traces = []
                 rows.append(row)
                 row_hosts.append(h)
                 st.window_requests = 0

@@ -105,7 +105,18 @@ class FleetServer:
                 exe.warmup()
         self._exe = executables
 
-        self._metrics = MetricsWriter(cfg.metrics_file)
+        self._raw_metrics = MetricsWriter(cfg.metrics_file)
+        # Fleet-wide tracing + collector (ISSUE 13): one span ring for
+        # the front door's router spans; the collector scrapes it plus
+        # every host's in-process /tracez twin, and fleet/fault records
+        # passing through the tapped stream pin their in-flight traces.
+        from mpi_pytorch_tpu.obs.collector import wire_fleet_obs
+
+        (self.spans, self.collector, self._fleet_flight,
+         self._metrics) = wire_fleet_obs(
+            cfg, self._raw_metrics,
+            lambda: self.router.active_hosts(), logger=self._logger,
+        )
         total = n + (1 if want_spare else 0)
         servers = []
         try:
@@ -117,7 +128,7 @@ class FleetServer:
         except BaseException:
             for s in servers:
                 s.close(drain=False)
-            self._metrics.close()
+            self._raw_metrics.close()
             raise
         self._servers = servers
         hosts = [LocalHost(s) for s in servers[:n]]
@@ -134,7 +145,11 @@ class FleetServer:
             fail_probes=cfg.serve_fail_probes,
             warmup_payload=warmup_payload,
             logger=self._logger,
+            trace_sample_rate=cfg.trace_sample_rate,
+            spans=self.spans,
         )
+        if self.collector is not None:
+            self.collector.start()
         self.controller = None
         if cfg.serve_target_p99_ms > 0:
             self.controller = FleetController(
@@ -272,10 +287,17 @@ class FleetServer:
             self.autoscaler.stop()
         if self.controller is not None:
             self.controller.stop()
+        # Collector stops BEFORE the router closes the hosts: the final
+        # scrape needs live /tracez rings, and stop() forces every open
+        # trace through the tail decision + flushes the timelines.
+        if self.collector is not None:
+            self.collector.stop(final=True)
+        if self._fleet_flight is not None:
+            self._fleet_flight.close()
         # Router close drains every host (spare included); each host
         # flushes its final registry snapshot into the shared stream.
         self.router.close()
-        self._metrics.close()
+        self._raw_metrics.close()
 
     def __enter__(self) -> "FleetServer":
         return self

@@ -31,7 +31,15 @@ Wire protocol (all bodies bounded, all reads timed — ``serve/http.py``):
   typed 400 the in-process call would raise).
 - ``GET /statsz`` / ``/metricsz`` / ``/metrics`` / ``/healthz`` — the
   probe surface (``/healthz`` carries the static host facts: queue
-  capacity, compiled buckets, precisions, pid).
+  capacity, compiled buckets, precisions, pid — plus ``time``, the
+  collector's clock-probe read). ``/metricsz`` snapshots carry a
+  monotonic ``seq`` + process ``start_ts`` so a scraper can tell a
+  counter reset (restart) from a negative delta (ISSUE 13).
+- ``GET /tracez?since=N`` — the bounded span-export ring: finished
+  host-side spans (queue/preprocess/device per traced request), exported
+  incrementally by cursor to the fleet collector. A ``Traceparent``
+  header on ``POST /submit`` / ``GET /result`` threads the front door's
+  trace id through this host's spans (W3C-style; ``obs/context.py``).
 
 Readiness: after warmup the process atomically writes ``--serve-port-file``
 (JSON ``{"port", "pid", "host_index"}``) and prints a ``SERVE_HOST_READY``
@@ -132,7 +140,8 @@ class ServingHost:
             port=port,
             metricsz=metricsz,
             get_routes={"/result/": self._handle_result,
-                        "/statsz": self._handle_statsz},
+                        "/statsz": self._handle_statsz,
+                        "/tracez": self._handle_tracez},
             post_routes={"/submit": self._handle_submit,
                          "/control": self._handle_control},
             read_timeout_s=read_timeout_s,
@@ -160,8 +169,18 @@ class ServingHost:
                 "error": "bad_request", "taxonomy": "request",
                 "detail": f"request body is not .npy bytes ({e})",
             })
+        # The trace thread crossing the wire (ISSUE 13): a traceparent
+        # header minted at the fleet front door parents this host's
+        # queue/preprocess/device spans; a malformed or absent header is
+        # an untraced request, never an error.
+        from mpi_pytorch_tpu.obs.context import parse_traceparent
+
+        ctx = parse_traceparent(self.http.request_headers().get("Traceparent"))
         try:
-            fut = self.server.submit(image)
+            if ctx is not None:
+                fut = self.server.submit(image, trace=ctx)
+            else:
+                fut = self.server.submit(image)
         except QueueFullError as e:
             hint = e.retry_after_ms
             headers = {}
@@ -226,6 +245,24 @@ class ServingHost:
                 self._results[rid][2] = time.monotonic()  # delivered
         return (200, "application/octet-stream",
                 _npy_bytes(np.asarray(preds)), {})
+
+    def _handle_tracez(self, path, query, body):
+        """The bounded span-export ring (ISSUE 13): incremental by
+        ``?since=<cursor>``; the payload's ``start_ts`` is the recorder
+        generation, so a collector whose cursor outlived this process's
+        predecessor knows to rewind."""
+        since = 0
+        for part in query.split("&"):
+            if part.startswith("since="):
+                try:
+                    since = int(part[6:])
+                except ValueError:
+                    pass
+        traces_fn = getattr(self.server, "traces", None)
+        if traces_fn is None:
+            return self._json(200, {"spans": [], "next_seq": 0,
+                                    "dropped": 0, "start_ts": None})
+        return self._json(200, traces_fn(since))
 
     def _handle_statsz(self, path, query, body):
         stats_fn = getattr(self.server, "stats", None)

@@ -82,6 +82,10 @@ class ObsHTTPServer:
         self._inflight_lock = threading.Lock()
         self._drained = threading.Event()
         self._drained.set()
+        # Per-handler-thread request headers: mounted routes read them via
+        # request_headers() (the Traceparent propagation seam, ISSUE 13)
+        # without changing the 3-arg route signature existing routes use.
+        self._tls = threading.local()
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -167,6 +171,7 @@ class ObsHTTPServer:
 
             def _dispatch(self, method):
                 path, _, query = self.path.partition("?")
+                outer._tls.headers = self.headers
                 try:
                     if method == "GET":
                         if path == "/metrics":
@@ -227,6 +232,12 @@ class ObsHTTPServer:
 
     def url(self, path: str = "/metrics") -> str:
         return f"http://{self.host}:{self.port}{path}"
+
+    def request_headers(self):
+        """The CURRENT request's headers (handler threads only; {} when
+        called off a handler) — how a mounted route reads the
+        ``Traceparent`` propagation header without a signature change."""
+        return getattr(self._tls, "headers", None) or {}
 
     def close(self) -> None:
         """Stop accepting, drain in-flight handlers (bounded by

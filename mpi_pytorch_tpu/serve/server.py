@@ -81,6 +81,11 @@ class _InFlight:
     # Which executable set served this flush — a precision retune lands
     # between flushes, so the record must carry the set that actually ran.
     precision: str = "bf16"
+    # Monotonic phase boundaries (flush pulled / preprocess done) — the
+    # completion loop reconstructs per-request wall-clock spans for
+    # TRACED requests from these (ISSUE 13); zero cost otherwise.
+    t_flush: float = 0.0
+    t_prep: float = 0.0
 
 
 class InferenceServer:
@@ -119,6 +124,16 @@ class InferenceServer:
         apply_runtime_flags(cfg)
         self.cfg = cfg
         self._logger = run_logger()
+        # Fleet-collector identity (ISSUE 13): the process start stamp +
+        # a monotonic snapshot sequence let a scraper distinguish a
+        # counter RESET (this process restarted) from a negative delta,
+        # and the span ring is the /tracez export surface. Creating the
+        # ring is one deque; an untraced request never touches it.
+        self.start_ts = time.time()
+        self._snapshot_seq = itertools.count()
+        from mpi_pytorch_tpu.obs.context import SpanRecorder
+
+        self._spans = SpanRecorder()
         # Fleet identity (serve/fleet/): the in-process N-host harness
         # tags each replica with its host index — the analogue of a
         # process index for the per-host fault gates — and a stable name
@@ -362,10 +377,16 @@ class InferenceServer:
 
     # ------------------------------------------------------------ request path
 
-    def submit(self, image) -> Future:
+    def submit(self, image, trace=None) -> Future:
         """Enqueue one request; the future resolves to the top-k class
         indices (np.int32, shape [topk]). Raises ``QueueFullError`` under
-        backpressure and ``ServerClosedError`` after ``close()``."""
+        backpressure and ``ServerClosedError`` after ``close()``.
+
+        ``trace`` (optional ``obs.TraceContext``) is the cross-process
+        trace thread: a traced request's queue/preprocess/device phases
+        land as spans in this host's ``/tracez`` ring, parented under the
+        caller's span (ISSUE 13). ``None`` — the default — records
+        nothing anywhere."""
         if self._batcher.closed:
             raise ServerClosedError("server is shut down")
         fut: Future = Future()
@@ -379,7 +400,9 @@ class InferenceServer:
         payload = self._submit_preprocess(image)
         try:
             self._batcher.submit(
-                PendingRequest(payload=payload, future=fut, req_id=rid)
+                PendingRequest(
+                    payload=payload, future=fut, req_id=rid, trace=trace
+                )
             )
         except QueueFullError:
             with self._lock:
@@ -566,14 +589,21 @@ class InferenceServer:
                     # carry these failures — a whole-flush casualty is the
                     # WORST outage and must not be the one that vanishes
                     # from the stream: record it as a fault signal.
-                    self._metrics.write(
-                        {
-                            "kind": "fault",
-                            "reason": "preprocess_all_failed",
-                            "detail": f"{prep_failures} request(s), no "
-                            "surviving batch",
-                        }
+                    fault_rec = {
+                        "kind": "fault",
+                        "reason": "preprocess_all_failed",
+                        "detail": f"{prep_failures} request(s), no "
+                        "surviving batch",
+                    }
+                    traced = next(
+                        (r for r in members if r.trace is not None), None
                     )
+                    if traced is not None:
+                        # The fault struck inside a traced request: stamp
+                        # its trace id so the chaos evidence links to the
+                        # exact victim waterfall (schema v9).
+                        fault_rec["trace_id"] = traced.trace.trace_id
+                    self._metrics.write(fault_rec)
                     continue
                 t_prep = time.monotonic()
                 self._maybe_fault_delay()
@@ -603,6 +633,8 @@ class InferenceServer:
                         t_oldest=min(r.t_submit for r in good),
                         prep_failures=prep_failures,
                         precision=exe.precision,
+                        t_flush=t_flush,
+                        t_prep=t_prep,
                     )
                 )
             except BaseException as e:  # noqa: BLE001 — keep serving
@@ -673,6 +705,14 @@ class InferenceServer:
                     # is a live axis (multi-set or non-default) — pure-bf16
                     # servers keep their records byte-identical to v6.
                     record["precision"] = item.precision
+                traced = [r for r in item.requests if r.trace is not None]
+                if traced:
+                    # Schema-v9: the flush's traced members, and their
+                    # host-side phase spans into the /tracez ring.
+                    # Untraced traffic skips BOTH — records and hot-path
+                    # behavior stay byte-identical to v8.
+                    record["trace_ids"] = [r.trace.trace_id for r in traced]
+                    self._record_request_spans(traced, item, t_done)
                 self._metrics.write(record)
                 # Live registry: per-flush aggregates (the /metrics p99 the
                 # acceptance test matches against this record stream) plus
@@ -701,11 +741,64 @@ class InferenceServer:
                 self._logger.error("serve completion loop error: %s", e)
                 self._fail(item.requests, e)
 
+    def _record_request_spans(self, traced, item, t_done_mono: float) -> None:
+        """Per-request host-side phase spans for a flush's TRACED members
+        (ISSUE 13): queue → preprocess → device under a per-request root,
+        parented on the caller's wire span. Runs on the completion loop —
+        off the request path — and only for traced requests. Timestamps
+        are wall clock, converted from the flush's monotonic boundaries
+        (same-process conversion, exact to clock resolution)."""
+        now_wall, now_mono = time.time(), time.monotonic()
+
+        def wall(mono: float) -> float:
+            return now_wall - (now_mono - mono)
+
+        for req in traced:
+            ctx = req.trace
+            root = self._spans.add(
+                name="serve/request",
+                trace=ctx.trace_id,
+                parent=ctx.span_id,
+                t0=wall(req.t_submit),
+                t1=wall(t_done_mono),
+                host=self.name,
+                attrs={"bucket": item.bucket, "req": req.req_id,
+                       "status": "ok"},
+            )
+            for name, m0, m1 in (
+                ("serve/queue", req.t_submit, item.t_flush),
+                ("serve/preprocess", item.t_flush, item.t_prep),
+                ("serve/device", item.t_dispatch, t_done_mono),
+            ):
+                self._spans.add(
+                    name=name, trace=ctx.trace_id, parent=root["span"],
+                    t0=wall(m0), t1=wall(m1), host=self.name,
+                )
+
+    def traces(self, since: int = 0) -> dict:
+        """Incremental span export — the ``/tracez`` payload (and the
+        in-process twin the fleet collector scrapes via ``LocalHost``)."""
+        return self._spans.export(since)
+
     def _fail(self, requests, exc) -> None:
         with self._lock:
             self._stats["failed"] += len(requests)
         self._m_failed.inc(len(requests))
+        now_wall, now_mono = time.time(), time.monotonic()
         for req in requests:
+            if req.trace is not None:
+                # The host-side half of a failed traced request: the span
+                # says where it died even when no serve record exists.
+                self._spans.add(
+                    name="serve/request",
+                    trace=req.trace.trace_id,
+                    parent=req.trace.span_id,
+                    t0=now_wall - (now_mono - req.t_submit),
+                    t1=now_wall,
+                    host=self.name,
+                    attrs={"req": req.req_id, "status": "failed",
+                           "error": type(exc).__name__},
+                )
             if not req.future.done():
                 req.future.set_exception(exc)
 
@@ -793,10 +886,18 @@ class InferenceServer:
         The queue-depth and compile gauges are refreshed first: they are
         otherwise only stamped per flush (completion loop), and the fleet
         router scores hosts off exactly this snapshot — a busy host whose
-        completion loop is behind must not look idle."""
+        completion loop is behind must not look idle.
+
+        The snapshot carries a monotonic ``seq`` + the process
+        ``start_ts`` (schema v9): a scraper seeing ``start_ts`` change —
+        or ``seq`` go backwards — knows the counters RESET with a host
+        restart, and re-baselines instead of booking a negative rate."""
         self._g_qdepth.set(self._batcher.qsize())
         self._g_compiles.set(self.compiles_after_warmup())
-        return self._registry.snapshot()
+        snap = self._registry.snapshot()
+        snap["seq"] = next(self._snapshot_seq)
+        snap["start_ts"] = self.start_ts
+        return snap
 
     @property
     def metrics_port(self) -> int | None:
@@ -859,6 +960,11 @@ class InferenceServer:
             "topk": stats["topk"],
             "host_index": self.host_index,
             "pid": os.getpid(),
+            # Clock-probe surface (ISSUE 13): the collector estimates this
+            # host's wall-clock offset from the probe's RTT midpoint, and
+            # corrects span timestamps by it before assembly.
+            "time": time.time(),
+            "start_ts": self.start_ts,
         }
 
     def _shutdown_sinks(self) -> None:

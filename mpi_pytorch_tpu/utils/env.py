@@ -117,7 +117,10 @@ FAULT_GATES: dict[str, str] = {
         "fleet the strike closes the host without drain; on a REMOTE "
         "fleet it SIGKILLs the serving SUBPROCESS (RemoteHost.kill), so "
         "the drill is real process death — tools/inject_faults.py "
-        "kill-serve-host is the by-hand equivalent"
+        "kill-serve-host is the by-hand equivalent. When the striking "
+        "request is TRACED (ISSUE 13), the announcing kind='fault' "
+        "record stamps its trace_id, so the chaos evidence links to the "
+        "exact victim waterfall (tools/trace_report.py)"
     ),
     "MPT_FAULT_SERVE_KILL_AFTER": (
         "kill the MPT_FAULT_SERVE_KILL_HOST host after this many requests "

@@ -1,0 +1,903 @@
+"""Tests for fleet-wide distributed tracing + the central collector
+(ISSUE 13): W3C-style traceparent propagation surviving the REAL HTTP
+stack (jax-free fake server), a failover'd request's trace carrying BOTH
+dispatch attempts with exactly one completion, probe-RTT skew correction
+ordering cross-host spans under an injected clock offset, tail sampling
+keeping every failed/slow/re-dispatched trace and ~rate of the rest,
+collector counter-reset detection (a restart is never a negative rate),
+schema-v9 record shapes, the trace_report waterfall/critical-path
+assembly, the bench per_phase columns + regression-gate learning, and
+the end-to-end real-server span thread (serve records gain trace_ids
+only when traced — byte-identical off).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ context basics
+
+
+def test_traceparent_roundtrip():
+    from mpi_pytorch_tpu.obs.context import (
+        format_traceparent,
+        mint_trace,
+        parse_traceparent,
+    )
+
+    ctx = mint_trace()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    assert parse_traceparent(format_traceparent(ctx)) == ctx
+    unsampled = mint_trace(sampled=False)
+    back = parse_traceparent(format_traceparent(unsampled))
+    assert back is not None and back.sampled is False
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id and child.span_id != ctx.span_id
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "junk", "00-zz-11-01",
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # all-zero trace id
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # all-zero span id
+    "00-" + "a" * 31 + "-" + "b" * 16 + "-01",  # short trace id
+    "99",
+])
+def test_traceparent_malformed_is_untraced(bad):
+    from mpi_pytorch_tpu.obs.context import parse_traceparent
+
+    assert parse_traceparent(bad) is None
+
+
+def test_span_recorder_ring_cursor_and_dropped():
+    from mpi_pytorch_tpu.obs.context import SpanRecorder
+
+    rec = SpanRecorder(capacity=4)
+    now = time.time()
+    for i in range(6):
+        rec.add(name=f"s{i}", trace="t" * 32, t0=now, t1=now + 0.001,
+                host="h0")
+    out = rec.export(0)
+    assert [s["name"] for s in out["spans"]] == ["s2", "s3", "s4", "s5"]
+    assert out["dropped"] == 2  # s0/s1 lapped before the cursor saw them
+    assert out["next_seq"] == 6
+    again = rec.export(out["next_seq"])
+    assert again["spans"] == [] and again["dropped"] == 0
+    rec.add(name="s6", trace="t" * 32, t0=now, t1=now, host="h0")
+    tail = rec.export(6)
+    assert [s["name"] for s in tail["spans"]] == ["s6"]
+    assert tail["start_ts"] == rec.start_ts
+
+
+def test_head_sampling_deterministic_rate():
+    from mpi_pytorch_tpu.obs.context import head_keep, new_trace_id
+
+    ids = [new_trace_id() for _ in range(2000)]
+    kept = [t for t in ids if head_keep(t, 0.2)]
+    assert kept == [t for t in ids if head_keep(t, 0.2)]  # deterministic
+    assert 0.1 < len(kept) / len(ids) < 0.3  # ~rate
+    assert all(head_keep(t, 1.0) for t in ids[:10])
+    assert not any(head_keep(t, 0.0) for t in ids[:10])
+
+
+# --------------------------------------------- fakes (jax-free host handles)
+
+
+class TracingFakeHost:
+    """HostHandle-shaped fake that records incoming trace contexts and
+    serves spans/snapshots like a real host — the router/collector unit
+    target."""
+
+    transport = "local"
+
+    def __init__(self, name, index, fail_submits=0):
+        from mpi_pytorch_tpu.obs.context import SpanRecorder
+
+        self.name = name
+        self.index = index
+        self.queue_capacity = 64
+        self.buckets = (1, 4)
+        self.active_buckets = (1, 4)
+        self.max_wait_ms = 2.0
+        self.precision = "bf16"
+        self.precisions = ("bf16",)
+        self.parity_top1 = None
+        self.fail_submits = fail_submits  # host-shaped future failures
+        self.seen_traces = []
+        self.spans = SpanRecorder()
+        self.start_ts = time.time()
+        self._seq = 0
+        self.counters = {"serve/requests": 0.0, "serve/served": 0.0,
+                         "serve/rejected": 0.0, "serve/failed": 0.0}
+        self.clock_skew_s = 0.0
+        self.closed = False
+
+    def submit(self, payload, trace=None):
+        from mpi_pytorch_tpu.serve.batcher import HostUnavailableError
+
+        self.seen_traces.append(trace)
+        self.counters["serve/requests"] += 1
+        fut = Future()
+        if self.fail_submits > 0:
+            self.fail_submits -= 1
+            self.counters["serve/failed"] += 1
+            fut.set_exception(
+                HostUnavailableError(f"{self.name} died mid-flight")
+            )
+            return fut
+        self.counters["serve/served"] += 1
+        if trace is not None:
+            # Host-side spans stamped on the HOST's (possibly skewed)
+            # clock — what the collector must correct.
+            now = time.time() + self.clock_skew_s
+            root = self.spans.add(
+                name="serve/request", trace=trace.trace_id,
+                parent=trace.span_id, t0=now, t1=now + 0.004,
+                host=self.name, attrs={"status": "ok"},
+            )
+            self.spans.add(
+                name="serve/device", trace=trace.trace_id,
+                parent=root["span"], t0=now + 0.001, t1=now + 0.003,
+                host=self.name,
+            )
+        fut.set_result(np.zeros((3,), np.int32))
+        return fut
+
+    # -- probe surface -------------------------------------------------
+    def snapshot(self):
+        self._seq += 1
+        return {
+            "counters": dict(self.counters),
+            "gauges": {"serve/queue_depth": 1.0},
+            "histograms": {},
+            "seq": self._seq,
+            "start_ts": self.start_ts,
+        }
+
+    def restart(self):
+        """Simulate a process restart: counters zero, seq space fresh."""
+        from mpi_pytorch_tpu.obs.context import SpanRecorder
+
+        self.start_ts = time.time() + 1e-3
+        self._seq = 0
+        self.counters = {k: 0.0 for k in self.counters}
+        self.spans = SpanRecorder()
+        self.spans.start_ts = self.start_ts
+
+    def traces(self, since=0):
+        return self.spans.export(since)
+
+    def clock_probe(self):
+        return (0.002, self.clock_skew_s)
+
+    def alive(self):
+        return not self.closed
+
+    def qsize(self):
+        return 0
+
+    def stats(self):
+        return {}
+
+    def set_max_wait_ms(self, v):
+        self.max_wait_ms = float(v)
+
+    def set_active_buckets(self, b):
+        self.active_buckets = tuple(b)
+
+    def set_precision(self, p):
+        pass
+
+    def compiles_after_warmup(self):
+        return 0
+
+    def close(self, drain=True):
+        self.closed = True
+
+    def kill(self):
+        self.closed = True
+
+
+class ListWriter:
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append({"ts": time.time(), **rec})
+
+    def close(self):
+        pass
+
+
+# ------------------------------------------------- router-side propagation
+
+
+def _router(hosts, **kw):
+    from mpi_pytorch_tpu.serve.fleet.router import FleetRouter
+
+    kw.setdefault("probe_interval_s", 0.05)
+    kw.setdefault("trace_sample_rate", 1.0)
+    return FleetRouter(hosts, **kw)
+
+
+def test_router_mints_and_propagates_trace():
+    h0 = TracingFakeHost("h0", 0)
+    router = _router([h0])
+    try:
+        router.submit(np.zeros((2,))).result(timeout=5)
+        assert len(h0.seen_traces) == 1
+        ctx = h0.seen_traces[0]
+        assert ctx is not None and len(ctx.trace_id) == 32
+        spans = router.spans.export(0)["spans"]
+        names = {s["name"] for s in spans}
+        assert {"route/request", "route/admission", "route/dispatch"} <= names
+        root = next(s for s in spans if s["name"] == "route/request")
+        assert root["trace"] == ctx.trace_id
+        assert root["attrs"]["status"] == "ok"
+        dispatch = next(s for s in spans if s["name"] == "route/dispatch")
+        # The host parents under the DISPATCH child context, so its spans
+        # join the same trace under the right parent.
+        assert dispatch["span"] == ctx.span_id
+        assert dispatch["parent"] == root["span"]
+    finally:
+        router.close()
+
+
+def test_tracing_off_is_inert():
+    h0 = TracingFakeHost("h0", 0)
+    router = _router([h0], trace_sample_rate=0.0)
+    try:
+        router.submit(np.zeros((2,))).result(timeout=5)
+        assert h0.seen_traces == [None]
+        assert router.spans is None
+    finally:
+        router.close()
+
+
+def test_failover_trace_has_both_attempts_one_completion():
+    """The acceptance shape: a re-dispatched request's trace carries BOTH
+    dispatch attempts (first failed, second ok) and exactly ONE
+    end-to-end completion."""
+    bad = TracingFakeHost("h0", 0, fail_submits=1)
+    good = TracingFakeHost("h1", 1)
+    writer = ListWriter()
+    router = _router([bad, good], metrics=writer, fail_probes=100)
+    try:
+        # Fresh snapshots → equal scores → _pick takes the FIRST host
+        # deterministically (the stale-path po2 fallback would randomize
+        # which host sees attempt 1).
+        deadline = time.time() + 2
+        while time.time() < deadline:
+            with router._lock:
+                fresh = all(
+                    router._state[h.name].score is not None
+                    for h in (bad, good)
+                )
+            if fresh:
+                break
+            time.sleep(0.01)
+        router.submit(np.zeros((2,))).result(timeout=5)
+        # Both hosts saw the SAME trace id on different dispatch spans.
+        trace_id = bad.seen_traces[0].trace_id
+        assert good.seen_traces[0].trace_id == trace_id
+        assert bad.seen_traces[0].span_id != good.seen_traces[0].span_id
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            spans = router.spans.export(0)["spans"]
+            if sum(1 for s in spans if s["name"] == "route/request") == 1:
+                break
+            time.sleep(0.01)
+        dispatches = [s for s in spans if s["name"] == "route/dispatch"]
+        assert len(dispatches) == 2, dispatches
+        outcomes = sorted(d["attrs"]["outcome"] for d in dispatches)
+        assert outcomes[0].startswith("failed:") and outcomes[1] == "ok"
+        assert sorted(d["attrs"]["attempt"] for d in dispatches) == [1, 2]
+        roots = [s for s in spans if s["name"] == "route/request"]
+        assert len(roots) == 1  # exactly one completion
+        assert roots[0]["attrs"]["redispatches"] == 1
+    finally:
+        router.close()
+
+
+def test_front_door_rejection_leaves_root_span():
+    h0 = TracingFakeHost("h0", 0)
+    router = _router([h0], admission_tokens=1)
+    from mpi_pytorch_tpu.serve.batcher import QueueFullError
+
+    try:
+        with router._lock:
+            router._tokens = 0  # deterministically exhausted
+        with pytest.raises(QueueFullError):
+            router.submit(np.zeros((2,)))
+        spans = router.spans.export(0)["spans"]
+        root = next(s for s in spans if s["name"] == "route/request")
+        assert root["attrs"]["status"] == "rejected"
+    finally:
+        router.close()
+
+
+def test_route_records_carry_trace_ids_only_when_traced():
+    h0 = TracingFakeHost("h0", 0)
+    writer = ListWriter()
+    router = _router([h0], metrics=writer, route_record_every=1)
+    try:
+        router.submit(np.zeros((2,))).result(timeout=5)
+        router._write_route_records(force=True)
+        routes = [r for r in writer.records if r["kind"] == "route"]
+        assert routes and routes[-1]["trace_ids"] == [
+            h0.seen_traces[0].trace_id
+        ]
+    finally:
+        router.close()
+    # And an UNTRACED router writes byte-identical v8 route records.
+    h1 = TracingFakeHost("h1", 1)
+    writer2 = ListWriter()
+    router2 = _router([h1], metrics=writer2, trace_sample_rate=0.0,
+                      route_record_every=1)
+    try:
+        router2.submit(np.zeros((2,))).result(timeout=5)
+        router2._write_route_records(force=True)
+        routes = [r for r in writer2.records if r["kind"] == "route"]
+        assert routes and "trace_ids" not in routes[-1]
+    finally:
+        router2.close()
+
+
+def test_kill_gate_fault_record_stamps_trace_id(monkeypatch):
+    monkeypatch.setenv("MPT_FAULT_SERVE_KILL_HOST", "0")
+    monkeypatch.setenv("MPT_FAULT_SERVE_KILL_AFTER", "1")
+    h0 = TracingFakeHost("h0", 0)
+    h1 = TracingFakeHost("h1", 1)
+    writer = ListWriter()
+    router = _router([h0, h1], metrics=writer, fail_probes=100)
+    try:
+        futs = [router.submit(np.zeros((2,))) for _ in range(4)]
+        for f in futs:
+            f.result(timeout=5)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            faults = [r for r in writer.records if r["kind"] == "fault"]
+            if faults:
+                break
+            time.sleep(0.01)
+        assert faults and faults[0]["reason"] == "injected_host_kill"
+        assert faults[0]["trace_id"] == h0.seen_traces[0].trace_id
+    finally:
+        router.close()
+
+
+# ----------------------------------------------------- wire-path propagation
+
+
+class FakeWireServer:
+    """jax-free duck-typed InferenceServer with the trace surface — the
+    real-HTTP-stack propagation target (test_remote_fleet's pattern)."""
+
+    name = "h0"
+
+    def __init__(self):
+        from mpi_pytorch_tpu.obs.context import SpanRecorder
+
+        self.spans = SpanRecorder()
+        self.start_ts = time.time()
+        self.seen_traces = []
+
+    def submit(self, image, trace=None):
+        self.seen_traces.append(trace)
+        fut = Future()
+        if trace is not None:
+            now = time.time()
+            self.spans.add(
+                name="serve/request", trace=trace.trace_id,
+                parent=trace.span_id, t0=now, t1=now + 0.002,
+                host=self.name, attrs={"status": "ok"},
+            )
+        fut.set_result(np.arange(3, dtype=np.int32))
+        return fut
+
+    def traces(self, since=0):
+        return self.spans.export(since)
+
+    def close(self, drain=True):
+        pass
+
+
+def test_traceparent_survives_real_http_stack():
+    """front door ctx → Traceparent header → ServingHost → server.submit
+    → host span ring → GET /tracez, plus the client-side wire spans —
+    the whole propagation seam over the REAL HTTP stack, no jax."""
+    from mpi_pytorch_tpu.obs.context import SpanRecorder, mint_trace
+    from mpi_pytorch_tpu.serve.fleet.remote import RemoteHost
+    from mpi_pytorch_tpu.serve.host import ServingHost
+
+    server = FakeWireServer()
+    host = ServingHost(server, port=0)
+    spans = SpanRecorder()
+    try:
+        remote = RemoteHost(
+            f"http://127.0.0.1:{host.port}", name="h0", index=0,
+            poll_slice_s=0.2, result_timeout_s=5.0, probe_retries=1,
+            spans=spans,
+        )
+        ctx = mint_trace().child()
+        preds = remote.submit(np.zeros((4, 4, 3), np.uint8),
+                              trace=ctx).result(timeout=5)
+        assert preds.shape == (3,)
+        # The SAME trace id crossed the wire.
+        assert len(server.seen_traces) == 1
+        got = server.seen_traces[0]
+        assert got is not None
+        assert got.trace_id == ctx.trace_id
+        assert got.span_id == ctx.span_id  # parents under the wire ctx
+        # Host-side spans export over the REAL /tracez endpoint.
+        out = remote.traces(0)
+        assert [s["name"] for s in out["spans"]] == ["serve/request"]
+        assert out["spans"][0]["trace"] == ctx.trace_id
+        # The export's generation stamp is the RECORDER's start (what the
+        # collector keys its cursor on), faithful across the wire.
+        assert out["start_ts"] == pytest.approx(server.spans.start_ts)
+        # Client-side wire spans landed in the router-process ring.
+        wire_names = sorted(
+            s["name"] for s in spans.export(0)["spans"]
+        )
+        assert wire_names == ["wire/result", "wire/submit"]
+        # Clock probe: healthz has no "time" on this fake → offset 0.
+        rtt, offset = remote.clock_probe()
+        assert rtt >= 0 and offset == 0.0
+        # An untraced submit stays untraced (no header, no spans).
+        remote.submit(np.zeros((4, 4, 3), np.uint8)).result(timeout=5)
+        assert server.seen_traces[1] is None
+        remote._pool.shutdown(wait=False, cancel_futures=True)
+    finally:
+        host.close()
+
+
+# ------------------------------------------------------------- collector
+
+
+def _collector(hosts, writer=None, **kw):
+    from mpi_pytorch_tpu.obs.collector import FleetCollector
+
+    kw.setdefault("sample_rate", 0.0)
+    kw.setdefault("trace_linger_s", 0.0)
+    return FleetCollector(lambda: hosts, metrics=writer, **kw)
+
+
+def test_collector_skew_correction_orders_cross_host_spans(tmp_path):
+    """Host h1's clock runs 500 ms AHEAD; without correction its spans
+    would start before the router's root. The collector subtracts the
+    probe-measured offset at ingest, restoring causal order."""
+    from mpi_pytorch_tpu.obs.context import SpanRecorder, mint_trace
+
+    h1 = TracingFakeHost("h1", 1)
+    h1.clock_skew_s = 0.5
+    router_spans = SpanRecorder()
+    trace_out = str(tmp_path / "spans.jsonl")
+    col = _collector([h1], spans=router_spans, trace_out=trace_out,
+                     sample_rate=1.0)
+    col.tick()  # baseline: measures h1's offset before any span lands
+    ctx = mint_trace()
+    t0 = time.time()
+    h1.submit(np.zeros((2,)), trace=ctx.child()).result(timeout=5)
+    router_spans.add(
+        name="route/request", trace=ctx.trace_id, span=ctx.span_id,
+        t0=t0, t1=time.time() + 0.01, host="router",
+        attrs={"status": "ok", "redispatches": 0},
+    )
+    col.tick()
+    col.stop(final=True)
+    assert col.offset_ms("h1") == pytest.approx(500.0, abs=50.0)
+    spans = [json.loads(line) for line in open(trace_out)]
+    by_name = {s["name"]: s for s in spans}
+    root, dev = by_name["route/request"], by_name["serve/device"]
+    # Corrected: the host-side span falls INSIDE the root window.
+    assert root["t0"] <= by_name["serve/request"]["t0"] <= root["t1"]
+    assert root["t0"] <= dev["t0"] and dev["t1"] <= root["t1"] + 0.05
+    assert dev["clock_offset_ms"] == pytest.approx(500.0, abs=50.0)
+
+
+def _root_span(recorder, trace_id, dur_ms=1.0, status="ok", redispatches=0):
+    now = time.time()
+    recorder.add(
+        name="route/request", trace=trace_id, t0=now,
+        t1=now + dur_ms / 1e3, host="router",
+        attrs={"status": status, "redispatches": redispatches},
+    )
+
+
+def test_tail_sampling_keeps_failed_slow_redispatched(tmp_path):
+    from mpi_pytorch_tpu.obs.context import (
+        SpanRecorder,
+        head_keep,
+        new_trace_id,
+    )
+
+    spans = SpanRecorder(capacity=16384)
+    ok_ids = [new_trace_id() for _ in range(400)]
+    for t in ok_ids:
+        _root_span(spans, t)
+    special = {
+        "failed": new_trace_id(), "rejected": new_trace_id(),
+        "redisp": new_trace_id(), "slow": new_trace_id(),
+    }
+    _root_span(spans, special["failed"], status="failed:RuntimeError")
+    _root_span(spans, special["rejected"], status="rejected")
+    _root_span(spans, special["redisp"], redispatches=1)
+    _root_span(spans, special["slow"], dur_ms=500.0)
+    trace_out = str(tmp_path / "spans.jsonl")
+    col = _collector([], spans=spans, trace_out=trace_out,
+                     sample_rate=0.1, slow_ms=100.0)
+    col.tick()
+    col.stop(final=True)
+    kept = {json.loads(line)["trace"] for line in open(trace_out)}
+    # Every special trace survives regardless of the head-sample draw...
+    assert set(special.values()) <= kept
+    # ...and of the ordinary ones, exactly the deterministic head sample.
+    assert kept - set(special.values()) == {
+        t for t in ok_ids if head_keep(t, 0.1)
+    }
+    assert col.stats["traces_kept"] == len(kept)
+    assert col.stats["traces_dropped"] == 404 - len(kept)
+
+
+def test_fleet_event_pins_open_traces(tmp_path):
+    """A failover record passing through the tapped stream pins every
+    in-flight trace — kept even though head sampling would drop them."""
+    from mpi_pytorch_tpu.obs.context import SpanRecorder, new_trace_id
+
+    spans = SpanRecorder()
+    trace_out = str(tmp_path / "spans.jsonl")
+    writer = ListWriter()
+    col = _collector([], writer=writer, spans=spans, trace_out=trace_out)
+    tapped = col.tap(writer)
+    victim = new_trace_id()
+    now = time.time()
+    spans.add(name="route/dispatch", trace=victim, t0=now, t1=now + 0.001,
+              host="router", attrs={"host": "h1", "attempt": 1,
+                                    "outcome": "ok"})
+    col.tick()  # victim is now an OPEN trace (no root yet)
+    tapped.write({"kind": "fleet", "event": "failover", "host": "h1"})
+    assert col.stats["traces_pinned"] == 1
+    _root_span(spans, victim)  # completes fine — but stays pinned
+    col.tick()
+    col.stop(final=True)
+    kept = {json.loads(line)["trace"] for line in open(trace_out)}
+    assert victim in kept
+    # The tapped record itself reached the inner writer untouched.
+    assert writer.records[-1]["event"] == "failover"
+
+
+def test_collector_reset_detection_never_negative_rate():
+    h0 = TracingFakeHost("h0", 0)
+    writer = ListWriter()
+    col = _collector([h0], writer=writer, timeline_every=1000)
+    col.tick()
+    h0.counters["serve/requests"] = 100.0
+    h0.counters["serve/served"] = 100.0
+    time.sleep(0.01)
+    col.tick()  # positive deltas land
+    h0.restart()  # counters back to zero, fresh seq + start_ts
+    time.sleep(0.01)
+    col.tick()  # must re-baseline, not book -100/dt
+    h0.counters["serve/requests"] = 5.0
+    time.sleep(0.01)
+    col.tick()
+    col.stop(final=True)
+    assert col.stats["resets"] == 1
+    assert col.stats["negative_deltas"] == 0
+    rates = [
+        v for (host, metric), ring in col._series.items()
+        if metric.endswith(":rate") for _, v in ring
+    ]
+    assert rates and all(v >= 0 for v in rates)
+    timelines = [r for r in writer.records if r["kind"] == "timeline"]
+    assert any(r["resets"] == 1 for r in timelines)
+
+
+def test_collector_timeline_records_schema_clean(tmp_path):
+    from mpi_pytorch_tpu.obs.schema import validate_jsonl
+
+    h0 = TracingFakeHost("h0", 0)
+    path = str(tmp_path / "m.jsonl")
+
+    class FileWriter:
+        def __init__(self):
+            self._fh = open(path, "a", buffering=1)
+
+        def write(self, rec):
+            self._fh.write(json.dumps({"ts": time.time(), **rec}) + "\n")
+
+        def close(self):
+            self._fh.close()
+
+    writer = FileWriter()
+    col = _collector([h0], writer=writer, timeline_every=1)
+    for _ in range(3):
+        h0.counters["serve/requests"] += 7
+        time.sleep(0.01)
+        col.tick()
+    col.stop(final=True)
+    writer.close()
+    assert validate_jsonl(path) == []
+    recs = [json.loads(line) for line in open(path)]
+    assert any(
+        r["kind"] == "timeline" and r["metric"] == "serve/requests:rate"
+        for r in recs
+    )
+    assert all(
+        v >= 0 for r in recs if r["kind"] == "timeline"
+        for _, v in r["points"]
+    )
+
+
+def test_collector_cursor_resets_with_recorder_generation():
+    """A restarted host's /tracez seq space starts over; the stale cursor
+    must rewind instead of silently missing every new span."""
+    h0 = TracingFakeHost("h0", 0)
+    col = _collector([h0], sample_rate=1.0)
+    from mpi_pytorch_tpu.obs.context import mint_trace
+
+    h0.submit(np.zeros((2,)), trace=mint_trace().child()).result(timeout=5)
+    col.tick()
+    seen_before = col.stats["spans_seen"]
+    assert seen_before == 2  # request + device spans
+    h0.restart()
+    h0.submit(np.zeros((2,)), trace=mint_trace().child()).result(timeout=5)
+    col.tick()
+    assert col.stats["spans_seen"] == seen_before + 2
+    col.stop(final=False)
+
+
+# ---------------------------------------------------------------- schema v9
+
+
+def test_schema_v9_shapes():
+    from mpi_pytorch_tpu.obs.schema import SCHEMA_VERSION, validate_record
+
+    assert SCHEMA_VERSION == 9
+    assert validate_record({
+        "kind": "timeline", "ts": 1.0, "host": "h0",
+        "metric": "serve/queue_depth", "points": [[1.0, 2.0]],
+        "window_s": 3.0, "clock_offset_ms": -0.2, "resets": 1,
+    }) == []
+    assert validate_record({"kind": "timeline", "ts": 1.0, "host": "h0"})
+    assert validate_record({
+        "kind": "serve", "ts": 1.0, "bucket": 4, "requests": 3,
+        "queue_depth": 0, "fill_ratio": 0.75, "queue_wait_ms": 1.0,
+        "device_ms": 2.0, "trace_ids": ["a" * 32],
+    }) == []
+    assert validate_record({
+        "kind": "route", "ts": 1.0, "host": "h0", "requests": 2,
+        "trace_ids": ["a" * 32, "b" * 32],
+    }) == []
+    assert validate_record({
+        "kind": "fault", "ts": 1.0, "reason": "injected_host_kill",
+        "trace_id": "a" * 32,
+    }) == []
+    assert validate_record({
+        "kind": "serve_bench", "ts": 1.0, "mode": "open", "buckets": "1,4",
+        "max_wait_ms": 2.0, "requests": 10, "p50_ms": 1.0, "p95_ms": 2.0,
+        "p99_ms": 3.0, "images_per_sec": 10.0,
+        "per_phase": {"serve/device": {"count": 5, "p50_ms": 1.0,
+                                       "p99_ms": 2.0}},
+    }) == []
+
+
+# ------------------------------------------------------------- trace_report
+
+
+def _write_spans(path, spans):
+    with open(path, "w") as f:
+        for s in spans:
+            f.write(json.dumps(s) + "\n")
+
+
+def _span(trace, span, parent, name, host, pid, t0, t1, attrs=None):
+    s = {"trace": trace, "span": span, "parent": parent, "name": name,
+         "host": host, "pid": pid, "t0": t0, "t1": t1}
+    if attrs:
+        s["attrs"] = attrs
+    return s
+
+
+def _failover_trace(trace="f" * 32, base=1000.0):
+    """A synthetic re-dispatched request crossing two processes."""
+    return [
+        _span(trace, "r" * 16, None, "route/request", "router", 100,
+              base, base + 0.100,
+              attrs={"status": "ok", "redispatches": 1}),
+        _span(trace, "a" * 16, "r" * 16, "route/dispatch", "router", 100,
+              base + 0.001, base + 0.040,
+              attrs={"host": "h1", "attempt": 1,
+                     "outcome": "failed:HostUnavailableError"}),
+        _span(trace, "b" * 16, "r" * 16, "route/dispatch", "router", 100,
+              base + 0.045, base + 0.099,
+              attrs={"host": "h2", "attempt": 2, "outcome": "ok"}),
+        _span(trace, "c" * 16, "b" * 16, "serve/request", "h2", 200,
+              base + 0.050, base + 0.095, attrs={"status": "ok"}),
+        _span(trace, "d" * 16, "c" * 16, "serve/queue", "h2", 200,
+              base + 0.050, base + 0.060),
+        _span(trace, "e" * 16, "c" * 16, "serve/device", "h2", 200,
+              base + 0.061, base + 0.094),
+    ]
+
+
+def test_trace_report_waterfall_and_critical_path(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import trace_report
+
+    path = str(tmp_path / "spans.jsonl")
+    _write_spans(path, _failover_trace())
+    rc = trace_report.main([path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "dispatch attempts=2" in out
+    assert "completions=1" in out
+    assert "-> h1 [failed:HostUnavailableError]" in out
+    assert "-> h2 [ok]" in out
+    assert "2 process(es)" in out
+    # The failed 39 ms attempt (no children) + the second attempt's wire
+    # overhead charge route/dispatch 48 ms of self-time — failover churn
+    # owns this tail, and the report says so.
+    assert "critical path: phase route/dispatch owns the p99" in out
+
+
+def test_trace_report_json_and_selection(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import trace_report
+
+    path = str(tmp_path / "spans.jsonl")
+    fast = "0" * 31 + "1"
+    spans = _failover_trace() + [
+        _span(fast, "1" * 16, None, "route/request", "router", 100,
+              2000.0, 2000.001,
+              attrs={"status": "ok", "redispatches": 0}),
+    ]
+    _write_spans(path, spans)
+    rc = trace_report.main([path, "--json"])
+    assert rc == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["traces"] == 2
+    # The re-dispatched trace is picked for the waterfall unprompted.
+    assert data["waterfalls"][0]["trace_id"] == "f" * 32
+    assert data["waterfalls"][0]["dispatch_attempts"] == 2
+    assert data["waterfalls"][0]["processes"] == 2
+    assert data["phase_breakdown"]["route/dispatch"]["count"] == 2
+    assert data["critical_path"]["phase"] == "route/dispatch"
+    assert data["critical_path"]["charges_ms"]["serve/device"] == 33.0
+    # Explicit --trace-id; unknown id is a loud rc=1.
+    assert trace_report.main([path, "--trace-id", fast]) == 0
+    assert trace_report.main([path, "--trace-id", "nope"]) == 1
+
+
+def test_trace_report_rejects_malformed(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import trace_report
+
+    path = str(tmp_path / "bad.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"trace": "x"}) + "\n")
+    assert trace_report.main([path]) == 1
+
+
+# ------------------------------------------------ regression gate per_phase
+
+
+def test_check_regression_learns_per_phase(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import check_regression
+
+    def row(per_phase=None, p99=5.0):
+        r = {"kind": "serve_bench", "ts": 1.0, "mode": "open",
+             "buckets": "1,4", "max_wait_ms": 2.0, "requests": 100,
+             "p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": p99,
+             "images_per_sec": 100.0, "model": "resnet18",
+             "offered_rps": 400.0}
+        if per_phase is not None:
+            r["per_phase"] = per_phase
+        return r
+
+    new = tmp_path / "serve_bench.json"
+    base = tmp_path / "serve_bench_prev.json"
+    # A phase p99 regression beyond tolerance fails...
+    base.write_text(json.dumps(row(
+        {"serve/device": {"count": 9, "p50_ms": 1.0, "p99_ms": 2.0}}
+    )) + "\n")
+    new.write_text(json.dumps(row(
+        {"serve/device": {"count": 9, "p50_ms": 1.0, "p99_ms": 4.0}}
+    )) + "\n")
+    violations = check_regression.check_serve(str(new), str(base), 10.0)
+    assert len(violations) == 1 and "phase serve/device" in violations[0]
+    # ...an OLD baseline without per_phase cannot (the learning rule).
+    base.write_text(json.dumps(row()) + "\n")
+    assert check_regression.check_serve(str(new), str(base), 10.0) == []
+    # Phases only on one side skip; shared healthy phases pass.
+    base.write_text(json.dumps(row(
+        {"serve/queue": {"count": 9, "p50_ms": 1.0, "p99_ms": 2.0},
+         "serve/device": {"count": 9, "p50_ms": 1.0, "p99_ms": 4.0}}
+    )) + "\n")
+    assert check_regression.check_serve(str(new), str(base), 10.0) == []
+
+
+# ------------------------------------------- real server (end of the thread)
+
+
+@pytest.fixture(scope="module")
+def tiny_server():
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.serve.server import InferenceServer
+
+    cfg = Config(
+        model_name="resnet18", num_classes=16, width=32, height=32,
+        synthetic_data=True, compute_dtype="float32",
+        serve_buckets="1,4", serve_max_wait_ms=2.0, serve_topk=3,
+        serve_queue_depth=64, loader_workers=2,
+        metrics_file="", log_file="", eval_log_file="",
+    )
+    cfg.validate_config()
+    server = InferenceServer(cfg, load_checkpoint=False)
+    yield server
+    server.close()
+
+
+def test_real_server_spans_and_record_trace_ids(tiny_server, tmp_path):
+    """End of the propagation thread: a REAL InferenceServer records
+    queue/preprocess/device spans for a traced request, stamps trace_ids
+    on the flush's serve record, and leaves untraced records untouched."""
+    from mpi_pytorch_tpu.obs.context import mint_trace
+
+    writer = ListWriter()
+    old_metrics = tiny_server._metrics
+    tiny_server._metrics = writer
+    try:
+        img = np.zeros((32, 32, 3), np.uint8)
+        # Untraced first: record must NOT carry trace_ids.
+        tiny_server.submit(img).result(timeout=30)
+        ctx = mint_trace().child()
+        before = tiny_server.traces(0)["next_seq"]
+        tiny_server.submit(img, trace=ctx).result(timeout=30)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            out = tiny_server.traces(before)
+            if any(s["name"] == "serve/request" for s in out["spans"]):
+                break
+            time.sleep(0.02)
+        names = sorted(s["name"] for s in out["spans"])
+        assert names == ["serve/device", "serve/preprocess",
+                         "serve/queue", "serve/request"]
+        assert all(s["trace"] == ctx.trace_id for s in out["spans"])
+        root = next(
+            s for s in out["spans"] if s["name"] == "serve/request"
+        )
+        assert root["parent"] == ctx.span_id
+        kids = [s for s in out["spans"] if s["name"] != "serve/request"]
+        assert all(s["parent"] == root["span"] for s in kids)
+        # Phases are causally ordered on the wall clock.
+        by = {s["name"]: s for s in out["spans"]}
+        assert by["serve/queue"]["t0"] <= by["serve/preprocess"]["t0"]
+        assert by["serve/preprocess"]["t1"] <= by["serve/device"]["t1"]
+        serves = [r for r in writer.records if r["kind"] == "serve"]
+        assert len(serves) >= 2
+        assert "trace_ids" not in serves[0]
+        assert serves[-1]["trace_ids"] == [ctx.trace_id]
+        assert tiny_server.compiles_after_warmup() == 0
+    finally:
+        tiny_server._metrics = old_metrics
+
+
+def test_real_server_snapshot_seq_and_start_ts(tiny_server):
+    s1 = tiny_server.registry_snapshot()
+    s2 = tiny_server.registry_snapshot()
+    assert s2["seq"] > s1["seq"]
+    assert s1["start_ts"] == s2["start_ts"] == tiny_server.start_ts
+    health = tiny_server._healthz()
+    assert health["start_ts"] == tiny_server.start_ts
+    assert abs(health["time"] - time.time()) < 5.0

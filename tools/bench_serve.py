@@ -278,6 +278,12 @@ def main() -> int:
                     "ONE server holding both startup-compiled executable "
                     "sets and sweep by switching live (no recompile); "
                     "int8 rows carry the startup parity_top1 stamp")
+    ap.add_argument("--trace-sample-rate", type=float, default=0.0,
+                    help="> 0 (needs --fleet N): distributed tracing at "
+                    "the router front door + the FleetCollector, and each "
+                    "row gains per_phase — the collector-derived "
+                    "queue/preprocess/device/wire p50/p99 breakdown for "
+                    "that sweep point (ISSUE 13)")
     ap.add_argument("--out", default="",
                     help="also write rows to this JSONL file (overwritten)")
     ap.add_argument("--smoke", action="store_true",
@@ -313,6 +319,12 @@ def main() -> int:
 
     if args.transport == "remote" and args.fleet <= 0:
         print("--transport remote needs --fleet N (N >= 1)", file=sys.stderr)
+        return 2
+    if args.trace_sample_rate > 0 and args.fleet <= 0:
+        # The trace id is minted at the FRONT DOOR, which is the fleet
+        # router — a single bare server has no front door to mint at.
+        print("--trace-sample-rate needs --fleet N (the router is the "
+              "minting front door)", file=sys.stderr)
         return 2
     cache_dir = ""
     if args.transport == "remote":
@@ -351,6 +363,11 @@ def main() -> int:
             serve_fleet_hosts=max(0, args.fleet),
             serve_precision=serve_precision,
             compilation_cache_dir=cache_dir,
+            trace_sample_rate=args.trace_sample_rate,
+            # The collector is what derives the per-phase breakdown; a
+            # tight scrape keeps the sweep point's spans inside the point.
+            serve_collect_interval_s=0.1 if args.trace_sample_rate > 0
+            else 0.0,
             metrics_file="", log_file="", eval_log_file="",
         )
         cfg.validate_config()
@@ -380,6 +397,16 @@ def main() -> int:
                         )
                         if args.transport == "remote":
                             row["transport"] = "http"
+                        collector = getattr(server, "collector", None)
+                        if collector is not None:
+                            # One forced scrape so the point's spans are
+                            # all in, then the per-phase p50/p99 deltas
+                            # since the previous point (ISSUE 13
+                            # satellite: the attribution columns).
+                            collector.tick()
+                            per_phase = collector.drain_phase_stats()
+                            if per_phase:
+                                row["per_phase"] = per_phase
                         if stamp_precision:
                             row["precision"] = precision
                         if precision == "int8" and server.parity_top1 is not None:

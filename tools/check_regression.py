@@ -158,6 +158,28 @@ def check_serve(new_path: str, baseline_path: str, tol_pct: float) -> list[str]:
                 f"serve [{point}]: {ips:,.1f} img/s vs baseline {ips_0:,.1f} "
                 f"(-{100.0 * (1 - ips / ips_0):.1f}% > {tol_pct}% tolerance)"
             )
+        # Schema-v9 per-phase attribution (the collector-derived
+        # queue/preprocess/device/wire breakdown): compared only when
+        # BOTH sides carry the phase — pre-v9 rows (no per_phase) and
+        # newly-instrumented phases skip, so old baselines keep working.
+        pp, pp_0 = row.get("per_phase"), prev.get("per_phase")
+        if isinstance(pp, dict) and isinstance(pp_0, dict):
+            for phase in sorted(set(pp) & set(pp_0)):
+                p99, p99_0 = (
+                    (pp[phase] or {}).get("p99_ms"),
+                    (pp_0[phase] or {}).get("p99_ms"),
+                )
+                if (
+                    isinstance(p99, (int, float))
+                    and isinstance(p99_0, (int, float))
+                    and p99_0 > 0 and p99 > p99_0 * (1 + tol_pct / 100.0)
+                ):
+                    violations.append(
+                        f"serve [{point}] phase {phase}: p99 {p99:.1f} ms "
+                        f"vs baseline {p99_0:.1f} ms "
+                        f"(+{100.0 * (p99 / p99_0 - 1):.1f}% > {tol_pct}% "
+                        "tolerance)"
+                    )
     return violations
 
 

@@ -31,6 +31,12 @@ CLI::
 The end-to-end chaos drive (kill an 8-device CPU-mesh run mid-step, resume
 on a 4-device mesh) lives in ``tests/test_elastic.py`` and the
 ``__graft_entry__`` dryrun's elastic leg, both built on these helpers.
+
+Trace linkage (ISSUE 13): a gate that fires INSIDE a traced request (the
+router's kill gate striking a traced dispatch, a preprocess crash taking
+a traced flush) stamps the victim's trace id on its announcing
+``kind="fault"`` record, so the chaos evidence joins the exact waterfall
+it disrupted (``tools/trace_report.py``).
 """
 
 from __future__ import annotations
@@ -236,6 +242,13 @@ def main(argv=None) -> int:
 
         for name, doc in sorted(FAULT_GATES.items()):
             print(f"{name}\n    {doc}")
+        print(
+            "\nTrace linkage (ISSUE 13): a gate firing INSIDE a traced "
+            "request stamps the active trace id on its announcing "
+            "kind='fault' record (schema v9 trace_id), so chaos evidence "
+            "joins the exact victim waterfall — assemble it with "
+            "tools/trace_report.py over the collector's trace file."
+        )
     return 0
 
 

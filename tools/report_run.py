@@ -21,7 +21,12 @@ run recorded that kind:
   bad-step rollback (trigger → restored epoch, LR backoff) and the
   skipped-step totals / longest streak in the step section;
 - SLO alert lines (rule, value vs threshold, actions) and the final live
-  metrics-registry snapshot (counters + histogram p50/p95/p99).
+  metrics-registry snapshot (counters + histogram p50/p95/p99);
+- fleet timelines (ISSUE 13): per-host collector windows (tracked
+  metrics, clock-offset estimate, counter resets absorbed) and the
+  serve-bench rows' collector-derived per-phase p99 lines — the full
+  cross-process waterfalls render via ``tools/trace_report.py`` over the
+  collector's trace file.
 
 Every record is validated against the shared schema
 (``mpi_pytorch_tpu/obs/schema.py``) first: malformed records are listed and
@@ -234,7 +239,7 @@ def summarize(records: list[dict]) -> dict:
                 "mode", "buckets", "max_wait_ms", "offered_rps", "requests",
                 "rejected", "p50_ms", "p95_ms", "p99_ms", "images_per_sec",
                 "compiles_after_warmup", "fleet_hosts", "precision",
-                "parity_top1",
+                "parity_top1", "per_phase",
             )}
             for r in serve_bench
         ]
@@ -272,6 +277,31 @@ def summarize(records: list[dict]) -> dict:
             )}
             for f in fleet_events
         ]
+    timelines = by_kind.get("timeline", [])
+    if timelines:
+        # One row per host: which metrics the collector tracked, how many
+        # timeline windows landed, the skew estimate, and restarts seen.
+        hosts: dict[str, dict] = {}
+        for t in timelines:
+            h = hosts.setdefault(t["host"], {
+                "metrics": set(), "records": 0, "points": 0,
+                "clock_offset_ms": None, "resets": 0,
+            })
+            h["metrics"].add(t["metric"])
+            h["records"] += 1
+            h["points"] += len(t.get("points") or ())
+            if t.get("clock_offset_ms") is not None:
+                h["clock_offset_ms"] = t["clock_offset_ms"]
+            h["resets"] = max(h["resets"], t.get("resets") or 0)
+        summary["timelines"] = {
+            name: {
+                "metrics": sorted(h["metrics"]), "records": h["records"],
+                "points": h["points"],
+                "clock_offset_ms": h["clock_offset_ms"],
+                "resets": h["resets"],
+            }
+            for name, h in sorted(hosts.items())
+        }
     quant = by_kind.get("quant_parity", [])
     if quant:
         summary["quant_parity"] = [
@@ -477,6 +507,21 @@ def render(path: str, records: list[dict], summary: dict) -> str:
                     f"vs bf16 ({r['buckets']} @ {r['max_wait_ms']} ms)"
                 )
                 break  # the stamp is the startup measurement — one line
+        # The v9 per-phase attribution columns: one compact line per row
+        # carrying the collector-derived breakdown (absent pre-v9).
+        for r in rows:
+            pp = r.get("per_phase")
+            if not pp:
+                continue
+            parts = [
+                f"{name} p99 {st.get('p99_ms')} ms"
+                for name, st in sorted(pp.items())
+                if isinstance(st, dict)
+            ]
+            out.append(
+                f"  per-phase [{r['mode']} {r['buckets']} @ "
+                f"{r['max_wait_ms']} ms]: " + ", ".join(parts)
+            )
     if "fleet_routing" in summary:
         fr = summary["fleet_routing"]
         out += ["", (
@@ -540,6 +585,18 @@ def render(path: str, records: list[dict], summary: dict) -> str:
         else:
             line = f"FLEET {f['event']}: {f.get('host')} {f.get('detail') or ''}"
         out += ["", line]
+    if "timelines" in summary:
+        tl = summary["timelines"]
+        out += ["", (
+            f"fleet timelines: {sum(h['records'] for h in tl.values())} "
+            f"window record(s) over {len(tl)} host(s)"
+        ), table(
+            ["host", "metrics", "records", "points", "clock_offset_ms",
+             "resets"],
+            [[name, len(h["metrics"]), h["records"], h["points"],
+              h["clock_offset_ms"], h["resets"]]
+             for name, h in tl.items()],
+        )]
     for q in summary.get("quant_parity", []):
         out += ["", (
             f"QUANT parity ({q.get('model') or 'model'}, {q['precision']}): "

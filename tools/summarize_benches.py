@@ -181,6 +181,26 @@ def main() -> None:
                 + ", ".join(str(p) for p in sorted(parities))
                 + " (ops/quantize.py; offline oracle: evaluate --quantize-eval)"
             )
+        # The v9 per-phase columns (collector-derived attribution): only
+        # rendered when some row carries per_phase, so pre-v9 artifacts
+        # print the same tables as before.
+        pp_rows = [r for r in sb if r.get("per_phase")]
+        if pp_rows:
+            print("\n### per-phase p99 attribution (tools/trace_report.py "
+                  "renders the waterfalls)\n")
+            phases = sorted({
+                p for r in pp_rows for p in r["per_phase"]
+            })
+            print("| mode | buckets | wait ms | " +
+                  " | ".join(f"{p} p99" for p in phases) + " |")
+            print("|---" * (3 + len(phases)) + "|")
+            for r in pp_rows:
+                cells = [
+                    str((r["per_phase"].get(p) or {}).get("p99_ms", "—"))
+                    for p in phases
+                ]
+                print(f"| {r['mode']} | {_cell(r['buckets'])} | "
+                      f"{r['max_wait_ms']} | " + " | ".join(cells) + " |")
         print()
 
     for name in ("roofline_resnet18.txt", "roofline_densenet121.txt",

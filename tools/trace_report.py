@@ -1,0 +1,326 @@
+"""Assemble cross-process request waterfalls from collected trace spans
+(ISSUE 13 tentpole 3).
+
+Input is the fleet collector's trace file (``--fleet-trace-file``): one
+JSON span per line, skew-corrected at collection time (``obs/collector.py``
+subtracts each host's probe-RTT clock-offset estimate at ingest), so
+spans from different PROCESSES share one time base and order correctly.
+
+Three outputs:
+
+- **per-request waterfalls** — the span tree of one trace rendered as a
+  timeline across process lanes: every dispatch attempt (a failover'd
+  request shows BOTH), the wire hops, and the host-side
+  queue/preprocess/device phases, each bar positioned on the request's
+  own clock;
+- **fleet per-phase latency breakdown** — span-name → count/p50/p99
+  over every collected trace (the attribution table: where fleet time
+  actually goes);
+- **critical-path attribution** — per trace, each span's SELF time
+  (duration minus the time covered by its children) is charged to its
+  phase; the report names the phase that owns the p99 (the largest
+  self-time charge across the slowest traces — "which phase do I fix to
+  move the tail", arXiv 1711.00705's question asked of a fleet).
+
+Run::
+
+    python tools/trace_report.py /tmp/fleet_trace.jsonl            # summary
+    python tools/trace_report.py TRACE.jsonl --trace-id <32hex>    # one waterfall
+    python tools/trace_report.py TRACE.jsonl --waterfalls 3 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_BAR_WIDTH = 40
+
+
+def load_spans(path: str) -> tuple[list[dict], list[str]]:
+    """(spans, problems): every line must be a span-shaped JSON object
+    (trace/span/name/host/pid/t0/t1) — the collector's contract."""
+    spans, problems = [], []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            try:
+                s = json.loads(line)
+            except ValueError as e:
+                problems.append(f"line {lineno}: not JSON ({e})")
+                continue
+            missing = [
+                k for k in ("trace", "span", "name", "host", "pid", "t0", "t1")
+                if k not in s
+            ]
+            if missing:
+                problems.append(f"line {lineno}: span missing {missing}")
+                continue
+            spans.append(s)
+    return spans, problems
+
+
+def group_traces(spans: list[dict]) -> dict[str, list[dict]]:
+    traces: dict[str, list[dict]] = {}
+    for s in spans:
+        traces.setdefault(s["trace"], []).append(s)
+    for members in traces.values():
+        members.sort(key=lambda s: (s["t0"], s["t1"]))
+    return traces
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    n = len(sorted_vals)
+    return sorted_vals[max(0, math.ceil(q * n) - 1)]
+
+
+def phase_breakdown(spans: list[dict]) -> dict[str, dict]:
+    """Span-name → {count, p50_ms, p99_ms, max_ms} over raw durations —
+    the fleet per-phase latency table."""
+    by_name: dict[str, list[float]] = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(1e3 * (s["t1"] - s["t0"]))
+    out = {}
+    for name, durs in sorted(by_name.items()):
+        durs.sort()
+        out[name] = {
+            "count": len(durs),
+            "p50_ms": round(_percentile(durs, 0.50), 3),
+            "p99_ms": round(_percentile(durs, 0.99), 3),
+            "max_ms": round(durs[-1], 3),
+        }
+    return out
+
+
+def self_times(members: list[dict]) -> dict[str, float]:
+    """Per-phase SELF time (ms) within one trace: each span's duration
+    minus the union of its children's intervals — the critical-path
+    charge (concurrent children don't double-bill the parent)."""
+    children: dict[str, list[dict]] = {}
+    for s in members:
+        if s.get("parent"):
+            children.setdefault(s["parent"], []).append(s)
+    charge: dict[str, float] = {}
+    for s in members:
+        kids = children.get(s["span"], ())
+        intervals = sorted(
+            (max(k["t0"], s["t0"]), min(k["t1"], s["t1"])) for k in kids
+        )
+        covered, cursor = 0.0, s["t0"]
+        for a, b in intervals:
+            if b <= cursor:
+                continue
+            covered += b - max(a, cursor)
+            cursor = max(cursor, b)
+        self_ms = max(0.0, 1e3 * ((s["t1"] - s["t0"]) - covered))
+        charge[s["name"]] = charge.get(s["name"], 0.0) + self_ms
+    return charge
+
+
+def trace_summary(trace_id: str, members: list[dict]) -> dict:
+    root = next(
+        (s for s in members if s["name"] == "route/request"), None
+    )
+    t0 = min(s["t0"] for s in members)
+    t1 = max(s["t1"] for s in members)
+    attrs = (root or {}).get("attrs") or {}
+    return {
+        "trace_id": trace_id,
+        "spans": len(members),
+        "processes": len({s["pid"] for s in members}),
+        "hosts": sorted({s["host"] for s in members}),
+        "duration_ms": round(1e3 * (t1 - t0), 3),
+        "status": attrs.get("status"),
+        "redispatches": attrs.get("redispatches", 0),
+        "dispatch_attempts": sum(
+            1 for s in members if s["name"] == "route/dispatch"
+        ),
+        "completions": sum(
+            1 for s in members if s["name"] == "route/request"
+        ),
+        "self_times_ms": {
+            k: round(v, 3) for k, v in sorted(self_times(members).items())
+        },
+    }
+
+
+def critical_path(traces: dict[str, list[dict]]) -> dict | None:
+    """Which phase owns the p99: take the slowest percentile of traces
+    (at least one) and name the phase with the largest total self-time
+    charge inside them — the phase to fix to move the tail."""
+    if not traces:
+        return None
+    durations = sorted(
+        (max(s["t1"] for s in m) - min(s["t0"] for s in m), t)
+        for t, m in traces.items()
+    )
+    cut = max(1, math.ceil(0.01 * len(durations)))
+    slowest = [t for _, t in durations[-cut:]]
+    charge: dict[str, float] = {}
+    for t in slowest:
+        for name, ms in self_times(traces[t]).items():
+            charge[name] = charge.get(name, 0.0) + ms
+    if not charge:
+        return None
+    owner = max(charge, key=charge.get)
+    total = sum(charge.values()) or 1.0
+    return {
+        "phase": owner,
+        "share_pct": round(100.0 * charge[owner] / total, 1),
+        "traces_examined": len(slowest),
+        "p99_trace": slowest[-1],
+        "charges_ms": {k: round(v, 3) for k, v in sorted(charge.items())},
+    }
+
+
+def _depth(span: dict, by_id: dict[str, dict]) -> int:
+    d, seen = 0, set()
+    cur = span
+    while cur.get("parent") and cur["parent"] in by_id:
+        if cur["span"] in seen:  # defensive: a cycle must not hang the tool
+            break
+        seen.add(cur["span"])
+        cur = by_id[cur["parent"]]
+        d += 1
+    return d
+
+
+def render_waterfall(trace_id: str, members: list[dict]) -> str:
+    """One trace as a text timeline: lanes are (pid, host), bars are
+    positioned on the request's own clock — the end-to-end waterfall."""
+    t0 = min(s["t0"] for s in members)
+    t1 = max(s["t1"] for s in members)
+    span_s = max(t1 - t0, 1e-9)
+    by_id = {s["span"]: s for s in members}
+    summary = trace_summary(trace_id, members)
+    out = [
+        f"trace {trace_id} — {summary['duration_ms']} ms, "
+        f"{summary['spans']} span(s) across {summary['processes']} "
+        f"process(es) {summary['hosts']}, status={summary['status']}, "
+        f"dispatch attempts={summary['dispatch_attempts']}, "
+        f"completions={summary['completions']}"
+    ]
+    label_w = max(
+        len("  " * _depth(s, by_id) + s["name"]) for s in members
+    )
+    for s in members:
+        start = 1e3 * (s["t0"] - t0)
+        dur = 1e3 * (s["t1"] - s["t0"])
+        lo = int(_BAR_WIDTH * (s["t0"] - t0) / span_s)
+        hi = int(math.ceil(_BAR_WIDTH * (s["t1"] - t0) / span_s))
+        hi = min(max(hi, lo + 1), _BAR_WIDTH)
+        bar = " " * lo + "#" * (hi - lo) + " " * (_BAR_WIDTH - hi)
+        label = "  " * _depth(s, by_id) + s["name"]
+        attrs = s.get("attrs") or {}
+        note = ""
+        if s["name"] == "route/dispatch":
+            note = f" -> {attrs.get('host')} [{attrs.get('outcome')}]"
+        elif attrs.get("status") and attrs["status"] != "ok":
+            note = f" [{attrs['status']}]"
+        out.append(
+            f"  {label.ljust(label_w)} |{bar}| "
+            f"{start:8.2f} +{dur:8.2f} ms  "
+            f"p{s['pid']}/{s['host']}{note}"
+        )
+    return "\n".join(out)
+
+
+def pick_default_traces(traces: dict[str, list[dict]], n: int) -> list[str]:
+    """The traces worth a waterfall unprompted: re-dispatched ones first
+    (the failover evidence), then the slowest."""
+    redispatched = [
+        t for t, m in traces.items()
+        if sum(1 for s in m if s["name"] == "route/dispatch") > 1
+    ]
+    by_dur = sorted(
+        traces,
+        key=lambda t: max(s["t1"] for s in traces[t])
+        - min(s["t0"] for s in traces[t]),
+        reverse=True,
+    )
+    picked = list(dict.fromkeys(redispatched + by_dur))
+    return picked[:n]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="assemble cross-process request waterfalls from a "
+        "fleet trace file (obs/collector.py output)"
+    )
+    ap.add_argument("trace_file", help="collector span JSONL")
+    ap.add_argument("--trace-id", default="",
+                    help="render exactly this trace's waterfall")
+    ap.add_argument("--waterfalls", type=int, default=1,
+                    help="how many waterfalls to render unprompted "
+                    "(re-dispatched traces first, then slowest)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary instead of text")
+    args = ap.parse_args(argv)
+
+    spans, problems = load_spans(args.trace_file)
+    if problems:
+        print(f"{len(problems)} malformed line(s) in {args.trace_file}:")
+        for p in problems:
+            print(" -", p)
+        return 1
+    if not spans:
+        print(f"{args.trace_file}: no spans")
+        return 1
+    traces = group_traces(spans)
+    breakdown = phase_breakdown(spans)
+    crit = critical_path(traces)
+    if args.trace_id:
+        if args.trace_id not in traces:
+            print(f"trace {args.trace_id} not in {args.trace_file} "
+                  f"({len(traces)} trace(s) present)")
+            return 1
+        picked = [args.trace_id]
+    else:
+        picked = pick_default_traces(traces, args.waterfalls)
+
+    if args.json:
+        print(json.dumps({
+            "spans": len(spans),
+            "traces": len(traces),
+            "phase_breakdown": breakdown,
+            "critical_path": crit,
+            "waterfalls": [
+                trace_summary(t, traces[t]) for t in picked
+            ],
+        }, indent=2))
+        return 0
+
+    print(f"fleet trace report: {args.trace_file}")
+    print(f"  {len(spans)} span(s) in {len(traces)} trace(s) across "
+          f"{len({s['pid'] for s in spans})} process(es)")
+    print()
+    print("per-phase latency breakdown (all collected spans):")
+    name_w = max(len(n) for n in breakdown)
+    print(f"  {'phase'.ljust(name_w)}  {'count':>7}  {'p50_ms':>9}  "
+          f"{'p99_ms':>9}  {'max_ms':>9}")
+    for name, st in breakdown.items():
+        print(f"  {name.ljust(name_w)}  {st['count']:>7}  "
+              f"{st['p50_ms']:>9.3f}  {st['p99_ms']:>9.3f}  "
+              f"{st['max_ms']:>9.3f}")
+    if crit is not None:
+        print()
+        print(
+            f"critical path: phase {crit['phase']} owns the p99 "
+            f"({crit['share_pct']}% of self-time across the "
+            f"{crit['traces_examined']} slowest trace(s))"
+        )
+    for t in picked:
+        print()
+        print(render_waterfall(t, traces[t]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
