@@ -308,6 +308,24 @@ class Config:
     # the serve startup parity stamp, and the --quantize-eval probe.
     quantize_calib: int = 64
 
+    # --- multi-model tenancy (mpi_pytorch_tpu/serve/zoo/, ISSUE 14) ---
+    # Non-empty turns the serving stack multi-tenant: comma-separated
+    # tenant specs "[alias=]arch[:key=val]*" (keys: ckpt, precision,
+    # buckets (|-separated), admission, cold — serve/zoo/registry.py).
+    # Each tenant gets its own per-(model, bucket[, precision]) AOT
+    # executable sets, its own batcher/queue (flushes are single-tenant
+    # by construction), a per-tenant front-door admission budget, and a
+    # model-labelled controller/SLO axis; requests carry model=. "" =
+    # single-model serving, byte-identical to the pre-zoo behavior.
+    serve_models: str = ""
+    # Packing budget (MB) for the resident tenant set on one host —
+    # params + largest-bucket activations per tenant, PR 6's leaf-size
+    # accounting (serve/zoo/registry.plan_packing; the plan is stamped
+    # on swap-in records). A cold swap-in evicts LRU-idle tenants until
+    # the plan fits; a single over-budget tenant is rejected loudly.
+    # 0 = unbounded (the plan is still computed and explained).
+    serve_pack_budget_mb: float = 0.0
+
     # --- fleet serving (mpi_pytorch_tpu/serve/fleet/, ISSUE 9) ---
     # N > 0 builds an in-process N-host fleet (FleetServer: N InferenceServer
     # replicas sharing one warmed executable set, fronted by the load-aware
@@ -714,6 +732,28 @@ class Config:
         if self.serve_max_wait_ms < 0:
             raise ValueError(
                 f"serve_max_wait_ms must be >= 0, got {self.serve_max_wait_ms}"
+            )
+        if self.serve_models:
+            # Parse now so a malformed tenant spec fails at config time,
+            # not at the first cold swap-in (serve/zoo/registry.py).
+            from mpi_pytorch_tpu.serve.zoo.registry import parse_model_specs
+
+            specs = parse_model_specs(self.serve_models)
+            if all(s.cold for s in specs):
+                raise ValueError(
+                    "serve_models marks every tenant :cold — a zoo host "
+                    "would start serving nothing"
+                )
+        if self.serve_pack_budget_mb < 0:
+            raise ValueError(
+                f"serve_pack_budget_mb must be >= 0 (0 = unbounded), "
+                f"got {self.serve_pack_budget_mb}"
+            )
+        if self.serve_pack_budget_mb and not self.serve_models:
+            raise ValueError(
+                "serve_pack_budget_mb bounds the multi-tenant packing "
+                "plan and needs serve_models (single-model serving has "
+                "no packing axis)"
             )
         if self.serve_queue_depth < 1:
             raise ValueError(

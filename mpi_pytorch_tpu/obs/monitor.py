@@ -203,10 +203,16 @@ class SLOMonitor:
         tracer=None,
         logger=None,
         clock=time.monotonic,
+        labels: dict | None = None,
     ):
         self.registry = registry
         self.rules = rules
         self.metrics = metrics
+        # Static labels merged into every alert record (schema v10):
+        # a zoo tenant's monitor passes {"model": <tenant>} so its SLO
+        # breaches are attributable per tenant (ISSUE 14). Only
+        # schema-known keys should be passed.
+        self.labels = dict(labels or {})
         self.preempt_path = preempt_path or os.environ.get("MPT_PREEMPT_FILE", "")
         self.tracer = tracer
         self._logger = logger
@@ -291,6 +297,7 @@ class SLOMonitor:
             "threshold": rule.threshold,
             "streak": rule.streak,
             "action": ",".join(rule.actions),
+            **self.labels,
         }
         if epoch is not None:
             record["epoch"] = epoch

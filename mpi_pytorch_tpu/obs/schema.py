@@ -19,16 +19,16 @@ Record kinds (every record also carries ``ts``, the epoch-seconds stamp
 | step      | epoch, step, loss                                   | grad_norm, data_wait_ms, step_ms, recompiles, hbm_bytes, sync_ms, overlap_frac, skipped, steps_skipped |
 | heartbeat | epoch, step, step_ms, median_step_ms, stragglers, threshold | images_per_sec |
 | anomaly   | reason, epoch                                       | step, loss, grad_norm, path, detail |
-| serve     | bucket, requests, queue_depth, fill_ratio, queue_wait_ms, device_ms | preprocess_ms, total_ms, precision |
-| serve_bench | mode, buckets, max_wait_ms, requests, p50_ms, p95_ms, p99_ms, images_per_sec | model, offered_rps, rejected, mean_fill_ratio, compiles_after_warmup, chips, precision, parity_top1 |
+| serve     | bucket, requests, queue_depth, fill_ratio, queue_wait_ms, device_ms | preprocess_ms, total_ms, precision, model |
+| serve_bench | mode, buckets, max_wait_ms, requests, p50_ms, p95_ms, p99_ms, images_per_sec | model, offered_rps, rejected, mean_fill_ratio, compiles_after_warmup, chips, precision, parity_top1, load_shape |
 | quant_parity | precision, top1_agree, samples                   | top5_agree, max_logit_drift, model |
 | resume    | epoch, to_devices                                   | from_devices, from_mesh, to_mesh, path, zero_shards_from, zero_shards_to, corrupt_skipped, strategy, cursor_epoch, cursor_step |
 | fault     | reason                                              | epoch, step, detail, streak |
 | rollback  | epoch, reason                                       | step, restored_epoch, rollbacks, lr_scale, path, detail |
 | metrics   | counters, gauges, histograms                        | merged_hosts |
 | alert     | rule, severity                                      | metric, value, threshold, streak, action, detail, epoch, step |
-| route     | host, requests                                      | share, score, queue_depth, inflight, window_s, transport, trace_ids |
-| fleet     | event                                               | host, detail, redispatched, spare, max_wait_ms_from/to, buckets_from/to, p99_ms, target_p99_ms, compiles_after_warmup, hosts_from/to, reason, reject_rate, queue_depth, restarts, transport |
+| route     | host, requests                                      | share, score, queue_depth, inflight, window_s, transport, trace_ids, models |
+| fleet     | event                                               | host, detail, redispatched, spare, max_wait_ms_from/to, buckets_from/to, p99_ms, target_p99_ms, compiles_after_warmup, hosts_from/to, reason, reject_rate, queue_depth, restarts, transport, model, resident, plan |
 | timeline  | host, metric, points                                | window_s, clock_offset_ms, resets |
 
 ``serve`` is the per-flush record the online inference server writes
@@ -125,7 +125,21 @@ from typing import Any, Mapping
 #      and optional ``per_phase`` on ``serve_bench`` rows (the
 #      collector-derived queue/preprocess/device/wire p50/p99 breakdown
 #      per sweep point).
-SCHEMA_VERSION = 9
+#  10: the multi-model-tenancy generation (ISSUE 14): ``serve`` flushes
+#      may carry ``model`` (the tenant the single-tenant-by-construction
+#      flush served), ``route`` windows may carry ``models`` (per-tenant
+#      dispatch counts of the window), ``fleet`` records grow the zoo
+#      lifecycle events ``swap_in``/``evict`` (the cold-model swap-in /
+#      LRU-or-operator eviction, with ``model``, the ``resident`` tenant
+#      list after the change, and — on swap-ins — the explainable
+#      packing ``plan`` the decision rested on), controller ``retune``
+#      and autoscaler ``scale_up``/``scale_down`` records may carry
+#      ``model`` (the tenant retuned / the pressured tenant), ``alert``
+#      records may carry ``model`` (the SLO monitor's tenant label), and
+#      ``serve_bench`` rows may carry ``load_shape`` (the multi-tenant
+#      sweep's traffic shape, e.g. "uniform" / "hot:resnet18"). All
+#      absent on untenanted serving — streams stay byte-identical to v9.
+SCHEMA_VERSION = 10
 
 _NUM = (int, float)
 _INT = (int,)
@@ -214,6 +228,9 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # byte-identical to v8; the no-hot-path-cost invariant's record
         # half).
         "trace_ids": (list,),
+        # v10: the tenant this flush served (flushes are single-tenant
+        # by construction — serve/zoo/) — absent on untenanted servers.
+        "model": (str,),
     },
     "serve_bench": {
         "model": (str,), "offered_rps": _NUM, "rejected": _INT,
@@ -236,6 +253,11 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # queue/preprocess/device/wire attribution; absent without a
         # collector, so pre-v9 rows compare unchanged).
         "per_phase": (dict,),
+        # v10: the multi-tenant sweep's traffic shape ("uniform" /
+        # "hot:<model>") — keyed into the regression trend-line identity
+        # alongside model, so a skewed-load row never compares against a
+        # uniform baseline.
+        "load_shape": (str,),
     },
     "resume": {
         "from_devices": _INT, "from_mesh": (str,), "to_mesh": (str,),
@@ -270,12 +292,19 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # v9: the traced requests dispatched to this host in the window
         # (bounded; absent when tracing is off — streams unchanged).
         "trace_ids": (list,),
+        # v10: per-tenant dispatch counts of this window (multi-model
+        # fleets only — absent otherwise, streams unchanged).
+        "models": (dict,),
     },
     "fleet": {
         "host": (str,), "detail": (str,), "redispatched": _INT,
         "spare": (str,), "max_wait_ms_from": _NUM, "max_wait_ms_to": _NUM,
         "buckets_from": (str,), "buckets_to": (str,), "p99_ms": _NUM,
         "target_p99_ms": _NUM, "compiles_after_warmup": _INT,
+        # v10: the multi-model axis — the tenant a retune/scale acted on
+        # (or the swap_in/evict subject), the resident set after a zoo
+        # residency change, and the packing plan a swap-in rested on.
+        "model": (str,), "resident": (list,), "plan": (dict,),
         # v7: the controller's precision retune axis — which executable
         # set the host left/entered, and the measured int8-vs-bf16 top-1
         # agreement stamped as the retune's accuracy evidence.
@@ -304,6 +333,9 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
     "alert": {
         "metric": (str,), "value": _NUM, "threshold": _NUM, "streak": _INT,
         "action": (str,), "detail": (str,), "epoch": _INT, "step": _INT,
+        # v10: the SLO monitor's tenant label (a zoo tenant's rules fire
+        # with its model stamped) — absent on untenanted monitors.
+        "model": (str,),
     },
     # v7: top5_agree is null for fused (argmax-only) contracts.
     "quant_parity": {

@@ -14,6 +14,10 @@ AOT-compiled predict executables — the serving half of the north star.
 - ``fleet/``: the multi-host layer — load-aware router with cross-host
   admission control and warm-spare failover, plus the live autotuning
   controller (ISSUE 9 / ROADMAP item 1).
+- ``zoo/``: multi-model tenancy (ISSUE 14) — the whole model zoo served
+  as tenants: per-(model, bucket[, precision]) executable sets under a
+  VMEM/HBM-aware packing plan, model-aware routing with per-tenant
+  admission/SLO isolation, and cold-model swap-in with LRU eviction.
 
 Load-drive it with ``tools/bench_serve.py`` (``--fleet N`` for the fleet
 path); tune it with ``docs/SERVING.md``.
@@ -32,6 +36,16 @@ from mpi_pytorch_tpu.serve.batcher import (
 )
 from mpi_pytorch_tpu.serve.executables import BucketExecutables
 from mpi_pytorch_tpu.serve.server import InferenceServer, local_replica_mesh
+from mpi_pytorch_tpu.serve.zoo import (
+    ModelNotResidentError,
+    ModelRegistry,
+    PackingError,
+    UnknownModelError,
+    ZooExecutablePool,
+    ZooHost,
+    ZooServer,
+    parse_model_specs,
+)
 from mpi_pytorch_tpu.serve.fleet import (
     FleetAutoscaler,
     FleetController,
@@ -55,7 +69,10 @@ __all__ = [
     "HostUnavailableError",
     "InferenceServer",
     "LocalHost",
+    "ModelNotResidentError",
+    "ModelRegistry",
     "NoLiveHostError",
+    "PackingError",
     "PendingRequest",
     "PreprocessError",
     "QueueFullError",
@@ -63,7 +80,12 @@ __all__ = [
     "RemoteHost",
     "ServeError",
     "ServerClosedError",
+    "UnknownModelError",
+    "ZooExecutablePool",
+    "ZooHost",
+    "ZooServer",
     "local_replica_mesh",
-    "parse_buckets",
+    "parse_model_specs",
     "pick_bucket",
+    "parse_buckets",
 ]

@@ -48,11 +48,18 @@ class QueueFullError(ServeError):
     long until the backlog has room again. Clients back off by it instead
     of hammering; the fleet router's admission control threads the hint
     through its own front-door rejections. None when no drain has been
-    observed yet (a hint would be a guess, not a measurement)."""
+    observed yet (a hint would be a guess, not a measurement).
 
-    def __init__(self, message: str, retry_after_ms: float | None = None):
+    ``model`` (ISSUE 14): WHICH tenant was rejected. A multi-model fleet
+    enforces per-tenant admission budgets, and the typed rejection must
+    say whose budget bound — a client serving two tenants backs off the
+    saturated one only. None on untenanted (single-model) serving."""
+
+    def __init__(self, message: str, retry_after_ms: float | None = None,
+                 model: str | None = None):
         super().__init__(message)
         self.retry_after_ms = retry_after_ms
+        self.model = model
 
 
 class ServerClosedError(ServeError):
@@ -68,6 +75,20 @@ class HostUnavailableError(ServeError):
     never like a request-fault ``ServeError``, which propagates to the
     caller (re-dispatching a poison request would just poison another
     host's flush)."""
+
+
+class UnknownModelError(ServeError):
+    """A request (or control op) named a tenant the model registry does
+    not hold (ISSUE 14) — a REQUEST-shaped fault: it propagates to the
+    caller, and the fleet router must never re-dispatch it or count it
+    against a host (no host anywhere can serve it)."""
+
+
+class ModelNotResidentError(ServeError):
+    """The tenant is registered but not resident on THIS host
+    (ISSUE 14) — a RESIDENCY fault, not host sickness: the router
+    re-routes to a host that holds it (or cold-loads it) without
+    striking the refusing host's failure streak."""
 
 
 class PreprocessError(ServeError):

@@ -106,6 +106,7 @@ class FleetAutoscaler:
         self._logger = logger or run_logger()
         self._clock = clock
         self._last_rejects = 0
+        self._last_rejects_by_model: dict[str, int] = {}
         self._last_tick_t: float | None = None
         self._last_action_t: float | None = None
         self._idle_streak = 0
@@ -129,6 +130,23 @@ class FleetAutoscaler:
             (rejects - self._last_rejects) / dt if dt and dt > 0 else 0.0
         )
         self._last_rejects = rejects
+        # Tenant-aware pressure (ISSUE 14): per-model front-door reject
+        # deltas name WHICH tenant is starved — the scale-up record (and
+        # its reason) carry the pressured tenant, so "why did the fleet
+        # grow" is answerable per model.
+        pressured_model = None
+        by_model = dict(
+            getattr(self._router, "rejections_by_model", None) or {}
+        )
+        if by_model and dt and dt > 0:
+            deltas = {
+                m: (n - self._last_rejects_by_model.get(m, 0)) / dt
+                for m, n in by_model.items()
+            }
+            worst = max(deltas, key=deltas.get)
+            if deltas[worst] > 0:
+                pressured_model = worst
+        self._last_rejects_by_model = by_model
         self._last_tick_t = now
 
         p99 = None
@@ -155,6 +173,7 @@ class FleetAutoscaler:
             "p99_ms": p99,
             "queue_depth": queue_depth,
             "queue_rising": rising,
+            "pressured_model": pressured_model,
         }
 
     # ------------------------------------------------------------- the tick
@@ -226,6 +245,9 @@ class FleetAutoscaler:
             record["host"] = host_name
         if sig["p99_ms"] is not None:
             record["p99_ms"] = round(sig["p99_ms"], 3)
+        if sig.get("pressured_model") is not None:
+            # Schema-v10: the tenant whose rejections drove the action.
+            record["model"] = sig["pressured_model"]
         if self.target_p99_ms > 0:
             record["target_p99_ms"] = self.target_p99_ms
         if compiles is not None:
@@ -241,6 +263,8 @@ class FleetAutoscaler:
             else f"p99 {sig['p99_ms']:.1f} ms over target "
                  f"{self.target_p99_ms:.1f} with rising queues"
         )
+        if sig.get("pressured_model") is not None:
+            reason += f" (pressured tenant: {sig['pressured_model']})"
         try:
             host = self._spawn_fn()
         except Exception as e:  # noqa: BLE001 — a failed spawn must not kill the loop

@@ -115,17 +115,26 @@ class FleetController:
     # ----------------------------------------------------------- one tick
 
     def tick(self) -> int:
-        """Evaluate every live host once; returns how many were retuned."""
+        """Evaluate every live host once; returns how many retunes ran.
+
+        Multi-model hosts (ISSUE 14) expand into per-TENANT units
+        (``ZooHost.tenants()``): each tenant's knobs (max_wait / active
+        buckets / precision ladder) retune against ITS OWN latency
+        sketch, and the retune record carries the ``model`` label — one
+        hot tenant's breach never sheds a healthy tenant's buckets."""
         retuned = 0
         for host in list(self._hosts_fn()):
-            try:
-                if self._tick_host(host):
-                    retuned += 1
-            except ServeError as e:
-                self._logger.warning(
-                    "fleet controller: host %s retune failed: %s",
-                    host.name, e,
-                )
+            tenants_fn = getattr(host, "tenants", None)
+            units = tenants_fn() if callable(tenants_fn) else [host]
+            for unit in units:
+                try:
+                    if self._tick_host(unit):
+                        retuned += 1
+                except ServeError as e:
+                    self._logger.warning(
+                        "fleet controller: host %s retune failed: %s",
+                        unit.name, e,
+                    )
         return retuned
 
     def _tick_host(self, host) -> bool:
@@ -217,7 +226,7 @@ class FleetController:
             record = {
                 "kind": "fleet",
                 "event": "retune",
-                "host": host.name,
+                "host": getattr(host, "host_name", host.name),
                 "max_wait_ms_from": round(wait_from, 3),
                 "max_wait_ms_to": round(wait_to, 3),
                 "buckets_from": ",".join(str(b) for b in active_from),
@@ -226,6 +235,12 @@ class FleetController:
                 "target_p99_ms": self.target_p99_ms,
                 "compiles_after_warmup": compiles,
             }
+            model = getattr(host, "model", None)
+            if model is not None:
+                # Schema-v10: the tenant this retune acted on — the
+                # model-labelled knob axis (absent on untenanted hosts,
+                # records byte-identical to v9).
+                record["model"] = model
             if prec_to != prec_from:
                 # Schema-v7: a precision switch carries the measured
                 # top-1 parity delta between the two sets — the accuracy

@@ -85,7 +85,10 @@ def _classify_http_error(e: urllib.error.HTTPError) -> Exception:
         payload = {}
     detail = payload.get("detail") or payload.get("error") or str(e)
     if e.code == 429:
-        return QueueFullError(detail, retry_after_ms=payload.get("retry_after_ms"))
+        return QueueFullError(
+            detail, retry_after_ms=payload.get("retry_after_ms"),
+            model=payload.get("model"),
+        )
     if e.code == 503:
         return ServerClosedError(detail)
     if e.code == 408:
@@ -154,6 +157,13 @@ class RemoteHost:
         self._facts_lock = threading.Lock()
         self._facts_cache: dict | None = None
         self._facts_t = -1.0
+        # Facts generation (ISSUE 14 satellite): zoo hosts bump this
+        # counter on every resident-model change (swap-in/evict), and it
+        # rides BOTH /healthz and /metricsz — so the probe loop's
+        # snapshot invalidates a stale facts cache the moment the
+        # resident set changes, and the router never dispatches a tenant
+        # to a host that just evicted it.
+        self._facts_gen: int | None = None
         # First probe pins the static facts (capacity, compiled buckets,
         # pid) — constructing a RemoteHost against a dead endpoint is a
         # loud typed failure, not a handle that fails later.
@@ -219,7 +229,21 @@ class RemoteHost:
         with self._facts_lock:
             self._facts_cache = facts
             self._facts_t = time.monotonic()
+            gen = facts.get("facts_generation")
+            if gen is not None:
+                self._facts_gen = int(gen)
         return facts
+
+    def _note_generation(self, gen) -> None:
+        """A sighting of the host's facts generation from ANY payload
+        (the /metricsz probe, mainly): a change means the resident model
+        set moved — the cached facts are stale NOW, TTL notwithstanding."""
+        if gen is None:
+            return
+        with self._facts_lock:
+            if self._facts_gen is not None and int(gen) != self._facts_gen:
+                self._facts_t = -1.0
+            self._facts_gen = int(gen)
 
     def _facts(self) -> dict:
         """The last /healthz payload, refreshed when stale — the cheap
@@ -236,11 +260,14 @@ class RemoteHost:
 
     # ------------------------------------------------------------- requests
 
-    def submit(self, image, trace=None) -> Future:
+    def submit(self, image, trace=None, model=None) -> Future:
         """POST the request bytes; the future resolves from the result
         long-poll. NO wire retries: a submit is not idempotent, and a
         failed submit is exactly the signal the router's drain streak
         and re-dispatch machinery exist to consume.
+
+        ``model`` (ISSUE 14) names the tenant on a multi-model host —
+        it rides the wire as the ``?model=`` query of ``POST /submit``.
 
         ``trace`` (optional ``obs.TraceContext``) rides the wire as a
         W3C-style ``Traceparent`` header — the serving process parents
@@ -258,8 +285,13 @@ class RemoteHost:
 
             headers = {"Traceparent": format_traceparent(trace)}
             t_wire = time.time()
+        path = "/submit"
+        if model is not None:
+            import urllib.parse
+
+            path += "?model=" + urllib.parse.quote(str(model))
         resp = json.loads(self._request(
-            "POST", "/submit", buf.getvalue(),
+            "POST", path, buf.getvalue(),
             timeout=self.connect_timeout_s, retries=0,
             ctype="application/octet-stream", headers=headers,
         ).decode())
@@ -334,10 +366,12 @@ class RemoteHost:
     # ----------------------------------------------------- telemetry / control
 
     def snapshot(self) -> dict:
-        return self._request_json(
+        snap = self._request_json(
             "GET", "/metricsz", timeout=self.connect_timeout_s,
             retries=self.probe_retries,
         )
+        self._note_generation(snap.get("facts_generation"))
+        return snap
 
     def alive(self) -> bool:
         try:
@@ -404,14 +438,48 @@ class RemoteHost:
     def parity_top1(self):
         return self._facts().get("parity_top1")
 
-    def _control(self, op: str, value=None) -> None:
+    # -- multi-model tenancy (ISSUE 14) --------------------------------
+    def models(self):
+        """The host's RESIDENT tenant set from its /healthz facts — the
+        router's dispatch filter. None = an untenanted (single-model)
+        host: the key is simply absent from its facts. The facts cache
+        serves this read; the generation counter keeps it coherent
+        through swap-ins/evictions."""
+        try:
+            models = self._facts().get("models")
+        except ServeError:
+            return ()
+        return None if models is None else tuple(models)
+
+    @property
+    def facts_generation(self):
+        return self._facts().get("facts_generation")
+
+    def ensure_model(self, model: str) -> None:
+        """The router's cold-load spill, over the wire. NOT idempotent-
+        retried (a retry would queue a second build behind the first),
+        and on the READ timeout: the control call holds the wire for
+        the whole load + warm-probe."""
+        self._control(
+            "ensure_model", str(model), retries=0,
+            timeout=max(self.read_timeout_s, self.result_timeout_s),
+        )
+
+    def evict_model(self, model: str) -> None:
+        self._control("evict_model", str(model), retries=0)
+
+    def _control(self, op: str, value=None, retries: int | None = None,
+                 timeout: float | None = None) -> None:
         payload = {"op": op}
         if value is not None:
             payload["value"] = value
-        # Control sets are idempotent → the probe retry budget applies.
+        # Control sets are idempotent → the probe retry budget applies
+        # (callers override for the non-idempotent zoo swap-in, which
+        # also holds the wire for the whole build — read timeout).
         self._request_json(
-            "POST", "/control", payload, timeout=self.connect_timeout_s,
-            retries=self.probe_retries,
+            "POST", "/control", payload,
+            timeout=self.connect_timeout_s if timeout is None else timeout,
+            retries=self.probe_retries if retries is None else retries,
         )
         with self._facts_lock:
             # A knob just moved: the next property read must not serve
@@ -876,6 +944,19 @@ class RemoteFleet:
 
         hosts = [spawned[i][1] for i in indices[:n]]
         spare_host = spawned[indices[n]][1] if want_spare else None
+        # Per-tenant front-door budgets (ISSUE 14): the zoo children
+        # advertise their tenants over /healthz; the router enforces the
+        # same isolation as the in-process fleet.
+        tenant_budgets = None
+        if cfg.serve_models:
+            from mpi_pytorch_tpu.serve.zoo import ModelRegistry
+
+            fleet_budget = cfg.serve_admission_tokens or sum(
+                h.queue_capacity for h in hosts
+            )
+            tenant_budgets = ModelRegistry.from_config(cfg).tenant_budgets(
+                fleet_budget
+            )
         warmup_payload = np.zeros((*cfg.image_size, 3), np.uint8)
         self.router = FleetRouter(
             hosts, spare_host,
@@ -887,6 +968,7 @@ class RemoteFleet:
             logger=self._logger,
             trace_sample_rate=cfg.trace_sample_rate,
             spans=self.spans,
+            tenant_budgets=tenant_budgets,
         )
         if self.collector is not None:
             self.collector.start()
@@ -1014,11 +1096,12 @@ class RemoteFleet:
 
     # -------------------------------------------------------------- requests
 
-    def submit(self, image):
-        return self.router.submit(image)
+    def submit(self, image, model: str | None = None):
+        return self.router.submit(image, model=model)
 
-    def predict_batch(self, images, timeout: float | None = None):
-        return self.router.predict_batch(images, timeout=timeout)
+    def predict_batch(self, images, timeout: float | None = None,
+                      model: str | None = None):
+        return self.router.predict_batch(images, timeout=timeout, model=model)
 
     # ------------------------------------------------------------- inspection
 
@@ -1051,6 +1134,22 @@ class RemoteFleet:
         spare = self.router.spare_host()
         if spare is not None:
             spare.set_precision(precision)
+
+    def tenant_stats(self) -> dict:
+        """model → fleet-wide per-tenant counters (the in-process
+        FleetServer surface, over the wire /statsz 'models' sections;
+        a host dying mid-inspection contributes nothing, not an error)."""
+        from mpi_pytorch_tpu.serve.fleet.router import aggregate_tenant_stats
+
+        host_stats = []
+        for h in self.router.active_hosts():
+            try:
+                host_stats.append(h.stats())
+            except ServeError:
+                continue
+        return aggregate_tenant_stats(
+            host_stats, self.router.rejections_by_model
+        )
 
     def stats(self) -> dict:
         hosts = {}

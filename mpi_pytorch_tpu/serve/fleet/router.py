@@ -56,9 +56,11 @@ from dataclasses import dataclass, field
 
 from mpi_pytorch_tpu.serve.batcher import (
     HostUnavailableError,
+    ModelNotResidentError,
     QueueFullError,
     ServeError,
     ServerClosedError,
+    UnknownModelError,
 )
 
 
@@ -66,6 +68,30 @@ class NoLiveHostError(ServeError):
     """Every serving host (and the spare) is drained/dead — the fleet has
     no capacity at all. Distinct from backpressure: retrying will not
     help until a host comes back."""
+
+
+def aggregate_tenant_stats(host_stats, rejections_by_model) -> dict:
+    """model → fleet-wide per-tenant counters, folded from the hosts'
+    per-tenant ``models`` stats sections plus the router's front-door
+    rejection counts — ONE definition shared by the local and remote
+    fleet harnesses (their bench/CI columns must never diverge)."""
+    out: dict = {}
+
+    def _agg(model):
+        return out.setdefault(model, {
+            "served": 0, "rejected": 0, "padded_rows": 0,
+            "front_door_rejections": 0,
+        })
+
+    for stats in host_stats:
+        for model, s in (stats.get("models") or {}).items():
+            agg = _agg(model)
+            agg["served"] += s.get("served", 0)
+            agg["rejected"] += s.get("rejected", 0)
+            agg["padded_rows"] += s.get("padded_rows", 0)
+    for model, n in (rejections_by_model or {}).items():
+        _agg(model)["front_door_rejections"] = n
+    return out
 
 
 @dataclass
@@ -83,6 +109,11 @@ class _HostState:
     # Trace ids of TRACED requests dispatched here this window (bounded;
     # stamped on the route record — empty/absent when tracing is off).
     window_traces: list = field(default_factory=list)
+    # Multi-model fleet (ISSUE 14): per-tenant queue depth from the last
+    # snapshot (the per-(host, model) half of the dispatch score) and the
+    # per-tenant dispatch counts of this route window.
+    model_qdepth: dict = field(default_factory=dict)
+    window_models: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -94,6 +125,10 @@ class _Flight:
     payload: object
     future: Future
     host: str | None = None  # current assignment (None while re-dispatching)
+    # The tenant this request names (ISSUE 14): the routing key of every
+    # dispatch decision, the per-tenant admission token it holds, and the
+    # model stamped on its spans. None = untenanted (single-model) fleet.
+    model: str | None = None
     redispatches: int = 0
     # Cross-process trace context minted at admission (None = untraced):
     # the trace id every dispatch attempt, wire hop, and host-side span
@@ -126,10 +161,22 @@ class LocalHost:
         self.index = server.host_index
 
     # -- request path -------------------------------------------------
-    def submit(self, image, trace=None) -> Future:
+    def submit(self, image, trace=None, model=None) -> Future:
+        if model is not None:
+            # Only the zoo twin (serve/zoo/ZooHost) serves tenants; the
+            # router never routes a tenant here (models() is None), so
+            # this is a harness-misuse guard, not a runtime path.
+            raise ServeError(
+                f"host {self.name} is not multi-tenant (model={model!r})"
+            )
         if trace is not None:
             return self.server.submit(image, trace=trace)
         return self.server.submit(image)
+
+    def models(self):
+        """Resident tenant set (ISSUE 14) — None on an untenanted host:
+        the router routes model-less requests only."""
+        return None
 
     # -- telemetry / control ------------------------------------------
     def snapshot(self) -> dict:
@@ -231,6 +278,7 @@ class FleetRouter:
         seed: int = 0,
         trace_sample_rate: float = 0.0,
         spans=None,
+        tenant_budgets: dict | None = None,
     ):
         if not hosts:
             raise ValueError("a fleet needs at least one serving host")
@@ -278,6 +326,17 @@ class FleetRouter:
             h.queue_capacity for h in self._active
         )
         self._tokens = self.budget
+        # Per-tenant admission (ISSUE 14): each tenant holds its own
+        # front-door token budget, so one hot tenant exhausts ITS tokens
+        # and is rejected while the others keep admitting — the
+        # isolation guarantee. None/{} = untenanted fleet (global budget
+        # only). Rejections are counted per tenant for the autoscaler's
+        # "which tenant is pressured" signal.
+        self.tenant_budgets = dict(tenant_budgets or {})
+        self._tenant_tokens = dict(self.tenant_budgets)
+        self.rejections_by_model: dict[str, int] = {
+            m: 0 for m in self.tenant_budgets
+        }
         self.front_door_rejections = 0
         self.redispatch_log: list[int] = []  # flight ids, append-only
         self.failovers: list[str] = []  # drained host names
@@ -297,12 +356,18 @@ class FleetRouter:
 
     # ------------------------------------------------------------ admission
 
-    def submit(self, image) -> Future:
+    def submit(self, image, model: str | None = None) -> Future:
         """Admit one request fleet-wide, or reject at the front door.
 
-        Raises ``QueueFullError`` (with ``retry_after_ms``) when the
-        global token budget is exhausted — one hot host's backpressure
-        becomes a fleet-level signal here, before any per-host queue can
+        ``model`` names the tenant on a multi-model fleet (ISSUE 14):
+        admission first charges the TENANT's token budget (a hot tenant
+        exhausts its own tokens and is rejected — the typed error names
+        it — while other tenants keep admitting), then the global one;
+        dispatch is then per-(host, model).
+
+        Raises ``QueueFullError`` (with ``retry_after_ms``) when either
+        budget is exhausted — one hot host's backpressure becomes a
+        fleet-level signal here, before any per-host queue can
         overflow — and ``NoLiveHostError`` when every host is drained."""
         if self._closed:
             raise ServerClosedError("fleet router is shut down")
@@ -312,27 +377,48 @@ class FleetRouter:
 
             trace = mint_trace()
         with self._lock:
-            if self._tokens <= 0:
+            tenant_bound = (
+                model is not None
+                and model in self._tenant_tokens
+                and self._tenant_tokens[model] <= 0
+            )
+            if tenant_bound or self._tokens <= 0:
                 self.front_door_rejections += 1
+                if model is not None:
+                    self.rejections_by_model[model] = (
+                        self.rejections_by_model.get(model, 0) + 1
+                    )
                 hint = self._retry_hint_locked()
                 if trace is not None:
                     # A rejected request still leaves a (zero-length)
                     # root span: tail sampling keeps every rejection.
                     now = time.time()
+                    attrs = {"status": "rejected", "redispatches": 0,
+                             "retry_after_ms": hint}
+                    if model is not None:
+                        attrs["model"] = model
                     self.spans.add(
                         name="route/request", trace=trace.trace_id,
                         span=trace.span_id, t0=now, t1=now, host="router",
-                        attrs={"status": "rejected", "redispatches": 0,
-                               "retry_after_ms": hint},
+                        attrs=attrs,
+                    )
+                if tenant_bound:
+                    raise QueueFullError(
+                        f"tenant {model!r} admission budget exhausted "
+                        f"({self.tenant_budgets[model]} in flight); "
+                        "retry later",
+                        retry_after_ms=hint, model=model,
                     )
                 raise QueueFullError(
                     f"fleet admission budget exhausted ({self.budget} "
                     "in flight); retry later",
-                    retry_after_ms=hint,
+                    retry_after_ms=hint, model=model,
                 )
             self._tokens -= 1
+            if model is not None and model in self._tenant_tokens:
+                self._tenant_tokens[model] -= 1
             entry = _Flight(
-                next(self._ids), image, Future(),
+                next(self._ids), image, Future(), model=model,
                 trace=trace, t_submit_wall=time.time() if trace else 0.0,
             )
             self._inflight[entry.fid] = entry
@@ -351,13 +437,20 @@ class FleetRouter:
                     entry.finished = True
                     self._inflight.pop(entry.fid, None)
                     self._tokens += 1
+                    self._release_tenant_token(entry)
             raise
         return entry.future
 
-    def predict_batch(self, images, timeout: float | None = None):
+    def _release_tenant_token(self, entry: _Flight) -> None:
+        """Return the entry's per-tenant admission token (lock held)."""
+        if entry.model is not None and entry.model in self._tenant_tokens:
+            self._tenant_tokens[entry.model] += 1
+
+    def predict_batch(self, images, timeout: float | None = None,
+                      model: str | None = None):
         import numpy as np
 
-        futs = [self.submit(im) for im in images]
+        futs = [self.submit(im, model=model) for im in images]
         return np.stack([f.result(timeout=timeout) for f in futs])
 
     def _retry_hint_locked(self) -> float:
@@ -372,11 +465,44 @@ class FleetRouter:
         """Assign ``entry`` to the best host and hand it over. Host-level
         backpressure or a dead host falls through to the next-best choice;
         only when EVERY live host rejects does the failure reach the
-        caller (sync path) or the entry's future (re-dispatch path)."""
+        caller (sync path) or the entry's future (re-dispatch path).
+
+        A tenant request (``entry.model``) routes to hosts with the model
+        RESIDENT; when none is live, it spills to the best host that can
+        COLD-LOAD it (``ensure_model`` — the zoo swap-in) before the
+        hand-over. A cold-load failure is host-shaped: counted, excluded,
+        next candidate."""
         while True:
-            host = self._pick(exclude)
+            host, resident = self._pick(exclude, entry.model)
             if host is None:
-                raise NoLiveHostError("no live serving hosts in the fleet")
+                raise NoLiveHostError(
+                    "no live serving hosts in the fleet"
+                    if entry.model is None else
+                    f"no live host has (or can cold-load) model "
+                    f"{entry.model!r}"
+                )
+            if not resident:
+                try:
+                    host.ensure_model(entry.model)
+                except UnknownModelError:
+                    # Request-shaped: no host anywhere holds this tenant
+                    # — propagate, never strike a host for it (a typo'd
+                    # model name must not drain a healthy fleet).
+                    raise
+                except ServeError as e:
+                    # The swap-in failed (packing budget, warm probe):
+                    # THIS host cannot take the tenant, but that is not
+                    # evidence of host sickness — exclude it for this
+                    # request without feeding its drain streak, and try
+                    # the next candidate.
+                    self._logger.warning(
+                        "fleet: cold-load of %s on %s failed: %s",
+                        entry.model, host.name, e,
+                    )
+                    exclude = exclude | {host.name}
+                    if self._has_candidate(exclude, entry.model):
+                        continue
+                    raise
             with self._lock:
                 entry.host = host.name
                 entry.redispatching = False  # claim fulfilled: assigned
@@ -384,6 +510,10 @@ class FleetRouter:
                 st.outstanding += 1
                 st.dispatched_total += 1
                 st.window_requests += 1
+                if entry.model is not None:
+                    st.window_models[entry.model] = (
+                        st.window_models.get(entry.model, 0) + 1
+                    )
                 dispatched_total = st.dispatched_total
                 if entry.trace is not None and len(st.window_traces) < 32:
                     st.window_traces.append(entry.trace.trace_id)
@@ -397,10 +527,12 @@ class FleetRouter:
                 d_ctx = entry.trace.child()
                 d_t0 = time.time()
             try:
+                kwargs = {}
                 if d_ctx is not None:
-                    hfut = host.submit(entry.payload, trace=d_ctx)
-                else:
-                    hfut = host.submit(entry.payload)
+                    kwargs["trace"] = d_ctx
+                if entry.model is not None:
+                    kwargs["model"] = entry.model
+                hfut = host.submit(entry.payload, **kwargs)
             except BaseException as e:  # noqa: BLE001 — per-host trouble
                 with self._lock:
                     self._state[host.name].outstanding -= 1
@@ -415,19 +547,25 @@ class FleetRouter:
                     # spill to the next-best host, give up only when
                     # every live host is saturated.
                     exclude = exclude | {host.name}
-                    if any(
-                        h.name not in exclude and h.name not in self._dead
-                        for h in self._active
-                    ):
+                    if self._has_candidate(exclude, entry.model):
+                        continue
+                    raise
+                if isinstance(e, UnknownModelError):
+                    # Request-shaped (ISSUE 14): the tenant does not
+                    # exist — propagate, never a host strike.
+                    raise
+                if isinstance(e, ModelNotResidentError):
+                    # A residency race (the host evicted the tenant
+                    # between the pick and the hand-over): re-route
+                    # without feeding the host's drain streak.
+                    exclude = exclude | {host.name}
+                    if self._has_candidate(exclude, entry.model):
                         continue
                     raise
                 # A dead/closing host: count it, maybe drain, try others.
                 self._note_dispatch_failure(host)
                 exclude = exclude | {host.name}
-                if any(
-                    h.name not in exclude and h.name not in self._dead
-                    for h in self._active
-                ):
+                if self._has_candidate(exclude, entry.model):
                     continue
                 raise
             hfut.add_done_callback(
@@ -438,52 +576,110 @@ class FleetRouter:
 
     def _record_dispatch_span(self, entry, d_ctx, d_t0, host, attempt,
                               outcome):
+        attrs = {"host": host.name, "attempt": attempt, "outcome": outcome}
+        if entry.model is not None:
+            attrs["model"] = entry.model
         self.spans.add(
             name="route/dispatch", trace=d_ctx.trace_id, span=d_ctx.span_id,
             parent=entry.trace.span_id, t0=d_t0, t1=time.time(),
-            host="router",
-            attrs={"host": host.name, "attempt": attempt,
-                   "outcome": outcome},
+            host="router", attrs=attrs,
         )
 
-    def _pick(self, exclude: frozenset = frozenset()):
-        """Lowest EWMA score among hosts with a FRESH snapshot; stale →
-        power-of-two-choices over router-tracked outstanding counts."""
+    @staticmethod
+    def _host_models(host):
+        """The host's resident tenant set (None = untenanted host)."""
+        models_fn = getattr(host, "models", None)
+        if models_fn is None:
+            return None
+        try:
+            return models_fn()
+        except Exception:  # noqa: BLE001 — an unreachable host has no facts
+            return ()
+
+    def _has_candidate(self, exclude: frozenset, model: str | None) -> bool:
+        """Is there any live non-excluded host that could still take this
+        request (resident OR cold-loadable tenant)?"""
+        with self._lock:
+            live = [
+                h for h in self._active
+                if h.name not in exclude and h.name not in self._dead
+            ]
+        if model is None:
+            return bool(live)
+        return any(
+            self._host_models(h) is not None or hasattr(h, "ensure_model")
+            for h in live
+        )
+
+    def _pick(self, exclude: frozenset = frozenset(),
+              model: str | None = None):
+        """(host, resident): lowest per-(host, model) score among hosts
+        with a FRESH snapshot; stale → power-of-two-choices over
+        router-tracked outstanding counts. A tenant request prefers
+        hosts holding the model RESIDENT; with none live it falls back
+        to the best host that can COLD-LOAD it (resident=False — the
+        caller swaps the model in before dispatch)."""
         now = time.monotonic()
         with self._lock:
             live = [
                 h for h in self._active
                 if h.name not in self._dead and h.name not in exclude
             ]
-            if not live:
-                return None
-            fresh = [
+        if not live:
+            return None, True
+        resident = live
+        loadable_fallback = False
+        if model is not None:
+            with_model = [
                 h for h in live
+                if (lambda ms: ms is not None and model in ms)(
+                    self._host_models(h)
+                )
+            ]
+            if with_model:
+                resident = with_model
+            else:
+                resident = [h for h in live if hasattr(h, "ensure_model")]
+                loadable_fallback = True
+                if not resident:
+                    return None, True
+
+        def _model_qdepth(h) -> float:
+            if model is None:
+                return 0.0
+            return float(
+                self._state[h.name].model_qdepth.get(model, 0.0)
+            )
+
+        with self._lock:
+            fresh = [
+                h for h in resident
                 if now - self._state[h.name].snapshot_t <= self._stale_after_s
                 and self._state[h.name].score is not None
             ]
             if fresh:
                 # EWMA snapshot score PLUS the router's own live
-                # outstanding count: a snapshot can be a whole probe
-                # interval old, and a burst shorter than that would
-                # otherwise land entirely on whichever host's frozen
-                # score happened to be lowest (observed in the bench's
-                # 120 ms open-loop burst before this term existed).
+                # outstanding count PLUS the tenant's own queue depth on
+                # that host (per-(host, model) scoring): a snapshot can
+                # be a whole probe interval old, and a burst shorter
+                # than that would otherwise land entirely on whichever
+                # host's frozen score happened to be lowest.
                 return min(
                     fresh,
                     key=lambda h: (
                         self._state[h.name].score
                         + self._state[h.name].outstanding
+                        + _model_qdepth(h)
                     ),
-                )
+                ), not loadable_fallback
             # Stale snapshots: two random choices, pick the one with
             # fewer router-tracked outstanding requests.
-            if len(live) == 1:
-                return live[0]
-            a, b = self._rng.sample(live, 2)
+            if len(resident) == 1:
+                return resident[0], not loadable_fallback
+            a, b = self._rng.sample(resident, 2)
             return min(
                 (a, b), key=lambda h: self._state[h.name].outstanding
-            )
+            ), not loadable_fallback
 
     def _on_host_done(self, entry: _Flight, host, fut, d_ctx=None,
                       d_t0=0.0, attempt=1) -> None:
@@ -525,6 +721,7 @@ class FleetRouter:
             entry.finished = True
             self._inflight.pop(entry.fid, None)
             self._tokens += 1
+            self._release_tenant_token(entry)
             now = time.monotonic()
             if self._done_t is not None:
                 inst = 1.0 / max(now - self._done_t, 1e-6)
@@ -754,6 +951,13 @@ class FleetRouter:
         gauges = snap.get("gauges", {})
         counters = snap.get("counters", {})
         qd = gauges.get("serve/queue_depth") or 0.0
+        # Multi-model hosts (ISSUE 14) nest per-tenant snapshots under
+        # "models": keep each tenant's queue depth for the
+        # per-(host, model) dispatch score.
+        model_qdepth = {
+            m: (s.get("gauges", {}).get("serve/queue_depth") or 0.0)
+            for m, s in (snap.get("models") or {}).items()
+        }
         # Every admitted request leaves the pipeline exactly one of three
         # ways (served / rejected / failed) — subtracting all three keeps
         # a past failure burst from reading as phantom in-flight load.
@@ -772,6 +976,7 @@ class FleetRouter:
                 raw if st.score is None
                 else (1 - self._alpha) * st.score + self._alpha * raw
             )
+            st.model_qdepth = model_qdepth
             st.snapshot_t = time.monotonic()
 
     def _warm_spare(self, spare) -> None:
@@ -782,7 +987,15 @@ class FleetRouter:
             return
         trip = False
         try:
-            fut = spare.submit(self._warmup_payload)
+            kwargs = {}
+            spare_models = self._host_models(spare)
+            if spare_models:
+                # A zoo spare warms through one resident tenant per tick
+                # (round-robin by tick keeps every resident set hot).
+                kwargs["model"] = spare_models[
+                    self._probe_ticks % len(spare_models)
+                ]
+            fut = spare.submit(self._warmup_payload, **kwargs)
 
             def _done(f):
                 if f.exception() is None:
@@ -841,6 +1054,12 @@ class FleetRouter:
                     # (absent when tracing is off — records unchanged).
                     row["trace_ids"] = list(st.window_traces)
                     st.window_traces = []
+                if st.window_models:
+                    # Schema-v10: the per-tenant dispatch counts of this
+                    # window (absent on untenanted fleets — records stay
+                    # byte-identical to v9).
+                    row["models"] = dict(st.window_models)
+                    st.window_models = {}
                 rows.append(row)
                 row_hosts.append(h)
                 st.window_requests = 0
@@ -946,7 +1165,7 @@ class FleetRouter:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "hosts": [h.name for h in self._active
                           if h.name not in self._dead],
                 "dead": sorted(self._dead),
@@ -967,6 +1186,11 @@ class FleetRouter:
                     for name, st in sorted(self._state.items())
                 },
             }
+            if self.tenant_budgets:
+                out["tenant_budgets"] = dict(self.tenant_budgets)
+                out["tenant_tokens_free"] = dict(self._tenant_tokens)
+                out["rejections_by_model"] = dict(self.rejections_by_model)
+            return out
 
     # -------------------------------------------------------------- lifecycle
 

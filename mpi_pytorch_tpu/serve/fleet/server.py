@@ -69,7 +69,24 @@ class FleetServer:
         self.cfg = cfg
         self._logger = run_logger()
 
-        if executables is None:
+        # Multi-model tenancy (ISSUE 14): serve_models turns every host
+        # into a ZooServer — per-tenant pipelines over one mesh, fed from
+        # ONE shared ZooExecutablePool (the fleet cost model generalized:
+        # one warmup compile set per (model, precision), not per host).
+        self.zoo_registry = None
+        self._zoo_pool = None
+        if cfg.serve_models:
+            from mpi_pytorch_tpu.serve.zoo import (
+                ModelRegistry,
+                ZooExecutablePool,
+            )
+
+            self.zoo_registry = ModelRegistry.from_config(cfg)
+            self._zoo_pool = ZooExecutablePool(
+                cfg, self.zoo_registry, mesh=mesh,
+                load_checkpoint=load_checkpoint, logger=self._logger,
+            )
+        elif executables is None:
             import jax
 
             if mesh is None:
@@ -121,19 +138,43 @@ class FleetServer:
         servers = []
         try:
             for i in range(total):
-                servers.append(InferenceServer(
-                    cfg, executables=executables, metrics=self._metrics,
-                    host_index=i,
-                ))
+                if self._zoo_pool is not None:
+                    from mpi_pytorch_tpu.serve.zoo import ZooServer
+
+                    servers.append(ZooServer(
+                        cfg, registry=self.zoo_registry,
+                        pool=self._zoo_pool, metrics=self._metrics,
+                        host_index=i, logger=self._logger,
+                    ))
+                else:
+                    servers.append(InferenceServer(
+                        cfg, executables=executables, metrics=self._metrics,
+                        host_index=i,
+                    ))
         except BaseException:
             for s in servers:
                 s.close(drain=False)
             self._raw_metrics.close()
             raise
         self._servers = servers
-        hosts = [LocalHost(s) for s in servers[:n]]
-        spare_host = LocalHost(servers[n]) if want_spare else None
+        if self._zoo_pool is not None:
+            from mpi_pytorch_tpu.serve.zoo import ZooHost
 
+            handles = [ZooHost(s) for s in servers]
+        else:
+            handles = [LocalHost(s) for s in servers]
+        hosts = handles[:n]
+        spare_host = handles[n] if want_spare else None
+
+        # Per-tenant front-door budgets (ISSUE 14): each tenant gets its
+        # spec's explicit admission or an equal share of the fleet
+        # budget — the starvation guard the router enforces.
+        tenant_budgets = None
+        if self.zoo_registry is not None:
+            fleet_budget = cfg.serve_admission_tokens or sum(
+                h.queue_capacity for h in hosts
+            )
+            tenant_budgets = self.zoo_registry.tenant_budgets(fleet_budget)
         # Warmup payload for the spare's keep-warm traffic: a filler
         # request in the loader contract's raw-pixels form.
         warmup_payload = np.zeros((*cfg.image_size, 3), np.uint8)
@@ -147,6 +188,7 @@ class FleetServer:
             logger=self._logger,
             trace_sample_rate=cfg.trace_sample_rate,
             spans=self.spans,
+            tenant_budgets=tenant_budgets,
         )
         if self.collector is not None:
             self.collector.start()
@@ -176,6 +218,16 @@ class FleetServer:
             host_seq = itertools.count(total)
 
             def _spawn_local():
+                if self._zoo_pool is not None:
+                    from mpi_pytorch_tpu.serve.zoo import ZooHost, ZooServer
+
+                    server = ZooServer(
+                        cfg, registry=self.zoo_registry,
+                        pool=self._zoo_pool, metrics=self._metrics,
+                        host_index=next(host_seq), logger=self._logger,
+                    )
+                    self._servers.append(server)
+                    return ZooHost(server)
                 server = InferenceServer(
                     cfg, executables=self._exe, metrics=self._metrics,
                     host_index=next(host_seq),
@@ -208,11 +260,12 @@ class FleetServer:
 
     # ------------------------------------------------------------ requests
 
-    def submit(self, image):
-        return self.router.submit(image)
+    def submit(self, image, model: str | None = None):
+        return self.router.submit(image, model=model)
 
-    def predict_batch(self, images, timeout: float | None = None):
-        return self.router.predict_batch(images, timeout=timeout)
+    def predict_batch(self, images, timeout: float | None = None,
+                      model: str | None = None):
+        return self.router.predict_batch(images, timeout=timeout, model=model)
 
     # ----------------------------------------------------------- inspection
 
@@ -276,6 +329,20 @@ class FleetServer:
             ),
         }
         return out
+
+    def tenant_stats(self) -> dict:
+        """model → fleet-wide per-tenant counters (served / padded /
+        host-queue rejections summed over hosts, front-door rejections
+        from the router) — the bench's per-tenant columns and the CI
+        leg's starvation assertions."""
+        if self.zoo_registry is None:
+            return {}
+        from mpi_pytorch_tpu.serve.fleet.router import aggregate_tenant_stats
+
+        return aggregate_tenant_stats(
+            (h.stats() for h in self.router.active_hosts()),
+            self.router.rejections_by_model,
+        )
 
     # ------------------------------------------------------------ lifecycle
 

@@ -169,6 +169,15 @@ class ServingHost:
                 "error": "bad_request", "taxonomy": "request",
                 "detail": f"request body is not .npy bytes ({e})",
             })
+        # Tenant routing over the wire (ISSUE 14): POST /submit?model=m
+        # names the tenant on a multi-model (zoo) host. Naming one on an
+        # untenanted host is a request fault (400), never host-shaped.
+        model = None
+        for part in query.split("&"):
+            if part.startswith("model="):
+                import urllib.parse
+
+                model = urllib.parse.unquote(part[6:])
         # The trace thread crossing the wire (ISSUE 13): a traceparent
         # header minted at the fleet front door parents this host's
         # queue/preprocess/device spans; a malformed or absent header is
@@ -177,10 +186,20 @@ class ServingHost:
 
         ctx = parse_traceparent(self.http.request_headers().get("Traceparent"))
         try:
+            kwargs = {}
             if ctx is not None:
-                fut = self.server.submit(image, trace=ctx)
-            else:
-                fut = self.server.submit(image)
+                kwargs["trace"] = ctx
+            if model is not None:
+                kwargs["model"] = model
+            try:
+                fut = self.server.submit(image, **kwargs)
+            except TypeError:
+                if model is None:
+                    raise
+                return self._json(400, {
+                    "error": "serve_error", "taxonomy": "request",
+                    "detail": f"host is not multi-tenant (model={model!r})",
+                })
         except QueueFullError as e:
             hint = e.retry_after_ms
             headers = {}
@@ -189,6 +208,9 @@ class ServingHost:
             return self._json(429, {
                 "error": "queue_full", "detail": str(e),
                 "retry_after_ms": hint,
+                # ISSUE 14: the rejection names its tenant so a client
+                # (or the router) backs off the right budget.
+                "model": getattr(e, "model", None),
             }, headers)
         except ServerClosedError as e:
             return self._json(503, {"error": "closed", "detail": str(e)})
@@ -292,6 +314,16 @@ class ServingHost:
                 )
             elif op == "set_precision":
                 self.server.set_precision(str(req["value"]))
+            elif op in ("ensure_model", "evict_model"):
+                # The zoo residency surface (ISSUE 14): the router's
+                # cold-load spill and the operator's evict, over the wire.
+                fn = getattr(self.server, op, None)
+                if fn is None:
+                    return self._json(400, {
+                        "error": "serve_error", "taxonomy": "request",
+                        "detail": f"host is not multi-tenant ({op})",
+                    })
+                fn(str(req["value"]))
             elif op == "shutdown":
                 self.shutdown_async(drain=bool(req.get("drain", True)))
             else:
@@ -355,7 +387,15 @@ def main(argv=None) -> int:
     cfg = parse_config(argv)
     logger = run_logger()
     host_index = cfg.serve_host_index if cfg.serve_host_index >= 0 else None
-    server = InferenceServer(cfg, host_index=host_index)
+    if cfg.serve_models:
+        # Multi-model tenancy (ISSUE 14): this process serves the whole
+        # zoo spec — per-tenant pipelines behind the same wire surface
+        # (requests carry ?model=, /healthz advertises the resident set).
+        from mpi_pytorch_tpu.serve.zoo import ZooServer
+
+        server = ZooServer(cfg, host_index=host_index)
+    else:
+        server = InferenceServer(cfg, host_index=host_index)
     host = ServingHost(
         server,
         port=max(0, cfg.serve_port),

@@ -108,6 +108,8 @@ class InferenceServer:
         metrics=None,
         executables=None,
         host_index: int | None = None,
+        model: str | None = None,
+        spans=None,
     ):
         import jax
 
@@ -133,13 +135,23 @@ class InferenceServer:
         self._snapshot_seq = itertools.count()
         from mpi_pytorch_tpu.obs.context import SpanRecorder
 
-        self._spans = SpanRecorder()
+        # A multi-tenant host (serve/zoo/) passes one SHARED recorder so
+        # its /tracez export is a single ring with one cursor space.
+        self._spans = spans if spans is not None else SpanRecorder()
         # Fleet identity (serve/fleet/): the in-process N-host harness
         # tags each replica with its host index — the analogue of a
         # process index for the per-host fault gates — and a stable name
         # for route/fleet records. None = plain single-host serving.
         self.host_index = host_index
         self.name = "serve" if host_index is None else f"h{host_index}"
+        # Tenant identity (ISSUE 14): a multi-model host runs one
+        # InferenceServer PER TENANT (serve/zoo/) — each stamps its
+        # ``model`` on serve records, request spans, and alerts, so the
+        # whole obs axis threads end to end. None = untenanted serving:
+        # records stay byte-identical to v9.
+        self.model = model
+        if model is not None:
+            self.name = f"{self.name}/{model}"
         if executables is not None:
             # Pre-built (shared) executable set(s): the fleet harness
             # compiles ONE BucketExecutables per precision and hands them
@@ -222,6 +234,7 @@ class InferenceServer:
                 self._registry, parse_rules(cfg.slo_rules),
                 metrics=self._metrics, preempt_path=cfg.preempt_file,
                 tracer=self._tracer, logger=self._logger,
+                labels={"model": model} if model else None,
             )
         self._req_ids = itertools.count()
         self._sinks_closed = False
@@ -705,6 +718,11 @@ class InferenceServer:
                     # is a live axis (multi-set or non-default) — pure-bf16
                     # servers keep their records byte-identical to v6.
                     record["precision"] = item.precision
+                if self.model is not None:
+                    # Schema-v10: the tenant this (single-tenant, by
+                    # construction) flush served — absent on untenanted
+                    # servers, so their records stay byte-identical to v9.
+                    record["model"] = self.model
                 traced = [r for r in item.requests if r.trace is not None]
                 if traced:
                     # Schema-v9: the flush's traced members, and their
@@ -755,6 +773,10 @@ class InferenceServer:
 
         for req in traced:
             ctx = req.trace
+            root_attrs = {"bucket": item.bucket, "req": req.req_id,
+                          "status": "ok"}
+            if self.model is not None:
+                root_attrs["model"] = self.model
             root = self._spans.add(
                 name="serve/request",
                 trace=ctx.trace_id,
@@ -762,8 +784,7 @@ class InferenceServer:
                 t0=wall(req.t_submit),
                 t1=wall(t_done_mono),
                 host=self.name,
-                attrs={"bucket": item.bucket, "req": req.req_id,
-                       "status": "ok"},
+                attrs=root_attrs,
             )
             for name, m0, m1 in (
                 ("serve/queue", req.t_submit, item.t_flush),
@@ -789,6 +810,10 @@ class InferenceServer:
             if req.trace is not None:
                 # The host-side half of a failed traced request: the span
                 # says where it died even when no serve record exists.
+                fail_attrs = {"req": req.req_id, "status": "failed",
+                              "error": type(exc).__name__}
+                if self.model is not None:
+                    fail_attrs["model"] = self.model
                 self._spans.add(
                     name="serve/request",
                     trace=req.trace.trace_id,
@@ -796,8 +821,7 @@ class InferenceServer:
                     t0=now_wall - (now_mono - req.t_submit),
                     t1=now_wall,
                     host=self.name,
-                    attrs={"req": req.req_id, "status": "failed",
-                           "error": type(exc).__name__},
+                    attrs=fail_attrs,
                 )
             if not req.future.done():
                 req.future.set_exception(exc)
@@ -878,6 +902,8 @@ class InferenceServer:
         out["precision"] = self.precision
         if self.parity_top1 is not None:
             out["parity_top1"] = self.parity_top1
+        if self.model is not None:
+            out["model"] = self.model
         return out
 
     def registry_snapshot(self) -> dict:

@@ -1,0 +1,44 @@
+"""Multi-model tenancy (ISSUE 14): serve the whole zoo as tenants.
+
+- ``registry.py`` — tenant specs (``--serve-models``), the
+  ``ModelRegistry``, and the VMEM/HBM-aware packing planner whose
+  explainable plan is stamped on swap-in records.
+- ``pool.py`` — per-(model, bucket[, precision]) AOT executable sets,
+  built lazily and shared across hosts, with the cold swap-in
+  load → warm-probe → activate gate.
+- ``server.py`` — ``ZooServer`` (one host, many tenants: per-tenant
+  pipelines over one mesh, single-tenant flushes by construction, LRU
+  eviction under the packing budget, ``facts_generation`` coherence)
+  plus the router/controller handles (``ZooHost``, ``TenantHandle``).
+"""
+
+from mpi_pytorch_tpu.serve.zoo.pool import ColdSwapError, ZooExecutablePool
+from mpi_pytorch_tpu.serve.zoo.registry import (
+    ModelRegistry,
+    ModelSpec,
+    PackingError,
+    PackingPlan,
+    UnknownModelError,
+    parse_model_specs,
+)
+from mpi_pytorch_tpu.serve.zoo.server import (
+    ModelNotResidentError,
+    TenantHandle,
+    ZooHost,
+    ZooServer,
+)
+
+__all__ = [
+    "ColdSwapError",
+    "ModelNotResidentError",
+    "ModelRegistry",
+    "ModelSpec",
+    "PackingError",
+    "PackingPlan",
+    "TenantHandle",
+    "UnknownModelError",
+    "ZooExecutablePool",
+    "ZooHost",
+    "ZooServer",
+    "parse_model_specs",
+]

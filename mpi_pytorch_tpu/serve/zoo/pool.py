@@ -1,0 +1,234 @@
+"""The zoo executable pool: per-(model, bucket[, precision]) AOT sets,
+built lazily, shared across a host fleet, cold-swappable.
+
+``BucketExecutables`` (PR 4/11) is one model's per-bucket[, per-precision]
+executable set; this pool generalizes the axis to the whole zoo — the
+tentpole's "``_exe_sets{precision}`` discipline with model identity in
+the key". The fleet cost model carries over from ``FleetServer``: N
+in-process hosts share ONE pool, so a local zoo fleet pays one warmup
+compile set per (model, precision), not N.
+
+Cold swap-in is the state machine ISSUE 14 names::
+
+    load (build state + compile per-bucket sets — persistent-cache hits
+          on a warm cache, so the wall clock is placement + warmup)
+      → warm-probe (execute every bucket of every set once, REBASELINE
+          the compile counters, then probe each bucket AGAIN and assert
+          zero compiles — a set that would compile under traffic never
+          activates)
+      → activate (the caller — ``ZooServer`` — stands the tenant's
+          batcher/server over the warmed sets and bumps its facts
+          generation)
+
+Byte accounting is measured, not guessed, once a state exists: the
+placed state's leaf sizes (PR 6's accounting) replace the registry's
+abstract-shape estimate in every later packing plan.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from mpi_pytorch_tpu.serve.batcher import ServeError
+
+
+def state_resident_bytes(state) -> int:
+    """Leaf-size accounting over a (possibly quantized) serving state —
+    the measured half of the packing plan's arithmetic."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(state):
+        size = getattr(leaf, "size", None)
+        dtype = getattr(leaf, "dtype", None)
+        if size is None or dtype is None:
+            continue
+        total += int(size) * int(np.dtype(dtype).itemsize)
+    return total
+
+
+class ColdSwapError(ServeError):
+    """A cold swap-in failed its warm probe (the freshly built sets
+    would compile under traffic) — the tenant never activates."""
+
+
+class ZooExecutablePool:
+    """model → {precision: warmed ``BucketExecutables``}, built on first
+    use, refcounted across the hosts that hold the tenant resident."""
+
+    def __init__(
+        self, cfg, registry, *, mesh=None, load_checkpoint: bool = True,
+        logger=None, build_fn=None,
+    ):
+        from mpi_pytorch_tpu.utils.logging import run_logger
+
+        self.cfg = cfg
+        self.registry = registry
+        self._logger = logger or run_logger()
+        self._load_checkpoint = load_checkpoint
+        # build_fn (tenant_cfg, mesh) -> {precision: UNWARMED set} is the
+        # test seam: packing/LRU/warm-probe logic is drivable without
+        # paying a compile per test.
+        self._build_fn = build_fn
+        self._lock = threading.Lock()
+        self._sets: dict[str, dict] = {}
+        self._bytes: dict[str, int] = {}
+        self._refs: dict[str, int] = {}
+        self._mesh = mesh
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            import jax
+
+            from mpi_pytorch_tpu.parallel.mesh import create_mesh
+            from mpi_pytorch_tpu.serve.batcher import ServeError as _SE
+
+            if jax.process_count() > 1:
+                raise _SE(
+                    "the in-process zoo pool is single-process; on a "
+                    "multi-process world run one zoo host per process over "
+                    "serve.local_replica_mesh()"
+                )
+            self._mesh = create_mesh(self.cfg.mesh)
+        return self._mesh
+
+    def resident(self) -> tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._sets))
+
+    def measured_bytes(self) -> dict[str, int]:
+        """model → measured resident bytes for every BUILT tenant — the
+        packing planner's override of its abstract estimates."""
+        with self._lock:
+            return dict(self._bytes)
+
+    def compiles_after_warmup(self) -> int:
+        with self._lock:
+            sets = [e for m in self._sets.values() for e in m.values()]
+        return sum(e.compiles_since_warmup() for e in sets)
+
+    # ------------------------------------------------------------ build
+
+    def _build(self, model: str) -> tuple[dict, int]:
+        """Load: per-tenant state + one UNWARMED set per precision."""
+        tenant_cfg = self.registry.tenant_cfg(model)
+        if self._build_fn is not None:
+            sets = self._build_fn(tenant_cfg, self.mesh)
+            return sets, sum(
+                state_resident_bytes(getattr(e, "_state", ()))
+                for e in sets.values()
+            )
+        from mpi_pytorch_tpu.serve.executables import BucketExecutables
+        from mpi_pytorch_tpu.serve.server import InferenceServer
+        from mpi_pytorch_tpu.train.step import place_state_on_mesh
+
+        state = InferenceServer._build_state(
+            tenant_cfg, self.mesh, self._load_checkpoint
+        )
+        state = place_state_on_mesh(state, self.mesh)
+        sets = {
+            p: BucketExecutables(
+                tenant_cfg, state, self.mesh, logger=self._logger,
+                precision=p,
+            )
+            for p in tenant_cfg.parsed_serve_precisions()
+        }
+        # Measured resident bytes: each set holds ITS state (int8 sets a
+        # quantized copy) — sum over sets, PR 6's leaf accounting.
+        measured = sum(
+            state_resident_bytes(e._state) for e in sets.values()
+        )
+        return sets, measured
+
+    def ensure(self, model: str) -> dict:
+        """The tenant's warmed sets — building, warming, and PROBING them
+        on first use (the cold swap-in's load + warm-probe halves).
+        Idempotent; refcounted per ``release``."""
+        self.registry.spec(model)  # unknown tenant raises typed, early
+        with self._lock:
+            ready = self._sets.get(model)
+            if ready is not None:
+                self._refs[model] += 1
+                return ready
+        # Build OUTSIDE the lock: a cold swap-in compiling for seconds
+        # must not block another tenant's lookup.
+        try:
+            sets, measured = self._build(model)
+            # Warm EVERY set, then rebaseline ALL (the compile listener
+            # is process-global — InferenceServer.__init__'s
+            # discipline), then the warm PROBE: run each bucket once
+            # more and demand zero compiles before the tenant may
+            # activate.
+            for exe in sets.values():
+                if not exe.warm:
+                    exe.warmup()
+            for exe in sets.values():
+                exe.rebaseline()
+            self.warm_probe(sets, model)
+        finally:
+            # The compile listener is PROCESS-GLOBAL: this swap-in's
+            # cold compiles landed on every already-resident set's
+            # counter too — on the FAILURE path as much as the success
+            # path (a refused swap-in must not leave phantom compiles on
+            # healthy tenants, which would fail their zero-steady-state
+            # assertions and the supervisor's re-admission gate).
+            # Re-baseline them all; the swap-in is a known, announced
+            # compile event, and steady state stays zero-compile for
+            # every tenant from here on.
+            with self._lock:
+                others = [
+                    e for sets_ in self._sets.values()
+                    for e in sets_.values()
+                ]
+            for exe in others:
+                exe.rebaseline()
+        with self._lock:
+            if model not in self._sets:  # lost builds are discarded, loudly
+                self._sets[model] = sets
+                self._bytes[model] = measured
+                self._refs[model] = 0
+            else:
+                self._logger.warning(
+                    "zoo pool: concurrent build of %s discarded (another "
+                    "host won the race)", model,
+                )
+            self._refs[model] += 1
+            return self._sets[model]
+
+    @staticmethod
+    def warm_probe(sets: dict, model: str) -> None:
+        """The activation gate: every bucket of every set executes once
+        AFTER the rebaseline, and any compile fails the swap-in — a
+        tenant that would compile under traffic never enters rotation
+        (the supervisor's re-admission handshake, generalized to
+        models)."""
+        import numpy as np
+
+        for exe in sets.values():
+            h, w = exe._image_hw
+            for bucket in exe.buckets:
+                images = np.zeros((bucket, h, w, 3), exe.image_dtype)
+                labels = np.full((bucket,), -1, np.int32)
+                exe(bucket, exe.place(images, labels))
+        compiles = sum(e.compiles_since_warmup() for e in sets.values())
+        if compiles != 0:
+            raise ColdSwapError(
+                f"cold swap-in of {model!r} failed its warm probe: "
+                f"{compiles} steady-state compile(s) after warmup — the "
+                "set must not activate"
+            )
+
+    def release(self, model: str) -> None:
+        """One host evicted the tenant; the last reference drops the
+        sets (the executable and state arrays free with them)."""
+        with self._lock:
+            if model not in self._sets:
+                return
+            self._refs[model] -= 1
+            if self._refs[model] <= 0:
+                del self._sets[model]
+                del self._refs[model]
+                # Measured bytes stay cached: a re-swap-in plans with the
+                # measurement, not the estimate.

@@ -1,0 +1,345 @@
+"""The model-zoo registry: tenant specs + the VMEM/HBM-aware packing plan.
+
+The reference ships seven torchvision CNNs (``models.py``) but its
+inference pipeline — and ours, until ISSUE 14 — serves exactly one
+checkpoint per deployment. This module makes *model identity* a
+first-class serving dimension: a ``ModelSpec`` names one TENANT (a model
+the fleet serves — architecture, checkpoint, precision, bucket set,
+admission budget), the ``ModelRegistry`` holds the zoo, and
+``plan_packing`` decides which (model, bucket) executable sets fit
+together on one host under an explicit byte budget — the same leaf-size
+accounting discipline PR 6 used for the ZeRO optimizer-state HBM math,
+applied to the serving side.
+
+The plan is EXPLAINABLE and stamped on records: every cold-model swap-in
+(``zoo/server.py``) carries ``plan.to_record()`` — which tenants are
+resident, what each costs, what the budget was — so "why did tenant X
+get evicted" is answerable from the metrics stream, not from a debugger.
+
+Spec syntax (the ``--serve-models`` / ``bench_serve --models`` string) —
+comma-separated tenants, each ``[alias=]arch[:key=value]*``::
+
+    resnet18,mobilenet_v2
+    hot=resnet18:admission=8,mobilenet_v2:precision=int8:cold
+    resnet18:ckpt=/ckpts/resnet18:buckets=1|8|32
+
+Keys: ``ckpt`` (checkpoint dir), ``precision`` (bf16|int8|both),
+``buckets`` (``|``-separated sizes — ``,`` is the tenant separator),
+``admission`` (per-tenant front-door token budget; 0 = an equal share of
+the fleet budget), ``cold`` (don't build at startup; the first routed
+request cold-swaps the model in from the persistent compilation cache).
+An alias lets two tenants share an architecture (A/B checkpoints).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+
+from mpi_pytorch_tpu.serve.batcher import ServeError, UnknownModelError
+
+__all__ = [
+    "ModelRegistry", "ModelSpec", "PackingError", "PackingPlan",
+    "PlanEntry", "UnknownModelError", "estimate_model_bytes",
+    "parse_model_specs",
+]
+
+
+class PackingError(ServeError):
+    """A tenant spec cannot fit the packing budget even alone (or the
+    resident set cannot be made to fit by evicting idle tenants) — the
+    loud rejection the planner owes the operator, with the plan's
+    arithmetic in the message."""
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    """One serving tenant: the unit of routing, admission, and retuning."""
+
+    model: str  # tenant name (the routing key; defaults to the arch)
+    arch: str  # architecture (config.SUPPORTED_MODELS)
+    checkpoint_dir: str = ""  # "" = serve fresh init (smoke/CI) or cfg's
+    precision: str = ""  # "" = the fleet cfg's serve_precision
+    buckets: str = ""  # "" = the fleet cfg's serve_buckets
+    admission: int = 0  # per-tenant front-door tokens; 0 = equal share
+    cold: bool = False  # True = not built at startup; swap-in on demand
+
+
+def parse_model_specs(text: str) -> tuple[ModelSpec, ...]:
+    """``--serve-models`` string → validated specs (see module docstring
+    for the syntax). Raises ``ValueError`` on malformed entries, unknown
+    architectures, or duplicate tenant names."""
+    from mpi_pytorch_tpu.config import SUPPORTED_MODELS
+
+    specs: list[ModelSpec] = []
+    for entry in (e.strip() for e in text.split(",") if e.strip()):
+        head, *opts = entry.split(":")
+        alias, _, arch = head.rpartition("=")
+        arch = arch.strip()
+        name = alias.strip() or arch
+        kwargs: dict = {}
+        for opt in opts:
+            key, _, value = opt.partition("=")
+            key = key.strip()
+            if key == "cold" and not value:
+                kwargs["cold"] = True
+            elif key == "ckpt":
+                kwargs["checkpoint_dir"] = value
+            elif key == "precision":
+                if value not in ("bf16", "int8", "both"):
+                    raise ValueError(
+                        f"tenant {name!r}: precision must be "
+                        f"bf16|int8|both, got {value!r}"
+                    )
+                kwargs["precision"] = value
+            elif key == "buckets":
+                kwargs["buckets"] = value.replace("|", ",")
+            elif key == "admission":
+                kwargs["admission"] = int(value)
+            else:
+                raise ValueError(
+                    f"tenant {name!r}: unknown spec key {key!r} (expected "
+                    "ckpt|precision|buckets|admission|cold)"
+                )
+        if arch not in SUPPORTED_MODELS:
+            raise ValueError(
+                f"tenant {name!r}: unsupported architecture {arch!r}; "
+                f"expected one of {SUPPORTED_MODELS}"
+            )
+        if kwargs.get("admission", 0) < 0:
+            raise ValueError(
+                f"tenant {name!r}: admission must be >= 0 (0 = equal "
+                f"share), got {kwargs['admission']}"
+            )
+        specs.append(ModelSpec(model=name, arch=arch, **kwargs))
+    if not specs:
+        raise ValueError("serve_models parsed to zero tenants")
+    names = [s.model for s in specs]
+    dupes = sorted({n for n in names if names.count(n) > 1})
+    if dupes:
+        raise ValueError(
+            f"duplicate tenant name(s) {dupes} — alias them "
+            "(e.g. 'a=resnet18,b=resnet18')"
+        )
+    return tuple(specs)
+
+
+# --------------------------------------------------------------- byte math
+
+
+def _spec_param_bytes(shapes, precision: str) -> int:
+    """Leaf-size accounting over an abstract variables tree (PR 6's HBM
+    discipline): f32 resident params, except int8 tenants whose >=2-D
+    kernels quantize to 1 byte/element + a 4-byte scale per output
+    channel (``ops/quantize.py``'s layout)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(shapes):
+        n = 1
+        for d in leaf.shape:
+            n *= int(d)
+        if precision == "int8" and len(leaf.shape) >= 2:
+            total += n + 4 * int(leaf.shape[-1])  # int8 kernel + scales
+        else:
+            total += n * 4  # f32 resident
+    return total
+
+
+def estimate_model_bytes(
+    arch: str, num_classes: int, image_size: int, buckets, precision: str,
+) -> dict:
+    """Resident-byte estimate for one tenant's executable sets, from
+    abstract shapes only (``jax.eval_shape`` — no device memory, no
+    compute): params via leaf accounting, plus per-bucket activation
+    high-water (the input batch and the [bucket, num_classes] logits —
+    at the 64.5k-class head the logits ARE the spike). An estimate for
+    the PLANNER; the pool re-measures from the built state."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_pytorch_tpu.models import initialize_model
+
+    model, _ = initialize_model(arch, num_classes)
+    dummy = jax.ShapeDtypeStruct((1, image_size, image_size, 3), jnp.float32)
+    rngs = {
+        "params": jax.ShapeDtypeStruct((2,), jnp.uint32),
+        "dropout": jax.ShapeDtypeStruct((2,), jnp.uint32),
+    }
+    shapes = jax.eval_shape(
+        lambda r, x: model.init(r, x, train=True), rngs, dummy
+    )
+    precisions = ("bf16", "int8") if precision == "both" else (precision,)
+    params = sum(_spec_param_bytes(shapes, p) for p in precisions)
+    per_bucket = {
+        int(b): int(b) * (image_size * image_size * 3 * 4 + num_classes * 4)
+        for b in buckets
+    }
+    return {
+        "params_bytes": int(params),
+        "per_bucket_bytes": per_bucket,
+        "total_bytes": int(params) + max(per_bucket.values(), default=0),
+    }
+
+
+@dataclass
+class PlanEntry:
+    model: str
+    params_bytes: int
+    bucket_bytes: dict  # bucket -> bytes
+    total_bytes: int
+    measured: bool = False  # True when sized from the BUILT state
+
+
+@dataclass
+class PackingPlan:
+    """Which tenants fit together on one host, and the arithmetic."""
+
+    budget_bytes: int | None  # None = unbounded (plan still explains)
+    entries: list[PlanEntry] = field(default_factory=list)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(e.total_bytes for e in self.entries)
+
+    @property
+    def fits(self) -> bool:
+        return self.budget_bytes is None or self.total_bytes <= self.budget_bytes
+
+    def explain(self) -> str:
+        mb = 1024 * 1024
+        lines = [
+            f"packing plan: {len(self.entries)} tenant(s), "
+            f"{self.total_bytes / mb:.1f} MB of "
+            + ("unbounded budget" if self.budget_bytes is None
+               else f"{self.budget_bytes / mb:.1f} MB budget")
+            + (" — FITS" if self.fits else " — OVER BUDGET"),
+        ]
+        for e in sorted(self.entries, key=lambda e: -e.total_bytes):
+            worst = max(e.bucket_bytes.values(), default=0)
+            lines.append(
+                f"  {e.model}: params {e.params_bytes / mb:.1f} MB + "
+                f"largest-bucket activations {worst / mb:.1f} MB = "
+                f"{e.total_bytes / mb:.1f} MB"
+                f" ({'measured' if e.measured else 'estimated'})"
+            )
+        return "\n".join(lines)
+
+    def to_record(self) -> dict:
+        """The stamp swap-in/evict records carry (MB, JSON-clean)."""
+        mb = 1024 * 1024
+        return {
+            "budget_mb": (
+                None if self.budget_bytes is None
+                else round(self.budget_bytes / mb, 1)
+            ),
+            "total_mb": round(self.total_bytes / mb, 1),
+            "fits": 1 if self.fits else 0,
+            "tenants": {
+                e.model: round(e.total_bytes / mb, 1) for e in self.entries
+            },
+        }
+
+
+class ModelRegistry:
+    """The zoo: tenant name → spec, per-tenant derived configs, byte
+    estimates, and the packing planner."""
+
+    def __init__(self, cfg, specs):
+        self.cfg = cfg
+        self._specs = {s.model: s for s in specs}
+        self._estimates: dict[str, dict] = {}
+
+    @classmethod
+    def from_config(cls, cfg) -> "ModelRegistry":
+        if not cfg.serve_models:
+            raise ValueError(
+                "ModelRegistry.from_config needs cfg.serve_models (the "
+                "tenant spec string)"
+            )
+        return cls(cfg, parse_model_specs(cfg.serve_models))
+
+    def models(self) -> tuple[str, ...]:
+        return tuple(self._specs)
+
+    def specs(self) -> tuple[ModelSpec, ...]:
+        return tuple(self._specs.values())
+
+    def spec(self, model: str) -> ModelSpec:
+        try:
+            return self._specs[model]
+        except KeyError:
+            raise UnknownModelError(
+                f"unknown model {model!r} (registry holds "
+                f"{sorted(self._specs)})"
+            ) from None
+
+    def tenant_cfg(self, model: str):
+        """The per-tenant ``Config`` a tenant's state/executables build
+        from: the fleet cfg with the spec's arch/checkpoint/precision/
+        buckets swapped in (everything else — image size, topk, queue
+        depth, wait — is host policy and stays shared)."""
+        spec = self.spec(model)
+        overrides: dict = {"model_name": spec.arch}
+        if spec.checkpoint_dir:
+            overrides["checkpoint_dir"] = spec.checkpoint_dir
+        if spec.precision:
+            overrides["serve_precision"] = spec.precision
+        if spec.buckets:
+            overrides["serve_buckets"] = spec.buckets
+        cfg = dataclasses.replace(self.cfg, **overrides)
+        return cfg
+
+    def tenant_budgets(self, total_budget: int) -> dict[str, int]:
+        """Per-tenant front-door admission tokens: the spec's explicit
+        ``admission`` when set, else an equal share of the fleet budget —
+        the isolation guarantee that one hot tenant cannot consume
+        another tenant's admission capacity (ISSUE 14 tentpole (4))."""
+        share = max(1, total_budget // max(1, len(self._specs)))
+        return {
+            s.model: (s.admission or share) for s in self._specs.values()
+        }
+
+    def estimate_bytes(self, model: str) -> dict:
+        """Cached abstract-shape estimate for one tenant (planner input;
+        the pool overrides with measured bytes once the state is built)."""
+        if model not in self._estimates:
+            spec = self.spec(model)
+            cfg = self.tenant_cfg(model)
+            self._estimates[model] = estimate_model_bytes(
+                spec.arch, cfg.num_classes, cfg.image_size[0],
+                cfg.parsed_serve_buckets(),
+                spec.precision or cfg.serve_precision,
+            )
+        return self._estimates[model]
+
+    def plan_packing(
+        self, models, budget_bytes: int | None,
+        measured: dict[str, int] | None = None,
+    ) -> PackingPlan:
+        """The packing plan for ``models`` co-resident on one host.
+        ``measured`` (model → bytes, from the pool's built states)
+        overrides the estimate where available. A SINGLE tenant
+        exceeding the budget alone is a spec error and raises
+        ``PackingError`` loudly — no eviction can ever make it fit."""
+        plan = PackingPlan(budget_bytes=budget_bytes)
+        measured = measured or {}
+        for model in models:
+            est = self.estimate_bytes(model)
+            total = measured.get(model, est["total_bytes"])
+            entry = PlanEntry(
+                model=model,
+                params_bytes=est["params_bytes"],
+                bucket_bytes=est["per_bucket_bytes"],
+                total_bytes=int(total),
+                measured=model in measured,
+            )
+            if budget_bytes is not None and entry.total_bytes > budget_bytes:
+                single = PackingPlan(budget_bytes=budget_bytes, entries=[entry])
+                raise PackingError(
+                    f"tenant {model!r} alone exceeds the packing budget — "
+                    "no eviction can make it fit. "
+                    + single.explain()
+                )
+            plan.entries.append(entry)
+        return plan

@@ -1,0 +1,588 @@
+"""ZooServer: one serving HOST holding many model TENANTS (ISSUE 14).
+
+The multi-tenant generalization of ``InferenceServer``: each resident
+tenant runs its own full serving pipeline (bounded queue, dynamic
+batcher, preprocess pool, per-tenant metrics registry, per-tenant
+executable sets from the shared ``ZooExecutablePool``) over the SAME
+device mesh — so a batcher flush is single-tenant BY CONSTRUCTION (a
+coalesced batch only ever holds one model's requests; a mixed flush
+would need a cross-model executable that doesn't exist), and per-tenant
+admission is the tenant's own bounded queue plus the fleet router's
+per-tenant front-door budget.
+
+Residency is dynamic — the cold-model swap-in state machine::
+
+    ensure_model(m):  plan (evict LRU idle tenants until the packing
+                      budget fits) → pool.ensure (load + warm-probe,
+                      zoo/pool.py) → activate (stand the tenant server)
+                      → bump facts_generation → kind="fleet"
+                      event="swap_in" record, packing plan stamped
+    evict_model(m):   drain the tenant server → release the pool sets →
+                      bump facts_generation → event="evict" record
+
+``facts_generation`` is the cache-coherence satellite: a host's resident
+model set is advertised through ``/healthz``/``/metricsz``, and a
+remote probe caches those facts — the generation counter lets the
+``RemoteHost`` facts cache invalidate the instant a swap-in/evict
+changes the set, so the router never dispatches a tenant to a host that
+just evicted it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from mpi_pytorch_tpu.serve.batcher import (
+    ModelNotResidentError,
+    QueueFullError,
+    ServeError,
+    UnknownModelError,
+)
+from mpi_pytorch_tpu.serve.fleet.router import LocalHost
+from mpi_pytorch_tpu.serve.zoo.pool import ZooExecutablePool
+from mpi_pytorch_tpu.serve.zoo.registry import ModelRegistry
+
+
+class ZooServer:
+    """N tenants' serving pipelines over one replica's chips."""
+
+    def __init__(
+        self,
+        cfg,
+        *,
+        registry: ModelRegistry | None = None,
+        pool: ZooExecutablePool | None = None,
+        metrics=None,
+        host_index: int | None = None,
+        load_checkpoint: bool = True,
+        mesh=None,
+        logger=None,
+    ):
+        from mpi_pytorch_tpu.obs.context import SpanRecorder
+        from mpi_pytorch_tpu.utils.logging import MetricsWriter, run_logger
+
+        self.cfg = cfg
+        self._logger = logger or run_logger()
+        self.registry = registry or ModelRegistry.from_config(cfg)
+        self.pool = pool if pool is not None else ZooExecutablePool(
+            cfg, self.registry, mesh=mesh, load_checkpoint=load_checkpoint,
+            logger=self._logger,
+        )
+        self.host_index = host_index
+        self.name = "serve" if host_index is None else f"h{host_index}"
+        self._metrics = metrics or MetricsWriter(cfg.metrics_file)
+        self._owns_metrics = metrics is None
+        # One shared span ring: the host's /tracez is a single cursor
+        # space across every tenant's request spans.
+        self._spans = SpanRecorder()
+        self.start_ts = time.time()
+        self._snapshot_seq = itertools.count()
+        budget_mb = float(getattr(cfg, "serve_pack_budget_mb", 0.0) or 0.0)
+        self._budget_bytes = int(budget_mb * 1024 * 1024) or None
+        self._lock = threading.Lock()  # tenant map / LRU / generation
+        self._swap_lock = threading.Lock()  # serializes swap-in/evict
+        self._tenants: dict[str, object] = {}  # model -> InferenceServer
+        self._last_used: dict[str, float] = {}
+        self._generation = 0
+        self._closed = False
+
+        startup = [s.model for s in self.registry.specs() if not s.cold]
+        if not startup:
+            raise ServeError(
+                "a zoo host needs at least one non-cold tenant at startup "
+                "(every spec marked :cold would leave the host serving "
+                "nothing)"
+            )
+        # The STARTUP packing plan: the non-cold residents must fit
+        # together with nothing to evict — over budget here is a spec
+        # error, rejected loudly with the plan's arithmetic.
+        plan = self.registry.plan_packing(
+            startup, self._budget_bytes, measured=self.pool.measured_bytes()
+        )
+        if not plan.fits:
+            from mpi_pytorch_tpu.serve.zoo.registry import PackingError
+
+            raise PackingError(
+                "startup tenant set exceeds the packing budget (nothing "
+                "is evictable at startup). " + plan.explain()
+            )
+        try:
+            for model in startup:
+                self._activate(model, event=None)  # startup: no record
+        except BaseException:
+            self.close(drain=False)
+            raise
+        self._logger.info(
+            "zoo[%s]: %d resident tenant(s) %s (registered %s)\n%s",
+            self.name, len(self._tenants), sorted(self._tenants),
+            sorted(self.registry.models()), plan.explain(),
+        )
+
+    # ------------------------------------------------------------ residency
+
+    @property
+    def facts_generation(self) -> int:
+        return self._generation
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def models(self) -> tuple[str, ...]:
+        """The RESIDENT tenant set — what this host advertises for
+        routing (``/healthz`` facts; the registered set may be larger)."""
+        with self._lock:
+            return tuple(sorted(self._tenants))
+
+    def registered_models(self) -> tuple[str, ...]:
+        return tuple(sorted(self.registry.models()))
+
+    def tenant(self, model: str):
+        """The tenant's ``InferenceServer``; typed errors for unknown vs
+        evicted tenants (the router re-routes only the latter)."""
+        self.registry.spec(model)  # UnknownModelError for non-tenants
+        with self._lock:
+            srv = self._tenants.get(model)
+        if srv is None:
+            raise ModelNotResidentError(
+                f"model {model!r} is not resident on {self.name} "
+                f"(resident: {sorted(self._tenants)}); cold-load it via "
+                "ensure_model"
+            )
+        return srv
+
+    def tenants(self) -> dict:
+        with self._lock:
+            return dict(self._tenants)
+
+    def _plan_with(self, models) -> object:
+        return self.registry.plan_packing(
+            models, self._budget_bytes, measured=self.pool.measured_bytes()
+        )
+
+    def _activate(self, model: str, event: str | None = "swap_in") -> None:
+        """Load → warm-probe → activate one tenant (the cold swap-in when
+        ``event`` is set; the startup build when None)."""
+        from mpi_pytorch_tpu.serve.server import InferenceServer
+
+        with self._swap_lock:
+            with self._lock:
+                if model in self._tenants:
+                    return
+                resident = list(self._tenants)
+            # LRU eviction under the packing budget: evict the least
+            # recently USED resident tenant until the plan fits (the
+            # incoming tenant is never the victim; PackingError from
+            # plan_packing if it can never fit even alone).
+            while True:
+                plan = self._plan_with(resident + [model])
+                if plan.fits:
+                    break
+                with self._lock:
+                    evictable = sorted(
+                        (m for m in resident),
+                        key=lambda m: self._last_used.get(m, 0.0),
+                    )
+                if not evictable:
+                    from mpi_pytorch_tpu.serve.zoo.registry import PackingError
+
+                    raise PackingError(
+                        f"cannot fit tenant {model!r}: nothing left to "
+                        "evict. " + plan.explain()
+                    )
+                victim = evictable[0]
+                self._evict_locked_out(victim, reason=f"lru for {model}")
+                resident.remove(victim)
+            sets = self.pool.ensure(model)  # load + warm-probe (pool gates)
+            tenant_cfg = self.registry.tenant_cfg(model)
+            srv = InferenceServer(
+                tenant_cfg, executables=sets, metrics=self._metrics,
+                host_index=self.host_index, model=model, spans=self._spans,
+            )
+            with self._lock:
+                self._tenants[model] = srv
+                self._last_used[model] = time.monotonic()
+                self._generation += 1
+                resident_now = sorted(self._tenants)
+            if event is not None:
+                self._logger.info(
+                    "zoo[%s]: cold swap-in of %s complete (resident %s)\n%s",
+                    self.name, model, resident_now, plan.explain(),
+                )
+                self._metrics.write({
+                    "kind": "fleet", "event": event,
+                    "host": self.name, "model": model,
+                    "resident": resident_now,
+                    "compiles_after_warmup": srv.compiles_after_warmup(),
+                    "plan": plan.to_record(),
+                })
+
+    def ensure_model(self, model: str) -> None:
+        """Cold swap-in (idempotent): make ``model`` resident here —
+        load from the persistent compilation cache, warm-probe, activate
+        (``zoo/pool.py``'s gate: a set that would compile under traffic
+        never activates)."""
+        if self._closed:
+            raise ServeError(f"zoo host {self.name} is shut down")
+        self.registry.spec(model)
+        self._activate(model, event="swap_in")
+
+    def _evict_locked_out(self, model: str, reason: str) -> None:
+        """Evict one tenant (``_swap_lock`` held by the caller): drain
+        its server, release its pool sets, bump the facts generation."""
+        with self._lock:
+            srv = self._tenants.pop(model, None)
+            if srv is None:
+                return
+            self._last_used.pop(model, None)
+            self._generation += 1
+            resident_now = sorted(self._tenants)
+        srv.close(drain=True)
+        self.pool.release(model)
+        self._logger.info(
+            "zoo[%s]: evicted tenant %s (%s; resident %s)",
+            self.name, model, reason, resident_now,
+        )
+        self._metrics.write({
+            "kind": "fleet", "event": "evict",
+            "host": self.name, "model": model,
+            "detail": reason, "resident": resident_now,
+        })
+
+    def evict_model(self, model: str) -> None:
+        self.registry.spec(model)
+        with self._swap_lock:
+            self._evict_locked_out(model, reason="operator evict")
+
+    # ---------------------------------------------------------- request path
+
+    def submit(self, image, model: str | None = None, trace=None):
+        """Enqueue one request for ``model``. The tenant must be named
+        on a multi-tenant host (a single-tenant zoo defaults to its one
+        tenant); rejections carry the tenant on the typed error."""
+        if model is None:
+            registered = self.registry.models()
+            if len(registered) != 1:
+                raise UnknownModelError(
+                    "a multi-tenant host needs model= on every request "
+                    f"(tenants: {sorted(registered)})"
+                )
+            model = registered[0]
+        srv = self.tenant(model)
+        with self._lock:
+            self._last_used[model] = time.monotonic()
+        try:
+            if trace is not None:
+                return srv.submit(image, trace=trace)
+            return srv.submit(image)
+        except QueueFullError as e:
+            e.model = model  # the typed rejection names its tenant
+            raise
+
+    def predict_batch(self, images, model: str | None = None,
+                      timeout: float | None = None):
+        import numpy as np
+
+        futs = [self.submit(im, model=model) for im in images]
+        return np.stack([f.result(timeout=timeout) for f in futs])
+
+    # ------------------------------------------------------------- telemetry
+
+    def stats(self) -> dict:
+        """Host-level counters + the per-tenant breakdown."""
+        tenants = {m: s.stats() for m, s in self.tenants().items()}
+        out = {
+            "served": sum(s["served"] for s in tenants.values()),
+            "rejected": sum(s["rejected"] for s in tenants.values()),
+            "failed": sum(s["failed"] for s in tenants.values()),
+            "padded_rows": sum(s["padded_rows"] for s in tenants.values()),
+            "queue_depth": sum(s["queue_depth"] for s in tenants.values()),
+            "compiles_after_warmup": self.compiles_after_warmup(),
+            "models": tenants,
+            "facts_generation": self.facts_generation,
+        }
+        return out
+
+    def tenant_stats(self) -> dict:
+        """model → its tenant server's stats (bench/CI per-tenant
+        columns)."""
+        return {m: s.stats() for m, s in self.tenants().items()}
+
+    def registry_snapshot(self) -> dict:
+        """The host-level snapshot the router scores and the collector
+        scrapes: counters/queue-depth summed across tenants, histogram
+        summaries folded conservatively (count/sum summed, percentiles
+        MAX — "the worst tenant's tail", which is what the autoscaler's
+        worst-host p99 wants), plus the per-tenant snapshots under
+        ``models`` and the ``facts_generation`` for remote facts-cache
+        invalidation."""
+        snaps = {m: s.registry_snapshot() for m, s in self.tenants().items()}
+        counters: dict = {}
+        gauges: dict = {}
+        hists: dict = {}
+        for snap in snaps.values():
+            for k, v in snap.get("counters", {}).items():
+                counters[k] = counters.get(k, 0.0) + (v or 0.0)
+            for k, v in snap.get("gauges", {}).items():
+                if v is None:
+                    gauges.setdefault(k, None)
+                elif k in ("serve/queue_depth", "serve/compiles_after_warmup"):
+                    gauges[k] = (gauges.get(k) or 0.0) + v
+                else:
+                    gauges[k] = max(gauges.get(k) or 0.0, v)
+            for k, h in snap.get("histograms", {}).items():
+                if not h:
+                    continue
+                if k not in hists:
+                    hists[k] = dict(h)
+                    continue
+                agg = hists[k]
+                agg["count"] = agg.get("count", 0) + h.get("count", 0)
+                agg["sum"] = agg.get("sum", 0.0) + h.get("sum", 0.0)
+                for q in ("p50", "p95", "p99", "max"):
+                    if h.get(q) is not None:
+                        agg[q] = max(agg.get(q) or 0.0, h[q])
+        return {
+            "counters": counters, "gauges": gauges, "histograms": hists,
+            "models": snaps,
+            "facts_generation": self.facts_generation,
+            "seq": next(self._snapshot_seq),
+            "start_ts": self.start_ts,
+        }
+
+    def traces(self, since: int = 0) -> dict:
+        """The shared span ring (one cursor space across tenants)."""
+        return self._spans.export(since)
+
+    def compiles_after_warmup(self) -> int:
+        """Steady-state compiles over EVERY pool set — an inactive
+        tenant's compile is just as much a broken invariant."""
+        return self.pool.compiles_after_warmup()
+
+    def _healthz(self) -> dict:
+        stats = self.stats()
+        first = next(iter(self.tenants().values()), None)
+        return {
+            "status": "ok" if not self._closed else "closing",
+            "queue_depth": stats["queue_depth"],
+            "compiles_after_warmup": stats["compiles_after_warmup"],
+            "served": stats["served"],
+            "rejected": stats["rejected"],
+            # The multi-model facts (ISSUE 14): the resident set IS a
+            # routing fact, and the generation counter is what keeps a
+            # remote probe's facts cache coherent through swap-ins.
+            "models": list(self.models()),
+            "registered_models": list(self.registered_models()),
+            "facts_generation": self.facts_generation,
+            "queue_capacity": self.queue_capacity,
+            "max_wait_ms": first.max_wait_ms if first else None,
+            "active_buckets": list(first.active_buckets) if first else [],
+            "buckets": list(first.buckets) if first else [],
+            "precisions": list(first.precisions) if first else [],
+            "parity_top1": first.parity_top1 if first else None,
+            "topk": first.topk if first else None,
+            "host_index": self.host_index,
+            "pid": __import__("os").getpid(),
+            "time": time.time(),
+            "start_ts": self.start_ts,
+        }
+
+    # --------------------------------------------------------------- control
+
+    @property
+    def precision(self) -> str:
+        """The active precision of the first tenant (bench sweep surface;
+        tenants may diverge under per-tenant controller retunes)."""
+        first = next(iter(self.tenants().values()), None)
+        return first.precision if first else "bf16"
+
+    @property
+    def parity_top1(self):
+        first = next(iter(self.tenants().values()), None)
+        return first.parity_top1 if first else None
+
+    @property
+    def queue_capacity(self) -> int:
+        """Admission capacity this host contributes to the fleet budget:
+        one tenant queue per REGISTERED tenant (stable across swap-ins —
+        the router's auto budget must not drift with residency)."""
+        return self.cfg.serve_queue_depth * max(1, len(self.registry.models()))
+
+    def _fanout(self, model, fn) -> None:
+        targets = (
+            [self.tenant(model)] if model is not None
+            else list(self.tenants().values())
+        )
+        for srv in targets:
+            fn(srv)
+
+    def set_max_wait_ms(self, v: float, model: str | None = None) -> None:
+        self._fanout(model, lambda s: s.set_max_wait_ms(v))
+
+    def set_active_buckets(self, buckets, model: str | None = None) -> None:
+        self._fanout(model, lambda s: s.set_active_buckets(buckets))
+
+    def set_precision(self, precision: str, model: str | None = None) -> None:
+        self._fanout(model, lambda s: s.set_precision(precision))
+
+    # ------------------------------------------------------------- lifecycle
+
+    def close(self, drain: bool = True) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            tenants = dict(self._tenants)
+        for srv in tenants.values():
+            try:
+                srv.close(drain=drain)
+            except Exception as e:  # noqa: BLE001 — close the rest
+                self._logger.warning("zoo tenant close failed: %s", e)
+        if self._owns_metrics:
+            try:
+                self._metrics.close()
+            except Exception as e:  # noqa: BLE001
+                self._logger.warning("zoo metrics close failed: %s", e)
+
+    def __enter__(self) -> "ZooServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class TenantHandle:
+    """One (host, tenant) pair as a controller-facing unit: the AIMD
+    retune knobs (max_wait / active buckets / precision) of exactly one
+    tenant on exactly one host — what makes controller retunes act PER
+    TENANT (the retune record carries ``host`` + ``model``)."""
+
+    def __init__(self, host_name: str, model: str, server):
+        self.host_name = host_name
+        self.model = model
+        self.name = f"{host_name}/{model}"  # unique controller key
+        self._server = server
+
+    def snapshot(self) -> dict:
+        return self._server.registry_snapshot()
+
+    @property
+    def max_wait_ms(self) -> float:
+        return self._server.max_wait_ms
+
+    @property
+    def active_buckets(self):
+        return self._server.active_buckets
+
+    @property
+    def buckets(self):
+        return self._server.buckets
+
+    @property
+    def precision(self) -> str:
+        return self._server.precision
+
+    @property
+    def precisions(self):
+        return self._server.precisions
+
+    @property
+    def parity_top1(self):
+        return self._server.parity_top1
+
+    def set_max_wait_ms(self, v: float) -> None:
+        self._server.set_max_wait_ms(v)
+
+    def set_active_buckets(self, buckets) -> None:
+        self._server.set_active_buckets(buckets)
+
+    def set_precision(self, precision: str) -> None:
+        self._server.set_precision(precision)
+
+    def compiles_after_warmup(self) -> int:
+        return self._server.compiles_after_warmup()
+
+
+class ZooHost(LocalHost):
+    """``HostHandle`` over an in-process ``ZooServer`` — the LocalHost
+    twin with the multi-model surface the router and controller read:
+    resident ``models()``, ``ensure_model`` (the router's cold-load
+    spill), and per-tenant ``tenants()`` units for the controller."""
+
+    def __init__(self, server: ZooServer):
+        self.server = server
+        self.name = server.name
+        self.index = server.host_index
+
+    def submit(self, image, trace=None, model=None):
+        return self.server.submit(image, model=model, trace=trace)
+
+    def models(self):
+        return self.server.models()
+
+    def ensure_model(self, model: str) -> None:
+        self.server.ensure_model(model)
+
+    def evict_model(self, model: str) -> None:
+        self.server.evict_model(model)
+
+    @property
+    def facts_generation(self) -> int:
+        return self.server.facts_generation
+
+    def tenants(self) -> list[TenantHandle]:
+        return [
+            TenantHandle(self.name, model, srv)
+            for model, srv in sorted(self.server.tenants().items())
+        ]
+
+    def alive(self) -> bool:
+        return not self.server.closed
+
+    def qsize(self) -> int:
+        return self.server.stats()["queue_depth"]
+
+    @property
+    def queue_capacity(self) -> int:
+        return self.server.queue_capacity
+
+    @property
+    def buckets(self):
+        first = next(iter(self.server.tenants().values()), None)
+        return tuple(first.buckets) if first else ()
+
+    @property
+    def active_buckets(self):
+        first = next(iter(self.server.tenants().values()), None)
+        return tuple(first.active_buckets) if first else ()
+
+    @property
+    def max_wait_ms(self) -> float:
+        first = next(iter(self.server.tenants().values()), None)
+        return first.max_wait_ms if first else 0.0
+
+    def set_max_wait_ms(self, v: float) -> None:
+        self.server.set_max_wait_ms(v)
+
+    def set_active_buckets(self, buckets) -> None:
+        self.server.set_active_buckets(buckets)
+
+    @property
+    def precision(self) -> str:
+        first = next(iter(self.server.tenants().values()), None)
+        return first.precision if first else "bf16"
+
+    @property
+    def precisions(self):
+        first = next(iter(self.server.tenants().values()), None)
+        return tuple(first.precisions) if first else ()
+
+    def set_precision(self, precision: str) -> None:
+        self.server.set_precision(precision)
+
+    @property
+    def parity_top1(self):
+        first = next(iter(self.server.tenants().values()), None)
+        return first.parity_top1 if first else None

@@ -37,11 +37,22 @@ params, warmup, and load shape. Rows carry ``precision``, and int8 rows
 carry ``parity_top1`` — the startup int8-vs-bf16 top-1 agreement the
 throughput claim is conditioned on.
 
+``--models resnet18,mobilenet_v2`` turns the sweep multi-tenant
+(ISSUE 14): ONE zoo server/fleet holds every tenant's executable sets,
+the load driver interleaves per-tenant traffic from a seeded assignment
+sequence (``--hot-model X`` skews 80% onto one tenant — the starvation
+drill), and every sweep point yields one row PER TENANT (model-keyed
+p99/fill/rejected columns, ``load_shape`` stamped). ``model`` +
+``load_shape`` key into ``check_regression``'s serve trend-line
+identity, so tenant rows never compare cross-model or cross-shape.
+
 Run: ``python tools/bench_serve.py --smoke [--out docs/serve_bench.json]``
      ``python tools/bench_serve.py --bucket-sets "1,8,32,128;1,32,512" \
         --max-wait-ms 2,5,10 --requests 2000 --rps 0,500,2000``
      ``python tools/bench_serve.py --smoke --fleet 3``
      ``python tools/bench_serve.py --smoke --precision bf16,int8``
+     ``python tools/bench_serve.py --smoke --fleet 2 \
+        --models resnet18,mobilenet_v2 [--hot-model resnet18]``
 """
 
 from __future__ import annotations
@@ -201,6 +212,126 @@ def _per_host_breakdown(snaps0, snaps1, stats0, stats1) -> dict:
     return out
 
 
+def run_point_tenants(server, pool, models, weights, *, mode, requests,
+                      concurrency, rps, seed, timeout_s, fleet_hosts=0,
+                      load_shape="uniform"):
+    """Multi-tenant sweep point (ISSUE 14): one seeded tenant-assignment
+    sequence drives interleaved traffic across ``models`` (weighted —
+    the hot-tenant skewed shape), latencies/rejections tally PER TENANT,
+    and the point yields one ``serve_bench`` row per tenant (p99 / fill /
+    rejected columns each under its ``model`` key).
+
+    Open-loop arrivals for a tenant inside its own ``retry_after_ms``
+    backoff window are SHED client-side (counted rejected) — per-tenant
+    backpressure must not distort the other tenants' arrival process."""
+    from mpi_pytorch_tpu.serve import QueueFullError
+
+    rng = np.random.default_rng(seed)
+    assign = rng.choice(len(models), size=requests, p=weights)
+    stats0 = server.tenant_stats()
+    lat = {m: [] for m in models}
+    rejected = {m: 0 for m in models}
+    lock = threading.Lock()
+
+    if mode == "open":
+        gaps = rng.exponential(1.0 / rps, size=requests)
+        backoff_until = {m: 0.0 for m in models}
+        futures = []
+        t0 = time.monotonic()
+        next_t = t0
+        for i in range(requests):
+            model = models[int(assign[i])]
+            next_t += gaps[i]
+            delay = next_t - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            if time.monotonic() < backoff_until[model]:
+                rejected[model] += 1  # shed: the tenant said "not yet"
+                continue
+            t_submit = time.monotonic()
+            try:
+                fut = server.submit(pool[i % len(pool)], model=model)
+            except QueueFullError as e:
+                rejected[model] += 1
+                if e.retry_after_ms:
+                    backoff_until[model] = max(
+                        backoff_until[model],
+                        time.monotonic() + e.retry_after_ms / 1e3,
+                    )
+                continue
+
+            def _done(f, m=model, t_submit=t_submit):
+                dt = 1e3 * (time.monotonic() - t_submit)
+                with lock:
+                    lat[m].append(dt)
+
+            fut.add_done_callback(_done)
+            futures.append(fut)
+        for f in futures:
+            f.result(timeout=timeout_s)
+        wall = time.monotonic() - t0
+    else:
+        counter = [0]
+
+        def client() -> None:
+            while True:
+                with lock:
+                    i = counter[0]
+                    if i >= requests:
+                        return
+                    counter[0] += 1
+                model = models[int(assign[i])]
+                t_submit = time.monotonic()
+                try:
+                    server.submit(
+                        pool[i % len(pool)], model=model
+                    ).result(timeout=timeout_s)
+                except QueueFullError:
+                    with lock:
+                        rejected[model] += 1
+                    continue
+                dt = 1e3 * (time.monotonic() - t_submit)
+                with lock:
+                    lat[model].append(dt)
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=client) for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+
+    stats1 = server.tenant_stats()
+    compiles = server.stats()["compiles_after_warmup"]
+    rows = []
+    for m in models:
+        s0, s1 = stats0.get(m, {}), stats1.get(m, {})
+        served = s1.get("served", 0) - s0.get("served", 0)
+        padded = s1.get("padded_rows", 0) - s0.get("padded_rows", 0)
+        fill = served / (served + padded) if served + padded else 0.0
+        share = float(weights[models.index(m)])
+        rows.append({
+            "kind": "serve_bench",
+            "ts": time.time(),
+            "mode": mode,
+            "model": m,
+            "load_shape": load_shape,
+            "requests": len(lat[m]),
+            "rejected": rejected[m],
+            "offered_rps": round(rps * share, 1) if mode == "open" else None,
+            "images_per_sec": (
+                round(len(lat[m]) / wall, 1) if wall > 0 else 0.0
+            ),
+            "mean_fill_ratio": round(fill, 4),
+            "compiles_after_warmup": compiles,
+            **_percentiles(lat[m]),
+        })
+        if fleet_hosts:
+            rows[-1]["fleet_hosts"] = fleet_hosts
+    return rows
+
+
 def run_point(server, pool, *, mode, requests, concurrency, rps, seed, timeout_s,
               fleet_hosts=0):
     stats0 = server.stats()
@@ -278,6 +409,22 @@ def main() -> int:
                     "ONE server holding both startup-compiled executable "
                     "sets and sweep by switching live (no recompile); "
                     "int8 rows carry the startup parity_top1 stamp")
+    ap.add_argument("--models", default="",
+                    help="comma list of tenant specs (ISSUE 14, e.g. "
+                    "'resnet18,mobilenet_v2'): ONE multi-tenant server/"
+                    "fleet serves the whole zoo, sweeps drive interleaved "
+                    "per-tenant traffic, and each sweep point yields one "
+                    "row PER TENANT (model-keyed p99/fill/rejected "
+                    "columns; check_regression keys model + load_shape "
+                    "into the trend-line identity)")
+    ap.add_argument("--hot-model", default="",
+                    help="with --models: skew the offered load onto this "
+                    "tenant (80%% hot / 20%% split over the rest) — the "
+                    "hot-tenant starvation shape; rows stamp "
+                    "load_shape='hot:<model>'")
+    ap.add_argument("--pack-budget-mb", type=float, default=0.0,
+                    help="with --models: the per-host packing budget "
+                    "(serve_pack_budget_mb; 0 = unbounded)")
     ap.add_argument("--trace-sample-rate", type=float, default=0.0,
                     help="> 0 (needs --fleet N): distributed tracing at "
                     "the router front door + the FleetCollector, and each "
@@ -296,7 +443,7 @@ def main() -> int:
         args.topk, args.compute_dtype = 3, "float32"
         # Fleet smoke: one bucket set (the hosts share its executables,
         # but each SET is a fresh fleet build — keep tier-1 cheap).
-        args.bucket_sets = "1,4" if args.fleet else "1,4;1,8"
+        args.bucket_sets = "1,4" if (args.fleet or args.models) else "1,4;1,8"
         args.max_wait_ms, args.requests, args.concurrency = "2", 48, 8
         args.rps = "0,400"
 
@@ -353,6 +500,35 @@ def main() -> int:
     # a default pure-bf16 run keeps v6-identical rows, so its trend lines
     # keep pairing with pre-v7 baselines (the serve-record rule).
     stamp_precision = "int8" in precisions
+    tenant_models: list[str] = []
+    tenant_weights: list[float] = []
+    load_shape = "uniform"
+    if args.models:
+        from mpi_pytorch_tpu.serve.zoo import parse_model_specs
+
+        tenant_models = [s.model for s in parse_model_specs(args.models)]
+        if args.hot_model:
+            if args.hot_model not in tenant_models:
+                print(f"--hot-model {args.hot_model!r} is not in --models",
+                      file=sys.stderr)
+                return 2
+            if len(tenant_models) < 2:
+                print("--hot-model needs >= 2 tenants", file=sys.stderr)
+                return 2
+            # The hot-tenant skewed shape: 80% of offered load on the
+            # hot tenant, the rest split evenly — the starvation drill.
+            cold_share = 0.2 / (len(tenant_models) - 1)
+            tenant_weights = [
+                0.8 if m == args.hot_model else cold_share
+                for m in tenant_models
+            ]
+            load_shape = f"hot:{args.hot_model}"
+        else:
+            tenant_weights = [1.0 / len(tenant_models)] * len(tenant_models)
+    elif args.hot_model or args.pack_budget_mb:
+        print("--hot-model/--pack-budget-mb need --models", file=sys.stderr)
+        return 2
+
     for bucket_set in [b for b in args.bucket_sets.split(";") if b.strip()]:
         cfg = Config(
             model_name=args.model, num_classes=args.num_classes,
@@ -362,6 +538,8 @@ def main() -> int:
             serve_topk=args.topk, fused_head_eval=args.fused_head,
             serve_fleet_hosts=max(0, args.fleet),
             serve_precision=serve_precision,
+            serve_models=args.models,
+            serve_pack_budget_mb=args.pack_budget_mb,
             compilation_cache_dir=cache_dir,
             trace_sample_rate=args.trace_sample_rate,
             # The collector is what derives the per-phase breakdown; a
@@ -375,6 +553,10 @@ def main() -> int:
             server = RemoteFleet(cfg)
         elif args.fleet > 0:
             server = FleetServer(cfg, load_checkpoint=False)
+        elif args.models:
+            from mpi_pytorch_tpu.serve.zoo import ZooServer
+
+            server = ZooServer(cfg, load_checkpoint=False)
         else:
             server = InferenceServer(cfg, load_checkpoint=False)
         try:
@@ -385,19 +567,27 @@ def main() -> int:
                     server.set_max_wait_ms(wait_ms)
                     for rps in rates:
                         mode = "open" if rps > 0 else "closed"
-                        row = run_point(
-                            server, pool, mode=mode, requests=args.requests,
-                            concurrency=args.concurrency, rps=rps,
-                            seed=args.seed, timeout_s=args.timeout_s,
-                            fleet_hosts=max(0, args.fleet),
-                        )
-                        row.update(
-                            model=args.model, buckets=bucket_set,
-                            max_wait_ms=wait_ms, chips=jax.device_count(),
-                        )
-                        if args.transport == "remote":
-                            row["transport"] = "http"
+                        if tenant_models:
+                            rows = run_point_tenants(
+                                server, pool, tenant_models, tenant_weights,
+                                mode=mode, requests=args.requests,
+                                concurrency=args.concurrency, rps=rps,
+                                seed=args.seed, timeout_s=args.timeout_s,
+                                fleet_hosts=max(0, args.fleet),
+                                load_shape=load_shape,
+                            )
+                        else:
+                            row = run_point(
+                                server, pool, mode=mode,
+                                requests=args.requests,
+                                concurrency=args.concurrency, rps=rps,
+                                seed=args.seed, timeout_s=args.timeout_s,
+                                fleet_hosts=max(0, args.fleet),
+                            )
+                            row["model"] = args.model
+                            rows = [row]
                         collector = getattr(server, "collector", None)
+                        per_phase = None
                         if collector is not None:
                             # One forced scrape so the point's spans are
                             # all in, then the per-phase p50/p99 deltas
@@ -405,14 +595,24 @@ def main() -> int:
                             # satellite: the attribution columns).
                             collector.tick()
                             per_phase = collector.drain_phase_stats()
-                            if per_phase:
+                        for row in rows:
+                            row.update(
+                                buckets=bucket_set, max_wait_ms=wait_ms,
+                                chips=jax.device_count(),
+                            )
+                            if args.transport == "remote":
+                                row["transport"] = "http"
+                            if per_phase and not tenant_models:
+                                # Per-phase spans are not tenant-split:
+                                # attach only to single-model rows.
                                 row["per_phase"] = per_phase
-                        if stamp_precision:
-                            row["precision"] = precision
-                        if precision == "int8" and server.parity_top1 is not None:
-                            row["parity_top1"] = server.parity_top1
-                        print(json.dumps(row), flush=True)
-                        out_rows.append(row)
+                            if stamp_precision:
+                                row["precision"] = precision
+                            if (precision == "int8"
+                                    and server.parity_top1 is not None):
+                                row["parity_top1"] = server.parity_top1
+                            print(json.dumps(row), flush=True)
+                            out_rows.append(row)
         finally:
             server.close()
 

@@ -99,17 +99,19 @@ def check_bench(root: str, tol_pct: float) -> list[str]:
 
 def _serve_key(row: dict) -> tuple:
     # fleet_hosts joined the sweep-point identity in schema v5, precision
-    # in v7, transport in v8: an N-host fleet row — or an int8 row, or a
-    # remote-transport row (real serving processes, requests crossing the
-    # wire) — is a different trend line than a single-server/bf16/
-    # in-process row at the same (mode, buckets, wait, rps), so none of
-    # them can ever be "a regression" against the other's baseline. Old
-    # rows (no field) key as None on both sides, so pre-v5/v7/v8
-    # baselines keep comparing unchanged.
+    # in v7, transport in v8, load_shape in v10: an N-host fleet row — or
+    # an int8 row, a remote-transport row, or a multi-tenant row under a
+    # skewed load shape — is a different trend line than a
+    # single-server/bf16/in-process/uniform row at the same
+    # (mode, buckets, wait, rps), so none of them can ever be "a
+    # regression" against the other's baseline. ``model`` has keyed the
+    # identity since v4 — tenant rows never compare cross-model. Old rows
+    # (no field) key as None on both sides, so prior-generation baselines
+    # keep comparing unchanged.
     return (
         row.get("mode"), row.get("buckets"), row.get("max_wait_ms"),
         row.get("offered_rps"), row.get("model"), row.get("fleet_hosts"),
-        row.get("precision"), row.get("transport"),
+        row.get("precision"), row.get("transport"), row.get("load_shape"),
     )
 
 

@@ -232,6 +232,24 @@ def summarize(records: list[dict]) -> dict:
             summary["serve"]["preprocess_ms"] = {
                 "mean": round(_mean(preps), 3), "max": round(max(preps), 3),
             }
+        if any(s.get("model") for s in serves):
+            # The v10 multi-tenant axis: per-tenant flush/fill breakdown
+            # (absent on untenanted streams — the table stays as before).
+            by_model: dict[str, dict] = {}
+            for s in serves:
+                m = by_model.setdefault(s.get("model") or "-", {
+                    "batches": 0, "requests": 0, "fills": [],
+                })
+                m["batches"] += 1
+                m["requests"] += s["requests"]
+                m["fills"].append(s["fill_ratio"])
+            summary["serve"]["by_model"] = {
+                name: {
+                    "batches": m["batches"], "requests": m["requests"],
+                    "mean_fill_ratio": round(_mean(m["fills"]), 4),
+                }
+                for name, m in sorted(by_model.items())
+            }
     serve_bench = by_kind.get("serve_bench", [])
     if serve_bench:
         summary["serve_bench"] = [
@@ -239,7 +257,7 @@ def summarize(records: list[dict]) -> dict:
                 "mode", "buckets", "max_wait_ms", "offered_rps", "requests",
                 "rejected", "p50_ms", "p95_ms", "p99_ms", "images_per_sec",
                 "compiles_after_warmup", "fleet_hosts", "precision",
-                "parity_top1", "per_phase",
+                "parity_top1", "per_phase", "model", "load_shape",
             )}
             for r in serve_bench
         ]
@@ -274,6 +292,7 @@ def summarize(records: list[dict]) -> dict:
                 "compiles_after_warmup", "precision_from", "precision_to",
                 "parity_top1", "hosts_from", "hosts_to", "reason",
                 "reject_rate", "queue_depth", "restarts", "transport",
+                "model", "resident", "plan",
             )}
             for f in fleet_events
         ]
@@ -485,6 +504,13 @@ def render(path: str, records: list[dict], summary: dict) -> str:
             ["bucket", "batches"],
             [[k, v] for k, v in sv["batches_by_bucket"].items()],
         ))
+        if "by_model" in sv:
+            out.append(table(
+                ["model", "batches", "requests", "fill%"],
+                [[name, m["batches"], m["requests"],
+                  round(100.0 * m["mean_fill_ratio"], 1)]
+                 for name, m in sv["by_model"].items()],
+            ))
     if "serve_bench" in summary:
         rows = summary["serve_bench"]
         headers = ["mode", "buckets", "wait_ms", "rps", "reqs", "p50", "p95",
@@ -499,6 +525,12 @@ def render(path: str, records: list[dict], summary: dict) -> str:
             headers.append("precision")
             for row, r in zip(cells, rows):
                 row.append(r.get("precision"))
+        if any(r.get("load_shape") for r in rows):
+            # The v10 multi-tenant axis: tenant + traffic shape columns
+            # (absent on single-model sweeps — table unchanged).
+            headers += ["model", "shape"]
+            for row, r in zip(cells, rows):
+                row += [r.get("model"), r.get("load_shape")]
         out += ["", "serve bench rows:", table(headers, cells)]
         for r in rows:
             if r.get("parity_top1") is not None:
@@ -543,7 +575,9 @@ def render(path: str, records: list[dict], summary: dict) -> str:
             )
         elif f["event"] == "retune":
             line = (
-                f"FLEET retune: host {f.get('host')} — max_wait "
+                f"FLEET retune: host {f.get('host')}"
+                + (f" tenant {f['model']}" if f.get("model") else "")
+                + " — max_wait "
                 f"{_fmt(f.get('max_wait_ms_from'))} → "
                 f"{_fmt(f.get('max_wait_ms_to'))} ms, buckets "
                 f"{f.get('buckets_from')} → {f.get('buckets_to')}"
@@ -565,6 +599,7 @@ def render(path: str, records: list[dict], summary: dict) -> str:
                 f"FLEET {f['event']}: {f.get('hosts_from')} → "
                 f"{f.get('hosts_to')} host(s)"
                 + (f" ({f.get('host')})" if f.get("host") else "")
+                + (f" [tenant {f['model']}]" if f.get("model") else "")
                 + (f" — {f['reason']}" if f.get("reason") else "")
             )
             evidence = []
@@ -582,6 +617,26 @@ def render(path: str, records: list[dict], summary: dict) -> str:
                 + (f" ({f['detail']})" if f.get("detail") else "")
                 + (f" — {f['reason']}" if f.get("reason") else "")
             )
+        elif f["event"] in ("swap_in", "evict"):
+            # The v10 zoo residency events: which tenant moved, what the
+            # host now holds, and (swap-ins) the packing plan's verdict.
+            line = (
+                f"FLEET {f['event']}: host {f.get('host')} "
+                f"{'loaded' if f['event'] == 'swap_in' else 'evicted'} "
+                f"tenant {f.get('model')}"
+                + (f" (resident: {', '.join(f['resident'])})"
+                   if f.get("resident") else "")
+            )
+            plan = f.get("plan") or {}
+            if plan:
+                line += (
+                    f" [plan {plan.get('total_mb')} MB"
+                    + (f" / {plan['budget_mb']} MB budget"
+                       if plan.get("budget_mb") is not None else "")
+                    + "]"
+                )
+            if f.get("compiles_after_warmup") is not None:
+                line += f" (compiles {f['compiles_after_warmup']})"
         else:
             line = f"FLEET {f['event']}: {f.get('host')} {f.get('detail') or ''}"
         out += ["", line]

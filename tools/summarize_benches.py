@@ -157,15 +157,25 @@ def main() -> None:
     sb = _load_jsonl(os.path.join(out, "serve_bench.json"))
     if sb:
         print("## serving latency vs load (tools/bench_serve.py)\n")
-        print("| mode | buckets | wait ms | offered rps | prec | fleet | "
-              "p50 ms | p95 ms | p99 ms | img/s | fill | rejected | "
-              "compiles |")
-        print("|---|---|---|---|---|---|---|---|---|---|---|---|---|")
+        # The v10 tenant columns: only rendered when some row carries a
+        # load_shape (a multi-tenant sweep) — single-model artifacts
+        # print the same table as before.
+        tenants = any(r.get("load_shape") for r in sb)
+        tenant_head = "model | shape | " if tenants else ""
+        print(f"| mode | buckets | wait ms | offered rps | {tenant_head}"
+              "prec | fleet | p50 ms | p95 ms | p99 ms | img/s | fill | "
+              "rejected | compiles |")
+        print("|---" * (13 + (2 if tenants else 0)) + "|")
         for r in sb:
             rps = r.get("offered_rps")
+            tenant_cells = (
+                f"{r.get('model') or '—'} | {r.get('load_shape') or '—'} | "
+                if tenants else ""
+            )
             print(
                 f"| {r['mode']} | {_cell(r['buckets'])} | {r['max_wait_ms']} | "
                 f"{'—' if rps is None else rps} | "
+                f"{tenant_cells}"
                 f"{r.get('precision') or 'bf16'} | "
                 f"{r.get('fleet_hosts') or '—'} | {r['p50_ms']} | "
                 f"{r['p95_ms']} | {r['p99_ms']} | {r['images_per_sec']:,.0f} | "
