@@ -50,18 +50,52 @@ class MeshConfig:
     data_axis: str = "data"
     model_axis: str = "model"
     pipe_axis: str = "pipe"
+    # Nested data-axis names (pods > 1). FIXED strings, not configurable:
+    # the collectives ledger classifies ICI-vs-DCN traffic by the "pod"
+    # name (parallel/mesh.POD_AXIS), and a renamed axis would silently
+    # misattribute cross-pod bytes.
+    pod_axis: str = "pod"
+    ici_axis: str = "ici"
     # -1 means "all remaining devices" on that axis.
     data_parallel: int = -1
     model_parallel: int = 1
     # Pipeline stages (driven by --pp-stages; the mesh gains a third axis
     # only when > 1, so existing 2-axis layouts are untouched).
     pipe_parallel: int = 1
+    # Cross-pod hierarchical training (--mesh-pods, ISSUE 15 / ROADMAP
+    # item 5): factor the data axis into the nested ("pod", "ici") pair —
+    # gradient sync becomes two-phase (reduce-scatter within the pod over
+    # fast ICI, cross-pod reduction over DCN with 1/ici the bytes,
+    # overlapped with backward), and ZeRO shards place within-pod so the
+    # param all_gather never crosses the DCN. 1 = flat mesh, unchanged.
+    pods: int = 1
 
     def validate(self) -> None:
         if self.model_parallel < 1:
             raise ValueError(f"model_parallel must be >= 1, got {self.model_parallel}")
         if self.pipe_parallel < 1:
             raise ValueError(f"pipe_parallel must be >= 1, got {self.pipe_parallel}")
+        if self.pods < 1:
+            raise ValueError(f"mesh pods must be >= 1, got {self.pods}")
+        # The nested-axis names really are fixed (see the field comment):
+        # is_hierarchical()/axis_kind() match the literal strings, so a
+        # renamed axis would make the step sync over only one data factor.
+        if self.pod_axis != "pod" or self.ici_axis != "ici":
+            raise ValueError(
+                "mesh pod_axis/ici_axis are fixed at 'pod'/'ici' (the "
+                "traffic ledger and the hierarchical step key on the "
+                f"literal names), got {self.pod_axis!r}/{self.ici_axis!r}"
+            )
+        # ...and the configurable axes may not claim the reserved names: a
+        # flat mesh named ('pod', 'ici') would read as hierarchical to
+        # is_hierarchical()/axis_kind() and sync over the wrong axes.
+        for field in ("data_axis", "model_axis", "pipe_axis"):
+            if getattr(self, field) in ("pod", "ici"):
+                raise ValueError(
+                    f"mesh {field} may not be named 'pod' or 'ici' — those "
+                    "names are reserved for the nested hierarchical data "
+                    f"axes, got {getattr(self, field)!r}"
+                )
 
 
 @dataclass
@@ -1109,6 +1143,24 @@ class Config:
                 "its replicated in/out specs would silently gather the TP-sharded "
                 "head. Use the default auto mode for mesh.model_parallel > 1."
             )
+        if self.mesh.pods > 1:
+            # Cross-pod hierarchical training (ISSUE 15): the two-phase
+            # ICI/DCN collectives live in the spmd shard_map step — the
+            # auto-partitioned jit step has no explicit collective to
+            # decompose (XLA schedules its own), so a nested mesh there
+            # would change nothing but the axis names.
+            if not self.spmd_mode:
+                raise ValueError(
+                    "mesh pods > 1 (hierarchical ICI/DCN gradient sync) "
+                    "requires spmd_mode: the two-phase collectives are "
+                    "explicit shard_map collectives (train/step.py)"
+                )
+            if self.pp_stages > 1:
+                raise ValueError(
+                    "mesh pods > 1 does not compose with pp_stages (the "
+                    "nested data axis and the pipe axis claim the same "
+                    "mesh reshape)"
+                )
         if self.pp_stages < 1:
             raise ValueError(f"pp_stages must be >= 1, got {self.pp_stages}")
         if self.pp_microbatches < 0:
@@ -1352,6 +1404,9 @@ def parse_config(argv: Sequence[str] | None = None, **overrides: Any) -> Config:
     _add_dataclass_args(parser, Config)
     # Convenience alias: one flag for square inputs (sets width AND height).
     parser.add_argument("--image-size", type=int, default=None, dest="image_size_alias")
+    # Alias for the nested-mesh pod count (ISSUE 15's documented spelling;
+    # equivalent to --mesh.pods).
+    parser.add_argument("--mesh-pods", type=int, default=None, dest="mesh_pods_alias")
     # STRICT parsing: an unknown flag must error, not be silently dropped —
     # a typo'd --batchsize otherwise trains with the default and no warning.
     args = parser.parse_args(argv)
@@ -1359,6 +1414,9 @@ def parse_config(argv: Sequence[str] | None = None, **overrides: Any) -> Config:
     alias = ns.pop("image_size_alias", None)
     if alias is not None:
         cfg.width = cfg.height = alias
+    pods_alias = ns.pop("mesh_pods_alias", None)
+    if pods_alias is not None:
+        cfg.mesh.pods = pods_alias
     for key, val in ns.items():
         if val is None:
             continue

@@ -233,8 +233,10 @@ def _row_sharding(mesh, batch: int):
     where a forced uneven shard would buy nothing)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    axis = mesh.axis_names[0]
-    spec = P(axis) if batch % mesh.shape[axis] == 0 else P()
+    from mpi_pytorch_tpu.parallel.mesh import data_axis_names, data_axis_size
+
+    axes = data_axis_names(mesh)  # ("pod", "ici") on a nested mesh
+    spec = P(axes) if batch % data_axis_size(mesh) == 0 else P()
     return NamedSharding(mesh, spec)
 
 

@@ -134,6 +134,10 @@ class StepHealth:
         # so the trainer leaves it unset — records carry it only from
         # tooling that measures it by A/B).
         self.overlap_frac: float | None = None
+        # Schema v11 (ISSUE 15): the cross-pod (DCN) overlap estimate of a
+        # hierarchical bucket plan — stamped only on --mesh-pods > 1 runs,
+        # so flat-mesh records stay byte-identical to prior generations.
+        self.dcn_overlap_frac: float | None = None
         # Consecutive steps whose GRADIENT norm was non-finite while the
         # loss stayed finite — the slow-corruption signal the preemption
         # watchdog (train/elastic.py) can act on before the loss itself
@@ -144,10 +148,19 @@ class StepHealth:
             _ensure_compile_listener()
             self._baseline = _compile_count
 
-    def set_sync(self, *, overlap_frac: float | None = None) -> None:
+    def set_sync(
+        self,
+        *,
+        overlap_frac: float | None = None,
+        dcn_overlap_frac: float | None = None,
+    ) -> None:
         """Arm the grad-sync fields on subsequent step records (trainer,
-        after the bucket plan is known)."""
+        after the bucket plan is known). ``dcn_overlap_frac`` is the
+        hierarchical (--mesh-pods) twin: what fraction of cross-pod sync
+        bytes are issued before the final bucket (train/step.py
+        hier_dcn_overlap_frac)."""
         self.overlap_frac = overlap_frac
+        self.dcn_overlap_frac = dcn_overlap_frac
 
     def start_epoch(self) -> None:
         """Re-arm the recompile counter: compiles BETWEEN epochs (first-call
@@ -187,6 +200,9 @@ class StepHealth:
         # records from lever-less runs stay byte-identical to v1.
         if self.overlap_frac is not None:
             record["overlap_frac"] = self.overlap_frac
+        # v11: hierarchical runs only (same absent-when-off discipline).
+        if self.dcn_overlap_frac is not None:
+            record["dcn_overlap_frac"] = self.dcn_overlap_frac
         if sync_ms is not None:
             record["sync_ms"] = round(sync_ms, 3)
         # Schema-v6 bad-step-policy fields (--bad-step-policy skip only):

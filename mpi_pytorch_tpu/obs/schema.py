@@ -16,7 +16,7 @@ Record kinds (every record also carries ``ts``, the epoch-seconds stamp
 | epoch     | epoch, loss, time_s, images_per_sec                 | tflops, mfu_pct |
 | val       | epoch, accuracy, loss                               |          |
 | eval      | accuracy, loss, images, time_s                      |          |
-| step      | epoch, step, loss                                   | grad_norm, data_wait_ms, step_ms, recompiles, hbm_bytes, sync_ms, overlap_frac, skipped, steps_skipped |
+| step      | epoch, step, loss                                   | grad_norm, data_wait_ms, step_ms, recompiles, hbm_bytes, sync_ms, overlap_frac, dcn_overlap_frac, skipped, steps_skipped |
 | heartbeat | epoch, step, step_ms, median_step_ms, stragglers, threshold | images_per_sec |
 | anomaly   | reason, epoch                                       | step, loss, grad_norm, path, detail |
 | serve     | bucket, requests, queue_depth, fill_ratio, queue_wait_ms, device_ms | preprocess_ms, total_ms, precision, model |
@@ -139,7 +139,16 @@ from typing import Any, Mapping
 #      ``serve_bench`` rows may carry ``load_shape`` (the multi-tenant
 #      sweep's traffic shape, e.g. "uniform" / "hot:resnet18"). All
 #      absent on untenanted serving — streams stay byte-identical to v9.
-SCHEMA_VERSION = 10
+#  11: the cross-pod hierarchical-training generation (ISSUE 15 / ROADMAP
+#      item 5): ``step`` records may carry ``dcn_overlap_frac`` (the
+#      static estimate of how much of the two-level grad sync's CROSS-POD
+#      (DCN) traffic is issued before the final reverse-topo bucket —
+#      stamped only on ``--mesh-pods > 1`` runs, so flat-mesh streams stay
+#      byte-identical to v10; the within-pod twin is v2's
+#      ``overlap_frac``). The checkpoint topology manifest and ``resume``
+#      records carry the pod factoring implicitly via their mesh-shape
+#      strings (``pod=2,ici=4,model=1``) — no new fields.
+SCHEMA_VERSION = 11
 
 _NUM = (int, float)
 _INT = (int,)
@@ -200,6 +209,9 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # v2 grad-sync fields (spmd --grad-sync-buckets; absent on v1
         # records and on lever-less runs):
         "sync_ms": _NUM, "overlap_frac": _NUM,
+        # v11: hierarchical (--mesh-pods > 1) runs only — the cross-pod
+        # (DCN) overlap estimate of the two-level bucket plan.
+        "dcn_overlap_frac": _NUM,
         # v6 bad-step-policy fields (--bad-step-policy skip only): whether
         # THIS step's update was discarded on a non-finite grad norm
         # (0/1), and the run's cumulative discard count.

@@ -23,6 +23,15 @@ ZeRO-sharded weight update (train/step.py ``zero_opt_state``) — the
 reference's MPI wrapper never needed them because every rank kept a full
 optimizer replica.
 
+Beyond that (ISSUE 15 / ROADMAP item 5): the TWO-PHASE hierarchical
+collectives of the nested ``(pod, ici)`` data axis — ``hier_psum`` /
+``hier_reduce_scatter_mean`` / ``hier_all_gather`` — reduce within the pod
+over fast ICI first, cross pods over the DCN with only the 1/ici-sized
+partial, and gather back within-pod (the hierarchical-allreduce
+decomposition of arXiv 1810.11112). Every collective in this module books
+its per-device egress bytes into the per-axis ``LEDGER``, attributed ICI vs
+DCN, so "how much gradient traffic crosses pods" is a number, not a guess.
+
 These functions must run inside an SPMD context that binds the axis name
 (``shard_map`` over a mesh, or ``jit``-of-``shard_map``). Under plain
 auto-sharded ``jit`` they are unnecessary: replication + XLA's partitioner
@@ -31,11 +40,108 @@ insert the equivalent collectives automatically.
 
 from __future__ import annotations
 
+import threading
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from mpi_pytorch_tpu.parallel.mesh import POD_AXIS
+
+
+# ---------------------------------------------------------------------------
+# Per-axis traffic ledger (ISSUE 15): every collective here records its
+# per-device egress bytes at TRACE time — shapes and axis sizes are static,
+# so one trace of the step IS the per-step traffic — keyed "dcn" when the
+# reduction touches the ``pod`` axis and "ici" otherwise. Consumers (the
+# trainer, tools/bench_modes.py, tests) reset() before lowering a step and
+# snapshot() after: jit caches the trace, so the recorded bytes are exactly
+# one step's. Zero runtime cost: nothing executes on the hot path.
+# ---------------------------------------------------------------------------
+
+
+class TrafficLedger:
+    """Byte/op counts per axis kind ("ici" / "dcn"), with the collective op
+    name retained so a snapshot explains WHERE the bytes come from."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[tuple[str, str], dict] = {}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def add(self, kind: str, op: str, nbytes: float) -> None:
+        with self._lock:
+            e = self._entries.setdefault((kind, op), {"bytes": 0.0, "ops": 0})
+            e["bytes"] += float(nbytes)
+            e["ops"] += 1
+
+    def snapshot(self) -> dict:
+        """``{"ici": {"bytes": .., "ops": .., "by_op": {op: bytes}},
+        "dcn": {...}}`` — both kinds always present (a flat mesh reads
+        ``dcn.bytes == 0``, which is itself the claim)."""
+        out = {
+            k: {"bytes": 0, "ops": 0, "by_op": {}} for k in ("ici", "dcn")
+        }
+        with self._lock:
+            for (kind, op), e in self._entries.items():
+                bucket = out.setdefault(
+                    kind, {"bytes": 0, "ops": 0, "by_op": {}}
+                )
+                bucket["bytes"] = int(bucket["bytes"] + e["bytes"])
+                bucket["ops"] += e["ops"]
+                bucket["by_op"][op] = int(
+                    bucket["by_op"].get(op, 0) + e["bytes"]
+                )
+        return out
+
+
+LEDGER = TrafficLedger()
+
+
+def axis_kind(axis) -> str:
+    """Which fabric a collective over ``axis`` rides: anything touching the
+    ``pod`` axis crosses pods (DCN); everything else stays within-pod ICI —
+    including a flat mesh's whole ``data`` axis (one pod, by definition)."""
+    names = (axis,) if isinstance(axis, str) else tuple(axis)
+    return "dcn" if POD_AXIS in names else "ici"
+
+
+def _axis_size(axis) -> int:
+    """Static size of (possibly multiple) bound named axes. ``lax.psum`` of
+    a unit Python scalar over bound axes folds to a concrete int at trace
+    time, so this costs nothing in the compiled program."""
+    return int(lax.psum(1, axis))
+
+
+def _account(op: str, axis, payload_bytes: float) -> None:
+    """Book one collective's per-device egress bytes (ring-algorithm cost
+    model, the convention of the allreduce literature): over an axis of
+    size P and a full payload of n bytes, an all-reduce moves
+    ``2n(P-1)/P``, a reduce-scatter or all-gather ``n(P-1)/P`` per device.
+    ``payload_bytes`` is always the FULL logical vector size n."""
+    try:
+        size = _axis_size(axis)
+    except Exception:
+        return  # unbound axis (collective used outside shard_map): no entry
+    if size <= 1:
+        return
+    factor = {
+        "all_reduce": 2.0 * (size - 1) / size,
+        "reduce_scatter": (size - 1) / size,
+        "all_gather": (size - 1) / size,
+    }[op]
+    LEDGER.add(axis_kind(axis), op, payload_bytes * factor)
+
+
+def _tree_bytes(x: Any) -> int:
+    return sum(
+        leaf.size * jnp.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree_util.tree_leaves(x)
+    )
 
 
 def num_processes() -> int:
@@ -51,12 +157,14 @@ def num_devices() -> int:
 def all_reduce(x: Any, op: str = "sum", axis: str = "data") -> Any:
     """Pytree allreduce (≙ ``mpi_all_reduce``/``mpi_sum``, mpi_tools.py:12-27)."""
     reducer = {"sum": lax.psum, "mean": lax.pmean, "max": lax.pmax, "min": lax.pmin}[op]
+    _account("all_reduce", axis, _tree_bytes(x))
     return jax.tree_util.tree_map(lambda v: reducer(v, axis), x)
 
 
 def avg_grads(grads: Any, axis: str = "data") -> Any:
     """Average a gradient pytree across the data axis — the entire
     ``mpi_avg_grads`` stack (mpi_tools.py:30-37) as one fused collective."""
+    _account("all_reduce", axis, _tree_bytes(grads))
     return jax.tree_util.tree_map(lambda g: lax.pmean(g, axis), grads)
 
 
@@ -67,9 +175,17 @@ def all_gather(x: Any, axis: str = "data") -> Any:
     update (train/step.py, ``zero_opt_state``): each shard applies the
     optimizer to its 1/P parameter slice, then one allgather rebuilds the
     full parameter tree for the next forward."""
+    _account("all_gather", axis, _tree_bytes(x) * _safe_axis_size(axis))
     return jax.tree_util.tree_map(
         lambda v: lax.all_gather(v, axis, tiled=True), x
     )
+
+
+def _safe_axis_size(axis) -> int:
+    try:
+        return _axis_size(axis)
+    except Exception:
+        return 1
 
 
 def reduce_scatter_mean(x: Any, axis: str = "data") -> Any:
@@ -81,6 +197,7 @@ def reduce_scatter_mean(x: Any, axis: str = "data") -> Any:
     shard only ever *needs* its own gradient slice, so the grad collective
     halves from allreduce to reduce-scatter."""
     size = lax.psum(1, axis)
+    _account("reduce_scatter", axis, _tree_bytes(x))
     return jax.tree_util.tree_map(
         lambda v: lax.psum_scatter(v, axis, scatter_dimension=0, tiled=True)
         / size,
@@ -106,6 +223,92 @@ def sync_params(params: Any, axis: str = "data", root: int = 0) -> Any:
     replication is maintained by the compiler; kept for SPMD-explicit code
     and for repairing divergence after per-shard mutation."""
     return broadcast_from(params, axis=axis, root=root)
+
+
+# ---------------------------------------------------------------------------
+# Two-phase hierarchical collectives over the nested (pod, ici) data axis
+# (ISSUE 15 / ROADMAP item 5). The decomposition is the classic hierarchical
+# allreduce (arXiv 1810.11112): reduce-scatter WITHIN the pod over fast ICI
+# (phase 1), all-reduce the 1/ici-sized partial ACROSS pods over the DCN
+# (phase 2 — the only bytes that leave a pod), gather back within-pod
+# (phase 3). Numerically ≡ one fused pmean over both axes up to reduction
+# order; tests/test_hierarchical.py pins the parity on raw arrays and
+# through the full trainer.
+# ---------------------------------------------------------------------------
+
+
+def _hier_rs_leaf(v, ici_axis: str, pod_axis: str, mean: bool):
+    """One leaf through phases 1+2: flatten, pad to the ici size, ICI
+    reduce-scatter, DCN psum of the slice. Returns ``(slice, orig)`` where
+    ``slice`` is this shard's [chunk] of the global sum (or mean)."""
+    ici = _axis_size(ici_axis)
+    chunk = -(-v.size // ici)
+    flat = jnp.pad(v.reshape(-1), (0, chunk * ici - v.size))
+    _account("reduce_scatter", ici_axis, flat.size * jnp.dtype(flat.dtype).itemsize)
+    sl = lax.psum_scatter(
+        flat.reshape(ici, chunk), ici_axis, scatter_dimension=0, tiled=True
+    ).reshape(-1)
+    _account("all_reduce", pod_axis, sl.size * jnp.dtype(sl.dtype).itemsize)
+    sl = lax.psum(sl, pod_axis)
+    if mean:
+        sl = sl / (ici * _axis_size(pod_axis))
+    return sl
+
+
+def hier_reduce_scatter_mean(
+    x: Any, ici_axis: str = "ici", pod_axis: str = "pod"
+) -> Any:
+    """Pytree hierarchical reduce-scatter-mean: shard (p, i) receives slice
+    ``i`` of the GLOBAL (all-pod) mean of every leaf, pod-replicated — ICI
+    carries the full payload once, the DCN only 1/ici of it. This is the
+    ZeRO-hierarchical gradient path (train/step.py): the within-pod shard
+    index owns the slice, so the optimizer update that follows needs
+    nothing more. Slices are in the ``zero_shard_spec`` flatten-pad layout
+    (strip padding with ``leaf[:orig.size]``)."""
+    return jax.tree_util.tree_map(
+        lambda v: _hier_rs_leaf(v, ici_axis, pod_axis, mean=True), x
+    )
+
+
+def hier_all_gather(x: Any, ici_axis: str = "ici") -> Any:
+    """Pytree tiled allgather over the ICI axis ONLY — the within-pod
+    reassembly (phase 3). Because the ZeRO shard index is the position on
+    ``ici`` alone, every pod holds an identical set of slices and the
+    gather never touches the DCN: params cost zero cross-pod bytes."""
+    _account(
+        "all_gather", ici_axis, _tree_bytes(x) * _safe_axis_size(ici_axis)
+    )
+    return jax.tree_util.tree_map(
+        lambda v: lax.all_gather(v, ici_axis, tiled=True), x
+    )
+
+
+def hier_psum(
+    x: Any, ici_axis: str = "ici", pod_axis: str = "pod", mean: bool = False
+) -> Any:
+    """Pytree hierarchical all-reduce: the full three-phase decomposition
+    (ICI reduce-scatter → DCN psum → ICI all-gather), returning every
+    shard's full-shape global sum (or mean) — what ``lax.psum(x, ("pod",
+    "ici"))`` computes, at 1/ici the DCN bytes. Used for whole-tree syncs
+    that every shard needs in full (fused grad sync without ZeRO, BN
+    running stats)."""
+
+    def leaf(v):
+        sl = _hier_rs_leaf(v, ici_axis, pod_axis, mean=mean)
+        _account(
+            "all_gather", ici_axis,
+            sl.size * jnp.dtype(sl.dtype).itemsize * _safe_axis_size(ici_axis),
+        )
+        full = lax.all_gather(sl, ici_axis, tiled=True)
+        return full[: v.size].reshape(v.shape)
+
+    return jax.tree_util.tree_map(leaf, x)
+
+
+def hier_pmean(x: Any, ici_axis: str = "ici", pod_axis: str = "pod") -> Any:
+    """``hier_psum`` with the global mean — the hierarchical twin of
+    ``avg_grads``."""
+    return hier_psum(x, ici_axis, pod_axis, mean=True)
 
 
 def host_allgather(values) -> "Any":

@@ -27,7 +27,15 @@ def create_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
     ``axis_names[0] == data`` / ``axis_names[1] == model`` — are untouched.
     Pipe is the LAST reshape axis: consecutive pipeline stages land on
     adjacent devices, so the stage→stage ``ppermute`` rides neighbor ICI
-    links."""
+    links.
+
+    ``pods > 1`` (--mesh-pods, ISSUE 15 / ROADMAP item 5) FACTORS the data
+    axis into the nested ``(pod, ici)`` pair instead: the mesh becomes
+    ``(pod, ici, model)`` with ``pod`` as the MAJOR reshape axis, so every
+    ``ici`` group is a contiguous run of devices — and, multi-host, a
+    contiguous run of whole processes — meaning the within-pod collectives
+    never cross a pod boundary (ICI stays ICI, and only the ``pod`` axis
+    rides the DCN). Flat meshes (pods == 1) are byte-identical to before."""
     from mpi_pytorch_tpu.utils.env import fault_countdown
 
     if fault_countdown("MPT_FAULT_BACKEND_WEDGE_N"):
@@ -50,11 +58,96 @@ def create_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
             f"data_parallel×model_parallel×pipe_parallel = {dp}×{mp}×{pp} "
             f"!= {n} devices"
         )
+    if cfg.pods > 1:
+        if pp > 1:
+            raise ValueError(
+                "mesh pods (hierarchical data axis) does not compose with "
+                "pipe_parallel — the pipe axis claims the trailing reshape "
+                "position the nested layout needs"
+            )
+        if dp % cfg.pods != 0:
+            raise ValueError(
+                f"data-parallel size {dp} not divisible by pods={cfg.pods}; "
+                "the data axis factors as pods × ici"
+            )
+        ici = dp // cfg.pods
+        per_pod = ici * mp
+        local = jax.local_device_count()
+        if jax.process_count() > 1 and per_pod % local != 0:
+            raise ValueError(
+                f"each pod spans {per_pod} device(s) but processes hold "
+                f"{local}; a process may not straddle a pod boundary "
+                "(pods are whole hosts on separate DCN domains)"
+            )
+        arr = np.asarray(devices).reshape(cfg.pods, ici, mp)
+        return Mesh(arr, (cfg.pod_axis, cfg.ici_axis, cfg.model_axis))
     if pp == 1:
         arr = np.asarray(devices).reshape(dp, mp)
         return Mesh(arr, (cfg.data_axis, cfg.model_axis))
     arr = np.asarray(devices).reshape(dp, mp, pp)
     return Mesh(arr, (cfg.data_axis, cfg.model_axis, cfg.pipe_axis))
+
+
+# ---------------------------------------------------------------------------
+# Nested (hierarchical) data-axis helpers — the one vocabulary every layer
+# keys the pod/ici factoring on, so "is this mesh hierarchical" can never
+# drift between the step, the state sharder, and the trainer.
+# ---------------------------------------------------------------------------
+
+# The nested data-axis names are FIXED (unlike the flat axis, which
+# MeshConfig can rename): the traffic ledger classifies collectives by
+# whether they touch "pod", and a renamed pod axis would silently book DCN
+# traffic as ICI.
+POD_AXIS, ICI_AXIS = "pod", "ici"
+
+
+def is_hierarchical(mesh: Mesh) -> bool:
+    """Whether ``mesh`` carries the nested ``(pod, ici)`` data factoring."""
+    return POD_AXIS in mesh.axis_names and ICI_AXIS in mesh.axis_names
+
+
+def data_axis_names(mesh: Mesh) -> tuple[str, ...]:
+    """The batch-sharding axes, major→minor: ``("pod", "ici")`` on a nested
+    mesh, ``(axis_names[0],)`` on a flat one. Everything that shards a batch
+    dimension (or psums a per-shard scalar globally) reduces over exactly
+    this tuple."""
+    if is_hierarchical(mesh):
+        return (POD_AXIS, ICI_AXIS)
+    return (mesh.axis_names[0],)
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    """Total data-parallel shard count (pods × ici on a nested mesh)."""
+    size = 1
+    for a in data_axis_names(mesh):
+        size *= int(mesh.shape[a])
+    return size
+
+
+def pod_shape(mesh: Mesh) -> tuple[int, int]:
+    """``(pods, ici)`` — ``(1, data_size)`` on a flat mesh, so flat-mesh
+    callers can treat every mesh as one pod."""
+    if is_hierarchical(mesh):
+        return int(mesh.shape[POD_AXIS]), int(mesh.shape[ICI_AXIS])
+    return 1, data_axis_size(mesh)
+
+
+def zero_shard_axis(mesh: Mesh) -> tuple[str, int]:
+    """``(axis_name, n_shards)`` the ZeRO optimizer-state partition keys on:
+    the ``ici`` axis on a nested mesh — shards place WITHIN a pod, each pod
+    holding a full (pod-replicated) copy, so the param all_gather that
+    reassembles full weights every step never touches the DCN — and the
+    whole data axis on a flat one."""
+    if is_hierarchical(mesh):
+        return ICI_AXIS, int(mesh.shape[ICI_AXIS])
+    axis = mesh.axis_names[0]
+    return axis, int(mesh.shape[axis])
+
+
+def model_axis_name(mesh: Mesh) -> str:
+    """The TP axis: ``axis_names[2]`` on a nested ``(pod, ici, model)``
+    mesh, ``axis_names[1]`` otherwise (flat 2-axis and pipe 3-axis alike)."""
+    return mesh.axis_names[2] if is_hierarchical(mesh) else mesh.axis_names[1]
 
 
 def mesh_topology(mesh: Mesh) -> dict:
@@ -118,7 +211,7 @@ def param_specs(params: Any, mesh: Mesh, fsdp: bool = False) -> Any:
     before use and reduce-scatters its gradient — the compiler-native form of
     fully-sharded data parallelism. Params with no divisible axis (small
     biases, BN scales) stay replicated."""
-    model_axis = mesh.axis_names[1]
+    model_axis = model_axis_name(mesh)
     data_axis, data_size = mesh.axis_names[0], mesh.shape[mesh.axis_names[0]]
 
     def spec(path, leaf):
@@ -154,8 +247,13 @@ def shard_batch(batch: tuple, mesh: Mesh) -> tuple:
     Multi-host: each host holds only its own shard of the global batch
     (per-host manifest sharding, trainer.build_training), so the global array
     is assembled from process-local data — no cross-host scatter traffic,
-    unlike the reference's rank-0 pickled-dataframe scatter."""
-    data_axis = mesh.axis_names[0]
+    unlike the reference's rank-0 pickled-dataframe scatter.
+
+    Nested meshes shard the batch over BOTH data factors (``("pod",
+    "ici")`` on dim 0) — pod-major, so shard (p, i) holds exactly the rows
+    flat shard ``p*ici + i`` would (the property the hierarchical ≡ flat
+    parity tests rest on)."""
+    data_axis = data_axis_names(mesh)
 
     def put(x):
         spec = P(data_axis, *([None] * (x.ndim - 1)))

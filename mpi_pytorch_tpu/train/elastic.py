@@ -54,7 +54,11 @@ from typing import Any
 import jax
 
 from mpi_pytorch_tpu import checkpoint as ckpt
-from mpi_pytorch_tpu.parallel.mesh import describe_topology, mesh_topology
+from mpi_pytorch_tpu.parallel.mesh import (
+    describe_topology,
+    mesh_topology,
+    zero_shard_axis,
+)
 from mpi_pytorch_tpu.train.state import _BOUNDED_LEAF_BYTES, zero_shard_spec
 from mpi_pytorch_tpu.train.step import place_state_on_mesh
 from mpi_pytorch_tpu.utils.env import env_int, fault_countdown
@@ -101,7 +105,10 @@ def topology_manifest(
         "spmd_mode": bool(spmd_mode),
     }
     if zero_opt_state:
-        n_shards = int(mesh.shape[mesh.axis_names[0]])
+        # The ZeRO partition axis: within-pod (ici) on a nested mesh —
+        # matches what zero_shard_opt_state actually chunked to, so a
+        # restore states the true P_old (parallel/mesh.zero_shard_axis).
+        _, n_shards = zero_shard_axis(mesh)
         manifest["zero_shards"] = n_shards
         if opt_template is not None:
             manifest["zero_shard_layout"] = zero_shard_layout(opt_template, n_shards)
@@ -496,6 +503,7 @@ class FaultInjector:
         self.delay_ms = env_int("MPT_FAULT_DELAY_STEP_MS", 0)
         self.delay_process = env_int("MPT_FAULT_DELAY_PROCESS", -1)
         self.delay_after = env_int("MPT_FAULT_DELAY_AFTER_STEP", 0)
+        self.dcn_delay_ms = env_int("MPT_FAULT_DCN_DELAY_MS", 0)
         self.nonfinite_at_step = env_int("MPT_FAULT_NONFINITE_AT_STEP", 0)
         self.preempt_at_step = env_int("MPT_FAULT_PREEMPT_AT_STEP", 0)
         self.preempt_fired = False
@@ -507,8 +515,8 @@ class FaultInjector:
     @property
     def active(self) -> bool:
         return bool(
-            self.kill_at_step or self.delay_ms or self.nonfinite_at_step
-            or self.preempt_at_step
+            self.kill_at_step or self.delay_ms or self.dcn_delay_ms
+            or self.nonfinite_at_step or self.preempt_at_step
         )
 
     def poison_batches(self, batches, epoch: int | None = None):
@@ -589,3 +597,16 @@ class FaultInjector:
             return
         if self.delay_process < 0 or process_index() == self.delay_process:
             time.sleep(self.delay_ms / 1e3)
+
+    def maybe_dcn_delay(self, hierarchical: bool) -> None:
+        """``MPT_FAULT_DCN_DELAY_MS`` — the slow-DCN-link fake (ISSUE 15):
+        stretch every step by the injected cross-pod latency, but ONLY on
+        hierarchical (pods > 1) runs — a flat mesh has no DCN phase, so
+        the gate correctly does nothing there (the property the overlap
+        chaos test pins). Host-side stand-in: the device step is one fused
+        program, so the delay lands in the timed region like a real slow
+        second-stage reduction would, and heartbeats/step records carry
+        it."""
+        if self.dcn_delay_ms <= 0 or not hierarchical:
+            return
+        time.sleep(self.dcn_delay_ms / 1e3)

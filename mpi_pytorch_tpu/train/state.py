@@ -148,12 +148,21 @@ def zero_shard_opt_state(opt_state: Any, mesh, bounded_bytes: int | None = None)
     materializes the full unsharded leaf on device before the sharded
     output exists, which at 2×params scale is exactly the HBM spike the
     sharding is meant to avoid — the per-row path bounds the transient to
-    one chunk per device."""
+    one chunk per device.
+
+    On a NESTED ``(pod, ici)`` mesh (ISSUE 15) the shard index is the
+    position on ``ici`` ONLY (``mesh.zero_shard_axis``): each pod holds a
+    full pod-replicated copy of the [ici, chunk] layout, so the per-step
+    param all_gather that reassembles full weights runs entirely within the
+    pod and never crosses the DCN. Optimizer HBM is 2×params/ici per device
+    instead of 2×params/(pods·ici) — the deliberate trade that keeps DCN
+    off the critical path of every step."""
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    data_axis = mesh.axis_names[0]
-    n_shards = mesh.shape[data_axis]
+    from mpi_pytorch_tpu.parallel.mesh import zero_shard_axis
+
+    data_axis, n_shards = zero_shard_axis(mesh)
     rep = NamedSharding(mesh, P())
     row_sharded = NamedSharding(mesh, P(data_axis))
     cap = _BOUNDED_LEAF_BYTES if bounded_bytes is None else bounded_bytes

@@ -43,9 +43,14 @@ from mpi_pytorch_tpu.config import IMAGENET_MEAN, IMAGENET_STD
 from mpi_pytorch_tpu.ops.losses import accuracy_count, classification_loss, valid_count
 from mpi_pytorch_tpu.parallel import collectives
 from mpi_pytorch_tpu.parallel.mesh import (
+    data_axis_names,
+    data_axis_size,
+    is_hierarchical,
     named_shardings,
     param_specs,
+    pod_shape,
     shard_first_divisible,
+    zero_shard_axis,
 )
 from mpi_pytorch_tpu.train.state import TrainState
 
@@ -639,10 +644,25 @@ def bucket_overlap_frac(params, buckets: list[list[int]]) -> float:
     return round(1.0 - bucket_bytes(buckets[-1]) / total, 4)
 
 
+def hier_dcn_overlap_frac(params, buckets: list[list[int]]) -> float:
+    """Static estimate of the cross-pod (DCN) overlap opportunity on a
+    hierarchical bucket plan: the fraction of DCN sync bytes whose
+    cross-pod phase is issued before the FINAL bucket's within-pod phase
+    completes. Each bucket's DCN payload is proportional to its byte size
+    (bucket_bytes / ici per pod pair), so the fraction is structurally the
+    same number as ``bucket_overlap_frac`` — exposed under its own name
+    because the claim it backs is different: DCN latency (the slow link)
+    hides under remaining backward compute + later buckets' ICI phases,
+    which is the whole point of the two-level sync (arXiv 1810.11112)."""
+    return bucket_overlap_frac(params, buckets)
+
+
 def _slice_tree(tree, data_axis: str, n_shards: int):
     """Shard k's OWNED 1/P slice of every leaf (the ``zero_shard_spec``
     flatten-pad partition), taken with one dynamic_slice per leaf at
-    ``lax.axis_index`` — must run inside a shard_map binding ``data_axis``."""
+    ``lax.axis_index`` — must run inside a shard_map binding ``data_axis``.
+    On a nested mesh ``data_axis`` is the ``ici`` axis: the slice index is
+    the within-pod position, identical across pods."""
     idx = lax.axis_index(data_axis)
 
     def slc(x):
@@ -663,6 +683,9 @@ def _bucketed_pmean(grads, buckets, data_axis: str):
     out: list = [None] * len(leaves)
     for bucket in buckets:
         flat = jnp.concatenate([leaves[i].reshape(-1) for i in bucket])
+        collectives._account(
+            "all_reduce", data_axis, flat.size * jnp.dtype(flat.dtype).itemsize
+        )
         mean = lax.pmean(flat, data_axis)
         off = 0
         for i in bucket:
@@ -690,10 +713,74 @@ def _bucketed_reduce_scatter(grads, buckets, data_axis: str, n_shards: int):
             )
             stacked.append(flat.reshape(n_shards, chunk))
         cat = jnp.concatenate(stacked, axis=1)
+        collectives._account(
+            "reduce_scatter", data_axis, cat.size * jnp.dtype(cat.dtype).itemsize
+        )
         sl = (
             lax.psum_scatter(cat, data_axis, scatter_dimension=0, tiled=True)
             / n_shards
         ).reshape(-1)
+        off = 0
+        for i in bucket:
+            chunk = _zero_chunk(leaves[i].size, n_shards)
+            out[i] = sl[off : off + chunk]
+            off += chunk
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _hier_bucketed_mean(grads, buckets, ici_axis: str, pod_axis: str):
+    """The hierarchical twin of ``_bucketed_pmean``: each reverse-topo
+    bucket is ONE three-phase collective — ICI reduce-scatter of the
+    concatenated bucket, DCN psum of the 1/ici slice (the only bytes that
+    leave the pod), ICI all-gather back to full shape. Each bucket's DCN
+    phase depends only on its OWN within-pod result, so the scheduler
+    issues it the moment phase 1 completes — cross-pod latency hides under
+    the remaining backward AND the later buckets' ICI phases."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    out: list = [None] * len(leaves)
+    for bucket in buckets:
+        flat = jnp.concatenate([leaves[i].reshape(-1) for i in bucket])
+        mean = collectives.hier_pmean(flat, ici_axis, pod_axis)
+        off = 0
+        for i in bucket:
+            n = leaves[i].size
+            out[i] = mean[off : off + n].reshape(leaves[i].shape)
+            off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _hier_bucketed_reduce_scatter(
+    grads, buckets, ici_axis: str, pod_axis: str, n_shards: int, n_pods: int
+):
+    """The ZeRO composition on the nested mesh: one ICI ``psum_scatter``
+    per bucket over the ``zero_shard_spec``-stacked leaves (shard i of
+    every pod receives slice i of the POD-LOCAL mean), then one DCN psum of
+    just that slice — cross-pod grad bytes per bucket are
+    ``bucket_bytes / ici``, the ~1/ici_size shrink the byte ledger pins.
+    Returns the tree of ``[chunk]`` GLOBAL-mean gradient slices, identical
+    (up to reduction order) to slicing ``_bucketed_reduce_scatter`` of a
+    flat mesh of the same total size."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    out: list = [None] * len(leaves)
+    for bucket in buckets:
+        stacked = []
+        for i in bucket:
+            chunk = _zero_chunk(leaves[i].size, n_shards)
+            flat = jnp.pad(
+                leaves[i].reshape(-1), (0, chunk * n_shards - leaves[i].size)
+            )
+            stacked.append(flat.reshape(n_shards, chunk))
+        cat = jnp.concatenate(stacked, axis=1)
+        collectives._account(
+            "reduce_scatter", ici_axis, cat.size * jnp.dtype(cat.dtype).itemsize
+        )
+        sl = lax.psum_scatter(
+            cat, ici_axis, scatter_dimension=0, tiled=True
+        ).reshape(-1)
+        collectives._account(
+            "all_reduce", pod_axis, sl.size * jnp.dtype(sl.dtype).itemsize
+        )
+        sl = lax.psum(sl, pod_axis) / (n_shards * n_pods)
         off = 0
         for i in bucket:
             chunk = _zero_chunk(leaves[i].size, n_shards)
@@ -737,21 +824,49 @@ def make_spmd_train_step(
       the buckets become ``reduce_scatter``s: each shard receives only its
       owned slice and grad comms halve.
 
+    On a NESTED ``(pod, ici)`` mesh (``--mesh-pods``, ISSUE 15) the same
+    step becomes the two-level hierarchical sync of ROADMAP item 5: every
+    gradient collective decomposes into an ICI phase (within-pod
+    reduce-scatter) and a DCN phase (cross-pod psum of the 1/ici-sized
+    partial), each bucket's DCN phase issued the moment its ICI phase
+    completes so cross-pod latency hides under remaining backward compute;
+    ZeRO shards place WITHIN the pod (slice index = ici position), so the
+    param all_gather never crosses the DCN. Numerics are parity-pinned
+    against the flat step (tests/test_hierarchical.py).
+
     The self-partitioning Mosaic kernels (``ops/fused_stem.py``,
     ``ops/fused_head_ce.py``, ``ops/fused_attention_small.py``) compose
     with this step without special-casing: their wrappers detect the
     already-bound ``data`` axis (``compat.axis_is_manual``) and run the
     per-shard kernel call directly instead of nesting a second shard_map
     over the same axis."""
-    data_axis = mesh.axis_names[0]
-    n_shards = mesh.shape[data_axis]
+    hier = is_hierarchical(mesh)
+    data_axes = data_axis_names(mesh)
+    # Hierarchical (pods > 1): the data axis is the nested (pod, ici) pair.
+    # Scalar reductions span both axes in one psum; the GRADIENT sync is
+    # explicitly two-phase so the DCN carries only 1/ici of the payload.
+    pod_axis, ici_axis = (data_axes if hier else (None, data_axes[0]))
+    red_axes = data_axes if hier else data_axes[0]
+    n_pods, ici_size = pod_shape(mesh)
+    # The ZeRO partition axis: within-pod (ici) on a nested mesh, so slice
+    # ownership — and the param all_gather — never crosses the DCN.
+    zero_axis, n_shards = zero_shard_axis(mesh)
+    batch_spec = P(data_axes if hier else data_axes[0])
 
     def _forward_backward(state: TrainState, batch):
         images, labels = batch
         images = ingest_images(images, compute_dtype)
-        # Per-shard rng ≙ each MPI rank's independent dropout stream.
+        # Per-shard rng ≙ each MPI rank's independent dropout stream. The
+        # nested index folds pod-major, which equals the flat shard index
+        # for the same device — hierarchical runs draw the identical
+        # per-shard streams a flat run would (parity-pinned).
+        shard_idx = (
+            lax.axis_index(pod_axis) * ici_size + lax.axis_index(ici_axis)
+            if hier
+            else lax.axis_index(ici_axis)
+        )
         rng = jax.random.fold_in(
-            jax.random.fold_in(state.rng, state.step), lax.axis_index(data_axis)
+            jax.random.fold_in(state.rng, state.step), shard_idx
         )
         loss, logits, new_bs, grads = _loss_and_updates(
             state, images, labels, rng, remat=remat
@@ -761,7 +876,11 @@ def make_spmd_train_step(
         # pmean'd so the replicated state stays consistent across shards
         # (the reference instead checkpoints rank 0's stats, main.py:162-171).
         if new_bs is not None:
-            new_bs = collectives.all_reduce(new_bs, "mean", axis=data_axis)
+            new_bs = (
+                collectives.hier_pmean(new_bs, ici_axis, pod_axis)
+                if hier
+                else collectives.all_reduce(new_bs, "mean", axis=ici_axis)
+            )
         return loss, logits, new_bs, grads, labels
 
     def _metrics(loss, logits, labels, grad_norm):
@@ -769,13 +888,15 @@ def make_spmd_train_step(
         # weighted by its valid-row count), so padded tail steps with uneven
         # shard occupancy stay exact — the *gradient* keeps the reference's
         # unweighted per-rank average (mpi_avg_grads divides by world size
-        # regardless of local batch size, mpi_tools.py:36).
+        # regardless of local batch size, mpi_tools.py:36). These are scalar
+        # psums (a few bytes), spanning both nested axes in one collective —
+        # not worth a two-phase decomposition or a ledger entry.
         local_count = valid_count(labels)
-        global_count = lax.psum(local_count, data_axis)
+        global_count = lax.psum(local_count, red_axes)
         return {
-            "loss": lax.psum(loss * local_count.astype(loss.dtype), data_axis)
+            "loss": lax.psum(loss * local_count.astype(loss.dtype), red_axes)
             / jnp.maximum(global_count.astype(loss.dtype), 1),
-            "correct": lax.psum(accuracy_count(logits, labels), data_axis),
+            "correct": lax.psum(accuracy_count(logits, labels), red_axes),
             "count": global_count,
             "grad_norm": grad_norm.astype(jnp.float32),
         }
@@ -785,12 +906,19 @@ def make_spmd_train_step(
         def per_shard(state: TrainState, batch):
             loss, logits, new_bs, grads, labels = _forward_backward(state, batch)
             if grad_bucket_mb > 0:
-                grads = _bucketed_pmean(
-                    grads, grad_bucket_plan(grads, grad_bucket_mb), data_axis
+                plan = grad_bucket_plan(grads, grad_bucket_mb)
+                grads = (
+                    _hier_bucketed_mean(grads, plan, ici_axis, pod_axis)
+                    if hier
+                    else _bucketed_pmean(grads, plan, ici_axis)
                 )
+            elif hier:
+                # Three-phase hierarchical allreduce: the DCN sees 1/ici of
+                # the gradient bytes a flat pmean would push across it.
+                grads = collectives.hier_pmean(grads, ici_axis, pod_axis)
             else:
                 # THE line (≙ the entire mpi_avg_grads stack, mpi_tools.py:30-37):
-                grads = collectives.avg_grads(grads, axis=data_axis)
+                grads = collectives.avg_grads(grads, axis=ici_axis)
             new_state = _apply_updates(state, grads, new_bs)
             # grads were just averaged: every shard computes the identical
             # global-gradient norm, so no further collective is needed.
@@ -807,7 +935,7 @@ def make_spmd_train_step(
         sharded = shard_map(
             per_shard,
             mesh=mesh,
-            in_specs=(P(), (P(data_axis), P(data_axis))),
+            in_specs=(P(), (batch_spec, batch_spec)),
             out_specs=(P(), P()),
             check_vma=False,
         )
@@ -825,23 +953,37 @@ def make_spmd_train_step(
         loss, logits, new_bs, grads, labels = _forward_backward(state, batch)
 
         if grad_bucket_mb > 0:
-            grad_slices = _bucketed_reduce_scatter(
-                grads, grad_bucket_plan(grads, grad_bucket_mb), data_axis, n_shards
+            plan = grad_bucket_plan(grads, grad_bucket_mb)
+            grad_slices = (
+                _hier_bucketed_reduce_scatter(
+                    grads, plan, ici_axis, pod_axis, n_shards, n_pods
+                )
+                if hier
+                else _bucketed_reduce_scatter(grads, plan, ici_axis, n_shards)
+            )
+        elif hier:
+            # Phases 1+2 only: each ici shard keeps its global-mean slice
+            # (pod-replicated) — the slice IS what the sharded optimizer
+            # update consumes, so no gather of gradients ever happens.
+            grad_slices = collectives.hier_reduce_scatter_mean(
+                grads, ici_axis, pod_axis
             )
         else:
-            grads = collectives.avg_grads(grads, axis=data_axis)
-            grad_slices = _slice_tree(grads, data_axis, n_shards)
+            grads = collectives.avg_grads(grads, axis=ici_axis)
+            grad_slices = _slice_tree(grads, ici_axis, n_shards)
         # Global grad norm from the owned slices: the slices tile the mean
         # gradient exactly (padding contributes zeros), so psum of per-slice
         # squared sums is the global squared norm — same number every other
-        # step flavor reports, one scalar collective.
+        # step flavor reports, one scalar collective. Over the ZeRO axis
+        # only: on a nested mesh the slices are pod-replicated, so an
+        # all-axis psum would count each slice pods times.
         sq = sum(
             jnp.sum(jnp.square(g.astype(jnp.float32)))
             for g in jax.tree_util.tree_leaves(grad_slices)
         )
-        grad_norm = jnp.sqrt(lax.psum(sq, data_axis))
+        grad_norm = jnp.sqrt(lax.psum(sq, zero_axis))
 
-        param_slices = _slice_tree(state.params, data_axis, n_shards)
+        param_slices = _slice_tree(state.params, zero_axis, n_shards)
         opt_local = jax.tree_util.tree_unflatten(
             opt_treedef,
             [
@@ -855,8 +997,15 @@ def make_spmd_train_step(
         updates, new_opt = state.tx.update(grad_slices, opt_local, param_slices)
         new_param_slices = optax.apply_updates(param_slices, updates)
         # Reassemble full params for the next forward: ONE tiled allgather
-        # per leaf, then strip the zero_shard_spec padding.
-        gathered = collectives.all_gather(new_param_slices, axis=data_axis)
+        # per leaf, then strip the zero_shard_spec padding. On a nested
+        # mesh this gathers over ``ici`` ONLY — every pod holds the full
+        # slice set, so reassembling params costs zero DCN bytes (the
+        # within-pod ZeRO placement rule).
+        gathered = (
+            collectives.hier_all_gather(new_param_slices, ici_axis)
+            if hier
+            else collectives.all_gather(new_param_slices, axis=ici_axis)
+        )
         new_params = jax.tree_util.tree_map(
             lambda full, orig: full[: orig.size].reshape(orig.shape),
             gathered,
@@ -885,13 +1034,15 @@ def make_spmd_train_step(
 
     def step(state: TrainState, batch):
         flat_opt, opt_treedef = jax.tree_util.tree_flatten(state.opt_state)
+        # Array leaves arrive [n_shards, chunk] sharded over the ZeRO axis
+        # (the ici axis on a nested mesh — pod-replicated by construction).
         opt_specs = tuple(
-            P(data_axis) if getattr(leaf, "ndim", 0) else P() for leaf in flat_opt
+            P(zero_axis) if getattr(leaf, "ndim", 0) else P() for leaf in flat_opt
         )
         core = shard_map(
             functools.partial(per_shard_zero, opt_treedef),
             mesh=mesh,
-            in_specs=(P(), opt_specs, (P(data_axis), P(data_axis))),
+            in_specs=(P(), opt_specs, (batch_spec, batch_spec)),
             out_specs=(P(), opt_specs, P()),
             check_vma=False,
         )

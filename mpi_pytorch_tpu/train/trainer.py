@@ -42,7 +42,17 @@ from mpi_pytorch_tpu.obs import (
     Tracer,
     parse_rules,
 )
-from mpi_pytorch_tpu.parallel.mesh import create_mesh, flat_mesh, shard_batch
+from mpi_pytorch_tpu.parallel.collectives import LEDGER
+from mpi_pytorch_tpu.parallel.mesh import (
+    create_mesh,
+    data_axis_names,
+    data_axis_size,
+    flat_mesh,
+    is_hierarchical,
+    pod_shape,
+    shard_batch,
+    zero_shard_axis,
+)
 from mpi_pytorch_tpu.train import elastic
 from mpi_pytorch_tpu.train.state import (
     TrainState,
@@ -53,6 +63,7 @@ from mpi_pytorch_tpu.train.state import (
 from mpi_pytorch_tpu.train.step import (
     bucket_overlap_frac,
     grad_bucket_plan,
+    hier_dcn_overlap_frac,
     make_cached_eval_step,
     make_cached_train_step,
     make_eval_step,
@@ -172,11 +183,12 @@ def build_training(cfg: Config, mesh=None):
         raise ValueError(
             f"global batch {cfg.batch_size} not divisible by {jax.process_count()} hosts"
         )
-    data_size = mesh.shape[cfg.mesh.data_axis]
+    data_size = data_axis_size(mesh)
     if cfg.batch_size % data_size != 0:
         raise ValueError(
             f"global batch {cfg.batch_size} not divisible by data-parallel size "
-            f"{data_size}; sharding the batch over the '{cfg.mesh.data_axis}' axis "
+            f"{data_size}; sharding the batch over the "
+            f"'{'×'.join(data_axis_names(mesh))}' ax{'es' if is_hierarchical(mesh) else 'is'} "
             "requires even division"
         )
     host_batch = cfg.batch_size // jax.process_count()
@@ -217,7 +229,12 @@ def build_training(cfg: Config, mesh=None):
         # Sync-BN: in spmd mode the axis name must be bound inside shard_map;
         # in auto mode BN already normalizes over the logical global batch
         # (the compiler inserts the cross-device mean), so no axis is needed.
-        bn_axis_name=mesh.axis_names[0] if (cfg.sync_batchnorm and cfg.spmd_mode) else None,
+        # Nested meshes sync over both data factors (flax forwards the
+        # tuple to lax.pmean unchanged).
+        bn_axis_name=(
+            (data_axis_names(mesh) if is_hierarchical(mesh) else mesh.axis_names[0])
+            if (cfg.sync_batchnorm and cfg.spmd_mode) else None
+        ),
         pretrained_dir=cfg.pretrained_dir,
         remat_blocks=(cfg.remat == "blocks"),
         sp_strategy=cfg.sp_strategy,
@@ -796,8 +813,11 @@ def _train_impl(
     resumed = False
     resume_manifest = None
     resume_was_dirty = False
+    # ZeRO shard count: the WITHIN-POD (ici) size on a nested mesh — shards
+    # place inside a pod so the param all_gather never crosses the DCN
+    # (train/state.py zero_shard_opt_state); the whole data axis when flat.
     zero_shards_to = (
-        mesh.shape[cfg.mesh.data_axis] if (cfg.spmd_mode and cfg.zero_opt_state) else 0
+        zero_shard_axis(mesh)[1] if (cfg.spmd_mode and cfg.zero_opt_state) else 0
     )
     if cfg.from_checkpoint:
         # Elastic restore (train/elastic.py): newest LOADABLE checkpoint
@@ -860,7 +880,7 @@ def _train_impl(
     if cfg.spmd_mode and cfg.zero_opt_state:
         opt_template = jax.eval_shape(state.tx.init, state.params)
         state = state.replace(opt_state=zero_shard_opt_state(state.opt_state, mesh))
-        n_data = mesh.shape[cfg.mesh.data_axis]
+        zero_axis_name, n_zero = zero_shard_axis(mesh)
         moment_bytes = sum(
             s.data.nbytes
             for leaf in jax.tree_util.tree_leaves(state.opt_state)
@@ -868,9 +888,12 @@ def _train_impl(
             for s in leaf.addressable_shards[:1]
         )
         logger.info(
-            "ZeRO opt-state sharding: moments partitioned 1/%d over '%s' "
+            "ZeRO opt-state sharding: moments partitioned 1/%d over '%s'%s "
             "(%.1f MB/device)",
-            n_data, cfg.mesh.data_axis, moment_bytes / 1e6,
+            n_zero, zero_axis_name,
+            " (within-pod: the param all_gather never crosses the DCN)"
+            if is_hierarchical(mesh) else "",
+            moment_bytes / 1e6,
         )
 
     def _saveable(st: TrainState) -> TrainState:
@@ -1023,7 +1046,33 @@ def _train_impl(
         finally:
             tracer.end(span)
 
+    # Per-axis collective-traffic ledger (ISSUE 15): bytes are booked at
+    # TRACE time (shapes are static), so one reset + one lower = exactly
+    # one step's ICI-vs-DCN traffic, attributable per collective op.
+    LEDGER.reset()
     compiled_step, flops_per_step = build_compiled(state)
+    traffic = LEDGER.snapshot() if cfg.spmd_mode else None
+    if traffic is not None and (traffic["ici"]["ops"] or traffic["dcn"]["ops"]):
+        tracer.instant(
+            "collective_traffic",
+            args={
+                "ici_bytes_per_step": traffic["ici"]["bytes"],
+                "dcn_bytes_per_step": traffic["dcn"]["bytes"],
+                "dcn_by_op": traffic["dcn"]["by_op"],
+            },
+        )
+        if registry is not None:
+            registry.gauge("train/ici_bytes_per_step").set(traffic["ici"]["bytes"])
+            registry.gauge("train/dcn_bytes_per_step").set(traffic["dcn"]["bytes"])
+        if is_hierarchical(mesh):
+            pods, ici = pod_shape(mesh)
+            logger.info(
+                "hierarchical sync (%d pod(s) × %d ici): %.2f MB/step ICI, "
+                "%.3f MB/step DCN per device (cross-pod payload 1/%d of the "
+                "gradient)",
+                pods, ici, traffic["ici"]["bytes"] / 1e6,
+                traffic["dcn"]["bytes"] / 1e6, ici,
+            )
 
     # Exact-step resume (ISSUE 10): validate the restored checkpoint's data
     # cursor against THIS run's walk. A match fast-forwards the first
@@ -1063,31 +1112,53 @@ def _train_impl(
     # and the static overlap_frac estimate stamped onto every step health
     # record — the plan the chip A/B (tools/bench_modes.py --levers)
     # measures against.
+    _hier = is_hierarchical(mesh)
     if cfg.spmd_mode and cfg.grad_sync_buckets > 0:
         _plan = grad_bucket_plan(state.params, cfg.grad_sync_buckets)
         _overlap = bucket_overlap_frac(state.params, _plan)
+        _dcn_overlap = hier_dcn_overlap_frac(state.params, _plan) if _hier else None
         _leaves = jax.tree_util.tree_leaves(state.params)
+        _, _ici_size = pod_shape(mesh)
         for _order, _bucket in enumerate(_plan):
+            _bytes = int(
+                sum(_leaves[i].size * _leaves[i].dtype.itemsize for i in _bucket)
+            )
             tracer.instant(
                 "grad_bucket",
-                args={
-                    "order": _order,
-                    "leaves": len(_bucket),
-                    "bytes": int(
-                        sum(_leaves[i].size * _leaves[i].dtype.itemsize
-                            for i in _bucket)
-                    ),
-                },
+                args={"order": _order, "leaves": len(_bucket), "bytes": _bytes},
             )
-        health.set_sync(overlap_frac=_overlap)
+            if _hier:
+                # The bucket's CROSS-POD phase: issued the moment its
+                # within-pod reduce-scatter lands, carrying 1/ici of the
+                # bucket's bytes over the DCN — one instant per bucket so
+                # a chip trace can line the phases up against backward.
+                tracer.instant(
+                    "dcn",
+                    args={
+                        "order": _order,
+                        "bytes": _bytes // _ici_size,
+                        "of_bucket_bytes": _bytes,
+                    },
+                )
+        health.set_sync(overlap_frac=_overlap, dcn_overlap_frac=_dcn_overlap)
         if registry is not None:
             registry.gauge("train/overlap_frac").set(_overlap)
+            if _dcn_overlap is not None:
+                registry.gauge("train/dcn_overlap_frac").set(_dcn_overlap)
         logger.info(
             "grad-sync buckets: %d × ~%.0f MiB (reverse-topo issue order), "
-            "%.0f%% of sync bytes overlap-eligible%s",
+            "%.0f%% of sync bytes overlap-eligible%s%s",
             len(_plan), cfg.grad_sync_buckets, 100.0 * _overlap,
             ", reduce-scatter (ZeRO slices)" if cfg.zero_opt_state else "",
+            ", two-phase ICI/DCN (per-bucket cross-pod stage overlapped)"
+            if _hier else "",
         )
+    elif cfg.spmd_mode and _hier:
+        # Hierarchical without buckets: the whole-tree sync is still
+        # two-phase (DCN carries 1/ici of the payload), but its cross-pod
+        # stage waits for the full backward — nothing to overlap, which
+        # the stamped 0.0 makes visible rather than implicit.
+        health.set_sync(dcn_overlap_frac=0.0)
     peak = hw.peak_bf16_tflops(jax.devices()[0])
     if heartbeat.enabled and heartbeat.every > n_steps:
         # Beats never span epoch boundaries (the window resets per epoch),
@@ -1135,9 +1206,10 @@ def _train_impl(
     if faults.active:
         logger.warning(
             "fault injection armed: kill_at_step=%d delay_step_ms=%d "
-            "nonfinite_at_step=%d preempt_at_step=%d (MPT_FAULT_* gates)",
-            faults.kill_at_step, faults.delay_ms, faults.nonfinite_at_step,
-            faults.preempt_at_step,
+            "dcn_delay_ms=%d nonfinite_at_step=%d preempt_at_step=%d "
+            "(MPT_FAULT_* gates)",
+            faults.kill_at_step, faults.delay_ms, faults.dcn_delay_ms,
+            faults.nonfinite_at_step, faults.preempt_at_step,
         )
     if faults.nonfinite_at_step and (
         cfg.device_cache or loader.image_dtype == np.dtype(np.uint8)
@@ -1146,6 +1218,11 @@ def _train_impl(
             "MPT_FAULT_NONFINITE_AT_STEP has no effect on this run: the "
             "gate NaN-poisons streaming float batches, and this run feeds "
             "%s", "device-cache indices" if cfg.device_cache else "uint8 pixels",
+        )
+    if faults.dcn_delay_ms and not _hier:
+        logger.warning(
+            "MPT_FAULT_DCN_DELAY_MS has no effect on this run: a flat mesh "
+            "has no cross-pod phase to slow down (set --mesh-pods > 1)"
         )
     # The watchdog unifies every stop signal behind one poll: the guard's
     # SIGTERM flag, the MPT_PREEMPT_FILE sentinel, repeated health signals
@@ -1478,6 +1555,10 @@ def _train_impl(
                     # Inside the timed region so a faked straggler delay
                     # lands in the step time the heartbeat exchanges.
                     faults.maybe_delay()
+                    # Slow-DCN-link fake (ISSUE 15): stretches only
+                    # hierarchical steps — a flat mesh has no cross-pod
+                    # phase to slow down.
+                    faults.maybe_dcn_delay(_hier)
                 step_s = time.perf_counter() - t_step
                 was_skipped = None
                 if bad_step_skip:

@@ -73,6 +73,15 @@ FAULT_GATES: dict[str, str] = {
         "mid-run, so warmup-baseline SLO rules (drift:) have a clean "
         "baseline to drift from"
     ),
+    "MPT_FAULT_DCN_DELAY_MS": (
+        "fake a slow DCN link: add this many ms to every train step's "
+        "CROSS-POD phase — the gate bites ONLY on hierarchical "
+        "(--mesh-pods > 1) runs, because only those have a DCN phase to "
+        "slow down; a flat-mesh run under the same gate is unaffected, "
+        "which is exactly the testable overlap contract (host-side "
+        "stand-in applied inside the timed step region, so heartbeats and "
+        "step records attribute the latency to the step it stretched)"
+    ),
     "MPT_FAULT_BACKEND_WEDGE_N": (
         "make the first N create_mesh calls in this process raise — the "
         "wedged-backend-init scenario the resume-side retry loop absorbs"

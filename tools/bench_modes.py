@@ -23,6 +23,13 @@ Lever rows add per-chip HBM high-water, optimizer-state MB/chip, MFU, the
 static overlap_frac of the bucket plan, and compiles_after_warmup (must be
 0 — the zero-steady-state-compile invariant, re-checked per row).
 
+``--mesh-pods P`` (ISSUE 15 / ROADMAP item 5) runs the spmd lever cells on
+the NESTED (pod, ici) mesh — the two-level ICI/DCN hierarchical sync —
+keyed ``mode-pP-bN`` with the per-axis byte-ledger columns
+(``ici_bytes_per_step`` / ``dcn_bytes_per_step``), ``dcn_overlap_frac``,
+and a ``mesh`` topology stamp ("p2xi4") the regression gate keys into the
+training trend-line identity (tools/check_regression.py).
+
 Streaming modes re-shard a fresh host batch EVERY step (device_put inside
 the timed loop), so they carry the real H2D cost the dtype modes differ by;
 the cached modes send only [B] int32 indices (and the scan, one dispatch per
@@ -52,18 +59,20 @@ MODEL, NUM_CLASSES, IMAGE = "resnet18", 64500, 128
 CACHE_ROWS = 8192  # HBM-resident rows for the cached modes (~400 MB f32)
 
 
-def _setup():
+def _setup(pods: int = 1):
     """Identical model/state for every mode — the dtype distinction lives
-    entirely in the host batch (`_host_batch`) and the ingest cast."""
+    entirely in the host batch (`_host_batch`) and the ingest cast.
+    ``pods > 1`` nests the data axis (``--mesh-pods``, ISSUE 15) for the
+    hierarchical lever cells."""
     import optax  # noqa: F401  (state factory pulls it in)
 
-    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.config import MeshConfig
     from mpi_pytorch_tpu.models import create_model_bundle
     from mpi_pytorch_tpu.parallel.mesh import create_mesh
     from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
     from mpi_pytorch_tpu.train.step import place_state_on_mesh
 
-    mesh = create_mesh(Config().mesh)
+    mesh = create_mesh(MeshConfig(pods=pods))
     bundle, variables = create_model_bundle(
         MODEL, NUM_CLASSES, rng=jax.random.PRNGKey(0), image_size=IMAGE,
         dtype=jnp.bfloat16, param_dtype=jnp.float32,
@@ -123,24 +132,34 @@ def _hbm_high_water():
     return None
 
 
-def bench_spmd(zero: bool, bucket_mb: float, batch_per_chip: int, steps: int, warmup: int):
+def bench_spmd(
+    zero: bool, bucket_mb: float, batch_per_chip: int, steps: int, warmup: int,
+    pods: int = 1,
+):
     """One training-half-lever cell: the spmd shard_map step with ZeRO
     opt-state sharding and/or bucketed grad sync. Same timing discipline as
     the streaming modes (fresh device_put per step), plus the lever
     telemetry columns: optimizer-state MB actually resident per chip, the
     bucket plan's static overlap_frac, HBM high-water, and a
-    compiles-after-warmup recheck of the zero-steady-state invariant."""
+    compiles-after-warmup recheck of the zero-steady-state invariant.
+
+    ``pods > 1`` (the ``--mesh-pods`` hierarchical cells, ISSUE 15): the
+    same levers on the nested (pod, ici) mesh, with the per-axis byte
+    ledger's ICI/DCN traffic and the DCN overlap estimate on the row —
+    the columns a chip A/B of the two-level sync is judged by."""
     from mpi_pytorch_tpu.obs.health import compile_count, ensure_compile_listener
-    from mpi_pytorch_tpu.parallel.mesh import shard_batch
+    from mpi_pytorch_tpu.parallel.collectives import LEDGER
+    from mpi_pytorch_tpu.parallel.mesh import is_hierarchical, pod_shape, shard_batch
     from mpi_pytorch_tpu.train.state import zero_shard_opt_state
     from mpi_pytorch_tpu.train.step import (
         bucket_overlap_frac,
         grad_bucket_plan,
+        hier_dcn_overlap_frac,
         make_spmd_train_step,
     )
     from mpi_pytorch_tpu.utils.hardware import peak_bf16_tflops, step_flops
 
-    mesh, state = _setup()
+    mesh, state = _setup(pods)
     if zero:
         state = state.replace(opt_state=zero_shard_opt_state(state.opt_state, mesh))
     opt_bytes_per_chip = sum(
@@ -154,7 +173,11 @@ def bench_spmd(zero: bool, bucket_mb: float, batch_per_chip: int, steps: int, wa
     step = make_spmd_train_step(
         mesh, jnp.bfloat16, zero_opt_state=zero, grad_bucket_mb=bucket_mb
     )
+    # Per-axis traffic is booked at trace time: reset + one lower = one
+    # step's ICI-vs-DCN bytes (parallel/collectives.LEDGER).
+    LEDGER.reset()
     compiled = step.lower(state, shard_batch((images, labels), mesh)).compile()
+    traffic = LEDGER.snapshot()
     flops = step_flops(compiled)
 
     ensure_compile_listener()
@@ -175,11 +198,18 @@ def bench_spmd(zero: bool, bucket_mb: float, batch_per_chip: int, steps: int, wa
         "opt_state_mb_per_chip": round(opt_bytes_per_chip / 1e6, 1),
         "hbm_high_water_mb": round(high_water / 1e6, 1) if high_water else None,
         "compiles_after_warmup": compile_count() - base_compiles,
+        "ici_bytes_per_step": traffic["ici"]["bytes"],
+        "dcn_bytes_per_step": traffic["dcn"]["bytes"],
     }
+    if is_hierarchical(mesh):
+        n_pods, ici = pod_shape(mesh)
+        extra["mesh"] = f"p{n_pods}xi{ici}"
     if bucket_mb > 0:
         plan = grad_bucket_plan(state.params, bucket_mb)
         extra["buckets"] = len(plan)
         extra["overlap_frac"] = bucket_overlap_frac(state.params, plan)
+        if is_hierarchical(mesh):
+            extra["dcn_overlap_frac"] = hier_dcn_overlap_frac(state.params, plan)
     peak = peak_bf16_tflops(jax.devices()[0])
     if peak and flops > 0:
         extra["mfu_pct"] = round(100.0 * flops * steps / dt / 1e12 / peak, 1)
@@ -238,17 +268,23 @@ def bench_cached(scan: bool, batch_per_chip: int, steps: int, warmup: int):
 
 
 MODES = {
-    "stream-f32": lambda b, s, w, mb: bench_streaming("float32", b, s, w),
-    "stream-bf16": lambda b, s, w, mb: bench_streaming("bfloat16", b, s, w),
-    "stream-uint8": lambda b, s, w, mb: bench_streaming("uint8", b, s, w),
-    "cached": lambda b, s, w, mb: bench_cached(False, b, s, w),
-    "cached-scan": lambda b, s, w, mb: bench_cached(True, b, s, w),
-    # Training-half levers (spmd shard_map step; ROADMAP item 2):
-    "spmd-base": lambda b, s, w, mb: bench_spmd(False, 0.0, b, s, w),
-    "spmd-zero": lambda b, s, w, mb: bench_spmd(True, 0.0, b, s, w),
-    "spmd-buckets": lambda b, s, w, mb: bench_spmd(False, mb, b, s, w),
-    "spmd-zero-buckets": lambda b, s, w, mb: bench_spmd(True, mb, b, s, w),
+    "stream-f32": lambda b, s, w, mb, p: bench_streaming("float32", b, s, w),
+    "stream-bf16": lambda b, s, w, mb, p: bench_streaming("bfloat16", b, s, w),
+    "stream-uint8": lambda b, s, w, mb, p: bench_streaming("uint8", b, s, w),
+    "cached": lambda b, s, w, mb, p: bench_cached(False, b, s, w),
+    "cached-scan": lambda b, s, w, mb, p: bench_cached(True, b, s, w),
+    # Training-half levers (spmd shard_map step; ROADMAP items 2 + 5 —
+    # --mesh-pods > 1 runs the same levers hierarchically):
+    "spmd-base": lambda b, s, w, mb, p: bench_spmd(False, 0.0, b, s, w, p),
+    "spmd-zero": lambda b, s, w, mb, p: bench_spmd(True, 0.0, b, s, w, p),
+    "spmd-buckets": lambda b, s, w, mb, p: bench_spmd(False, mb, b, s, w, p),
+    "spmd-zero-buckets": lambda b, s, w, mb, p: bench_spmd(True, mb, b, s, w, p),
 }
+
+# Modes the --mesh-pods axis applies to (the hierarchical cells are
+# spmd-lever cells; the ingest modes run the auto-jit step, which a nested
+# mesh cannot change).
+POD_MODES = ("spmd-base", "spmd-zero", "spmd-buckets", "spmd-zero-buckets")
 
 LEVER_MODES = "spmd-base,spmd-zero,spmd-buckets,spmd-zero-buckets"
 # The documented default run stays the five INGEST modes — the lever cells
@@ -271,6 +307,12 @@ def main() -> None:
         "--bucket-mb", type=float, default=25.0,
         help="grad-sync bucket size (MiB) for the spmd-*buckets modes",
     )
+    ap.add_argument(
+        "--mesh-pods", type=int, default=1,
+        help="factor the data axis into this many nested pods for the "
+             "spmd lever cells (hierarchical ICI/DCN sync, ISSUE 15); "
+             "cells key mode-p<P>-b<batch> and rows carry per-axis bytes",
+    )
     ap.add_argument("--out", default="")
     ap.add_argument(
         "--partial-out", default="",
@@ -290,7 +332,13 @@ def main() -> None:
     done = load_partial(args.resume_from)
     records = []
     for mode in (m.strip() for m in args.modes.split(",") if m.strip()):
-        cell = f"{mode}-b{args.batch}"
+        pods = args.mesh_pods if mode in POD_MODES else 1
+        # Hierarchical cells key their pod factoring (mode-pP-bN) so a
+        # partial-file resume — and the trend-line identity downstream —
+        # never conflates them with flat cells of the same mode.
+        cell = (
+            f"{mode}-p{pods}-b{args.batch}" if pods > 1 else f"{mode}-b{args.batch}"
+        )
         if cell in done:
             rec = done[cell]
             records.append(rec)
@@ -298,7 +346,7 @@ def main() -> None:
             continue
         try:
             dt, images, n_chips, extra = MODES[mode](
-                args.batch, args.steps, args.warmup, args.bucket_mb
+                args.batch, args.steps, args.warmup, args.bucket_mb, pods
             )
             rec = {
                 "mode": mode,

@@ -57,15 +57,16 @@ ZOO = {
 def build_state_and_batch(
     model_name: str, batch_per_chip: int, image: int, optimizer: bool = True,
     remat_blocks: bool = False, attn_impl: str = "full", stem_s2d: bool = False,
-    fused_stem: bool | None = None, qkv_fused: bool = False,
+    fused_stem: bool | None = None, qkv_fused: bool = False, mesh_pods: int = 1,
 ):
     """Shared harness setup (also used by tools/bench_eval.py and
     tools/profile_step.py): mesh, placed train state, and a random sharded
     device batch. ``optimizer=False`` skips the Adam moment trees (~2x params
-    of f32 HBM) for forward-only benches."""
+    of f32 HBM) for forward-only benches. ``mesh_pods > 1`` nests the data
+    axis (pod, ici) for the hierarchical-sync profiles (ISSUE 15)."""
     import optax
 
-    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.config import MeshConfig
     from mpi_pytorch_tpu.models import create_model_bundle
     from mpi_pytorch_tpu.parallel.mesh import create_mesh, shard_batch
     from mpi_pytorch_tpu.train.state import TrainState, make_optimizer
@@ -73,7 +74,7 @@ def build_state_and_batch(
 
     n_chips = jax.device_count()
     batch = batch_per_chip * n_chips
-    mesh = create_mesh(Config().mesh)
+    mesh = create_mesh(MeshConfig(pods=mesh_pods))
     if fused_stem is None:
         # Same contract as bench.py: the fused stem is the headline resnet
         # configuration on TPU; MPT_FUSED_STEM=0 reverts for A/B.

@@ -11,8 +11,10 @@ This gate fails (exit 1) when the NEWEST comparable cell regressed more
 than ``--tolerance-pct`` against its predecessor:
 
 - train: ``value`` (img/s) dropped — compared only between rounds whose
-  ``metric`` string is IDENTICAL (the config is baked into the string, so
-  a batch-size change is a new trend line, not a regression);
+  ``metric`` string AND mesh topology (``parsed["mesh"]``, the pods×ici
+  factoring of hierarchical rounds — ISSUE 15) are IDENTICAL (the config
+  is baked into the string, so a batch-size change — or a flat↔nested
+  mesh change — is a new trend line, not a regression);
 - serve: ``p99_ms`` rose or ``images_per_sec`` dropped for the same sweep
   point (mode × buckets × max_wait × offered_rps × model), compared
   against a committed baseline snapshot (``--serve-baseline``).
@@ -50,10 +52,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _ROUND = re.compile(r"BENCH_r(\d+)\.json$")
 
 
-def bench_cells(root: str) -> list[tuple[int, str, float]]:
-    """Comparable (round, metric, value) cells from ``BENCH_r*.json``,
+def bench_cells(root: str) -> list[tuple[int, str, str | None, float]]:
+    """Comparable (round, metric, mesh, value) cells from ``BENCH_r*.json``,
     round-ordered; rounds with rc != 0 or null parsed/value are dropped
-    (a wedged backend is a lost round, not a zero)."""
+    (a wedged backend is a lost round, not a zero). ``mesh`` is the
+    training mesh topology stamped by hierarchical rounds
+    (``parsed["mesh"]``, e.g. ``"p2xi4"`` for 2 pods × 4 ici — the
+    ``tools/bench_modes.py`` cell convention); flat/legacy rounds carry
+    None, so prior history keys exactly as before."""
     cells = []
     for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
         m = _ROUND.search(os.path.basename(path))
@@ -71,26 +77,33 @@ def bench_cells(root: str) -> list[tuple[int, str, float]]:
         metric, value = parsed.get("metric"), parsed.get("value")
         if not isinstance(metric, str) or not isinstance(value, (int, float)):
             continue
-        cells.append((int(m.group(1)), metric, float(value)))
-    return sorted(cells)
+        mesh = parsed.get("mesh")
+        if not isinstance(mesh, str):
+            mesh = None
+        cells.append((int(m.group(1)), metric, mesh, float(value)))
+    return sorted(cells, key=lambda c: (c[0], c[1], c[2] or ""))
 
 
 def check_bench(root: str, tol_pct: float) -> list[str]:
-    """NEWEST-vs-predecessor comparison per metric string — only the last
-    pair of each trend line is judged: the gate protects the current PR's
-    claim, and a historical dip that later recovered must not fail CI
-    forever (the history is immutable)."""
+    """NEWEST-vs-predecessor comparison per (metric, mesh-topology) trend
+    line — only the last pair of each line is judged: the gate protects
+    the current PR's claim, and a historical dip that later recovered must
+    not fail CI forever (the history is immutable). Mesh topology
+    (pods×ici, ISSUE 15) is part of the identity: a hierarchical cell pays
+    a DCN hop per step by construction, so it must never be read as a
+    regression of — or an alibi for — the flat-mesh trend line."""
     violations = []
-    by_metric: dict[str, list[tuple[int, float]]] = {}
-    for rnd, metric, value in bench_cells(root):
-        by_metric.setdefault(metric, []).append((rnd, value))
-    for metric, cells in by_metric.items():
+    by_metric: dict[tuple, list[tuple[int, float]]] = {}
+    for rnd, metric, mesh, value in bench_cells(root):
+        by_metric.setdefault((metric, mesh), []).append((rnd, value))
+    for (metric, mesh), cells in by_metric.items():
         if len(cells) < 2:
             continue
         (prev_rnd, prev), (rnd, value) = cells[-2], cells[-1]
         if value < prev * (1 - tol_pct / 100.0):
+            line = metric if mesh is None else f"{metric} [mesh {mesh}]"
             violations.append(
-                f"BENCH r{rnd:02d}: {metric!r} regressed "
+                f"BENCH r{rnd:02d}: {line!r} regressed "
                 f"{value:,.1f} vs r{prev_rnd:02d}'s {prev:,.1f} "
                 f"(-{100.0 * (1 - value / prev):.1f}% > {tol_pct}% tolerance)"
             )

@@ -55,6 +55,12 @@ def main() -> None:
                     help="spmd: ZeRO-shard the optimizer state over the data axis")
     ap.add_argument("--grad-sync-buckets", type=float, default=0.0, metavar="MB",
                     help="spmd: bucketed grad-sync collectives (MiB per bucket)")
+    ap.add_argument("--mesh-pods", type=int, default=1,
+                    help="spmd: nest the data axis into this many pods — the "
+                         "two-level ICI/DCN hierarchical sync (ISSUE 15); the "
+                         "summary gains per-axis bytes + dcn_overlap_frac, and "
+                         "with --trace-dir the XLA trace shows whether each "
+                         "bucket's cross-pod phase hides under the backward")
     args = ap.parse_args()
 
     from mpi_pytorch_tpu.models.registry import supports_remat_blocks
@@ -70,13 +76,18 @@ def main() -> None:
         ap.error(f"--remat blocks not implemented for {args.model}")
     if (args.zero_opt_state or args.grad_sync_buckets) and not args.spmd:
         ap.error("--zero-opt-state / --grad-sync-buckets are spmd-step levers; add --spmd")
+    if args.mesh_pods > 1 and not args.spmd:
+        ap.error("--mesh-pods nests the spmd step's data axis; add --spmd")
     if args.spmd and args.accum > 1:
         ap.error("--accum applies to the auto-jit step only")
 
     mesh, state, device_batch, n_chips, batch = build_state_and_batch(
-        args.model, args.batch, args.image, remat_blocks=(args.remat == "blocks")
+        args.model, args.batch, args.image, remat_blocks=(args.remat == "blocks"),
+        mesh_pods=args.mesh_pods,
     )
     lever_info = {}
+    if args.mesh_pods > 1:
+        lever_info["mesh"] = f"p{args.mesh_pods}xi{jax.device_count() // args.mesh_pods}"
     if args.spmd:
         if args.zero_opt_state:
             from mpi_pytorch_tpu.train.state import zero_shard_opt_state
@@ -95,6 +106,12 @@ def main() -> None:
             plan = grad_bucket_plan(state.params, args.grad_sync_buckets)
             lever_info["buckets"] = len(plan)
             lever_info["overlap_frac"] = bucket_overlap_frac(state.params, plan)
+            if args.mesh_pods > 1:
+                from mpi_pytorch_tpu.train.step import hier_dcn_overlap_frac
+
+                lever_info["dcn_overlap_frac"] = hier_dcn_overlap_frac(
+                    state.params, plan
+                )
         step = make_spmd_train_step(
             mesh, jnp.bfloat16, remat=(args.remat == "full"),
             zero_opt_state=args.zero_opt_state,
@@ -104,7 +121,14 @@ def main() -> None:
         step = make_train_step(
             jnp.bfloat16, remat=(args.remat == "full"), accum_steps=args.accum, mesh=mesh
         )
+    from mpi_pytorch_tpu.parallel.collectives import LEDGER
+
+    LEDGER.reset()  # trace-time per-axis byte accounting (one lower = one step)
     compiled = step.lower(state, device_batch).compile()
+    if args.spmd:
+        traffic = LEDGER.snapshot()
+        lever_info["ici_bytes_per_step"] = traffic["ici"]["bytes"]
+        lever_info["dcn_bytes_per_step"] = traffic["dcn"]["bytes"]
     mem = compiled.memory_analysis()
     flops = step_flops(compiled)
 

@@ -160,6 +160,10 @@ def summarize(records: list[dict]) -> dict:
         overlaps = _finite([s.get("overlap_frac") for s in steps])
         if overlaps:
             stat["overlap_frac"] = round(_mean(overlaps), 4)
+        # Schema-v11 hierarchical (--mesh-pods) runs: the cross-pod twin.
+        dcn_overlaps = _finite([s.get("dcn_overlap_frac") for s in steps])
+        if dcn_overlaps:
+            stat["dcn_overlap_frac"] = round(_mean(dcn_overlaps), 4)
         if norms:
             stat["grad_norm"] = {
                 "first": round(norms[0], 4), "last": round(norms[-1], 4),
@@ -436,6 +440,12 @@ def render(path: str, records: list[dict], summary: dict) -> str:
             out.append(
                 f"  grad-sync overlap-eligible: {100.0 * ss['overlap_frac']:.1f}%"
                 " of sync bytes (static bucket-plan estimate)"
+            )
+        if "dcn_overlap_frac" in ss:
+            out.append(
+                f"  cross-pod (DCN) overlap-eligible: "
+                f"{100.0 * ss['dcn_overlap_frac']:.1f}% of cross-pod sync "
+                "bytes (hierarchical --mesh-pods plan)"
             )
         if "wait_fraction_pct" in ss:
             out.append(
