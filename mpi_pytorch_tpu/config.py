@@ -412,6 +412,29 @@ class Config:
     serve_connect_timeout_s: float = 2.0
     serve_read_timeout_s: float = 30.0
     serve_probe_retries: int = 2
+
+    # --- tail-at-scale data plane (serve/wire.py, serve/client.py —
+    # ISSUE 16) ---
+    # Fleet data-plane transport: "http" = the .npy-over-HTTP legacy path
+    # (now with per-host keep-alive connection reuse); "framed" = the
+    # length-prefixed binary MPTW wire — persistent pooled connections,
+    # pipelining, out-of-order completion by req_id, no JSON/base64 on
+    # the hot path. Control/probe traffic (healthz, statsz, control ops)
+    # stays on HTTP either way; only submit/result moves. Flows to
+    # spawned host processes, which bind a WireListener next to the HTTP
+    # surface and advertise it as wire_port in the readiness file.
+    serve_transport: str = "http"
+    # Hedged requests (the 1810.11112 tail-tolerance move): when a
+    # dispatched request outlives a deadline derived from the TARGET
+    # host's live p99 (p99 × serve_hedge_factor, floor-clamped), the
+    # router re-issues it to the second-best host; the claim ledger
+    # resolves duplicate completions first-wins exactly-once and the
+    # loser is revoked with a CANCEL frame so it never occupies a batch
+    # slot after the winner lands. Needs >= 2 fleet hosts to ever have a
+    # second-best host.
+    serve_hedge: bool = False
+    serve_hedge_factor: float = 3.0
+    serve_hedge_floor_ms: float = 20.0
     # True starts the FleetAutoscaler: grow/shrink the host set from
     # registry metrics (admission-reject rate, p99 vs --serve-target-p99-ms,
     # queue-depth trend), bounded by the min/max host counts and the
@@ -876,6 +899,39 @@ class Config:
             raise ValueError(
                 f"serve_probe_retries must be >= 0 (0 = single attempt), "
                 f"got {self.serve_probe_retries}"
+            )
+        # --- tail-at-scale data plane (ISSUE 16) ---
+        if self.serve_transport not in ("http", "framed"):
+            raise ValueError(
+                f"serve_transport must be http|framed, "
+                f"got {self.serve_transport!r}"
+            )
+        if self.serve_hedge_factor <= 1.0:
+            raise ValueError(
+                "serve_hedge_factor must be > 1.0 (a hedge at or below "
+                f"p99 duplicates the median request), "
+                f"got {self.serve_hedge_factor}"
+            )
+        if self.serve_hedge_floor_ms <= 0:
+            raise ValueError(
+                f"serve_hedge_floor_ms must be > 0, "
+                f"got {self.serve_hedge_floor_ms}"
+            )
+        if not self.serve_hedge:
+            # The silently-ignored rule: the hedge policy knobs are only
+            # read by the router's hedge timer.
+            if (self.serve_hedge_factor != 3.0
+                    or self.serve_hedge_floor_ms != 20.0):
+                raise ValueError(
+                    "serve_hedge_factor/serve_hedge_floor_ms configure "
+                    "request hedging and need --serve-hedge true (without "
+                    "it they would be silently ignored)"
+                )
+        elif self.serve_fleet_hosts < 2:
+            raise ValueError(
+                "serve_hedge needs >= 2 fleet hosts (--serve-fleet-hosts) "
+                "— with one host there is never a second-best host to "
+                "hedge to, and the knob would be silently ignored"
             )
         if not self.serve_autoscale:
             # The silently-ignored rule again: the scaler bounds are only

@@ -30,6 +30,7 @@ Record kinds (every record also carries ``ts``, the epoch-seconds stamp
 | route     | host, requests                                      | share, score, queue_depth, inflight, window_s, transport, trace_ids, models |
 | fleet     | event                                               | host, detail, redispatched, spare, max_wait_ms_from/to, buckets_from/to, p99_ms, target_p99_ms, compiles_after_warmup, hosts_from/to, reason, reject_rate, queue_depth, restarts, transport, model, resident, plan |
 | timeline  | host, metric, points                                | window_s, clock_offset_ms, resets |
+| hedge     | winner, loser                                       | cancelled, deadline_ms, trace_id |
 
 ``serve`` is the per-flush record the online inference server writes
 (serve/server.py: one coalesced batch dispatched to a bucket executable);
@@ -148,7 +149,20 @@ from typing import Any, Mapping
 #      ``overlap_frac``). The checkpoint topology manifest and ``resume``
 #      records carry the pod factoring implicitly via their mesh-shape
 #      strings (``pod=2,ici=4,model=1``) — no new fields.
-SCHEMA_VERSION = 11
+#  12: the tail-at-scale data-plane generation (ISSUE 16): the ``hedge``
+#      kind — one per hedged request that raced (router-level request
+#      hedging over the framed wire, ``serve/fleet/router.py``: which
+#      host won, which lost, whether the loser was revoked in flight,
+#      and the p99-derived deadline that fired the hedge; ``trace_id``
+#      when the request was traced); ``serve_bench`` rows may carry
+#      ``hedged`` (how many requests of the sweep point hedged) and
+#      ``copies_per_request`` (the zero-copy dispatch assertion: input
+#      bytes touched exactly once between wire and ``device_put``);
+#      ``transport`` values grow "framed" / "framed+hedge" (the binary
+#      framed wire of ``serve/wire.py`` — check_regression already keys
+#      transport into the serve trend-line identity). All absent on
+#      HTTP/in-process serving — streams stay byte-identical to v11.
+SCHEMA_VERSION = 12
 
 _NUM = (int, float)
 _INT = (int,)
@@ -197,6 +211,9 @@ REQUIRED: dict[str, dict[str, tuple]] = {
     # v9: one per-(host, metric) time-series window from the fleet
     # collector (obs/collector.py) — points are [[wall_ts, value], ...].
     "timeline": {"host": (str,), "metric": (str,), "points": (list,)},
+    # v12: one hedged-request race (serve/fleet/router.py): the host
+    # whose completion won and the host whose attempt was revoked.
+    "hedge": {"winner": (str,), "loser": (str,)},
 }
 
 OPTIONAL: dict[str, dict[str, tuple]] = {
@@ -270,6 +287,11 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # alongside model, so a skewed-load row never compares against a
         # uniform baseline.
         "load_shape": (str,),
+        # v12: how many requests of this sweep point hedged (framed wire
+        # with --hedge only), and the zero-copy dispatch assertion —
+        # input copies per served request (1.0 = bytes touched exactly
+        # once between the wire and device_put). Absent elsewhere.
+        "hedged": _INT, "copies_per_request": _NUM,
     },
     "resume": {
         "from_devices": _INT, "from_mesh": (str,), "to_mesh": (str,),
@@ -358,6 +380,12 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
     # many counter resets (host restarts) the collector absorbed.
     "timeline": {
         "window_s": _NUM, "clock_offset_ms": _NUM, "resets": _INT,
+    },
+    # v12: whether the loser was revoked while still in flight (a CANCEL
+    # frame / Future.cancel() landed before it resolved), the deadline
+    # that fired the hedge, and the traced request's id.
+    "hedge": {
+        "cancelled": _INT, "deadline_ms": _NUM, "trace_id": (str,),
     },
 }
 

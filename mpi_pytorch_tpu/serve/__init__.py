@@ -46,6 +46,12 @@ from mpi_pytorch_tpu.serve.zoo import (
     ZooServer,
     parse_model_specs,
 )
+from mpi_pytorch_tpu.serve.client import WireHost
+from mpi_pytorch_tpu.serve.wire import (
+    WireClient,
+    WireError,
+    WireListener,
+)
 from mpi_pytorch_tpu.serve.fleet import (
     FleetAutoscaler,
     FleetController,
@@ -81,6 +87,10 @@ __all__ = [
     "ServeError",
     "ServerClosedError",
     "UnknownModelError",
+    "WireClient",
+    "WireError",
+    "WireHost",
+    "WireListener",
     "ZooExecutablePool",
     "ZooHost",
     "ZooServer",

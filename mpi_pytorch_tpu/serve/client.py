@@ -1,0 +1,137 @@
+"""Framed-wire client SDK (ISSUE 16): ``WireHost``.
+
+``WireHost`` is a ``RemoteHost`` whose DATA PLANE rides the binary
+framed wire (``serve/wire.py``) instead of npy-over-POST + long-poll:
+one persistent multiplexed stream per (client, host) pair, pipelined
+submits, out-of-order completion by req_id, and a real CANCEL verb —
+the hedge-loser revocation the router's exactly-once ledger needs.
+
+Everything else — probes, facts cache, /control retunes, tracez scrape,
+supervisor lifecycle — is inherited unchanged from ``RemoteHost`` over
+its keep-alive HTTP pool: the control plane is low-rate and JSON suits
+it; only the per-request path justified a wire format. The framed port
+is discovered from the host's readiness payload / ``/healthz`` facts
+(``wire_port``), so the HTTP surface is also the handshake.
+
+Failure mapping is shared with the in-process path: ERROR frames carry
+the PR 12 taxonomy as typed kinds, so a 429's ``retry_after_ms`` and a
+dead connection's host-shaped verdict look EXACTLY like their HTTP
+twins to the router — ``FleetRouter`` needs no transport branches.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
+
+import numpy as np
+
+from mpi_pytorch_tpu.serve.batcher import (
+    HostUnavailableError,
+    ServeError,
+    ServerClosedError,
+)
+from mpi_pytorch_tpu.serve.fleet.remote import RemoteHost
+from mpi_pytorch_tpu.serve.wire import WireClient
+
+
+class WireHost(RemoteHost):
+    """``HostHandle`` over the framed wire: binary SUBMIT/RESULT frames
+    on persistent pooled connections for requests, inherited HTTP for
+    control/probes. ``cancel(fut)`` sends the CANCEL frame for the
+    future's req_id — the router's hedge loser never occupies a batch
+    slot server-side."""
+
+    transport = "framed"
+
+    def __init__(self, base_url: str, *, wire_port: int | None = None,
+                 wire_pool: int = 2, **kwargs):
+        super().__init__(base_url, **kwargs)
+        if wire_port is None:
+            wire_port = self._facts().get("wire_port")
+        if not wire_port:
+            raise HostUnavailableError(
+                f"{self.name}: host at {self.base_url} advertises no "
+                f"wire_port — is it running with serve_transport='framed'?"
+            )
+        self.wire_port = int(wire_port)
+        host = self._netloc.rsplit(":", 1)[0]
+        self._wire = WireClient(
+            host, self.wire_port, pool=wire_pool,
+            connect_timeout_s=self.connect_timeout_s,
+        )
+
+    # ------------------------------------------------------------- requests
+
+    def submit(self, image, trace=None, model=None) -> Future:
+        """One pipelined SUBMIT frame; the returned future resolves from
+        the reader thread's req_id match (RESULT → top-k array, ERROR →
+        the exact typed exception). No wire retries — same
+        non-idempotent-submit discipline as the HTTP path. The req_id
+        rides the future (``wire_req_id``) as the CANCEL handle."""
+        if self._closed:
+            raise ServerClosedError(f"remote host {self.name} is closed")
+        traceparent = None
+        t_wire = 0.0
+        if trace is not None:
+            from mpi_pytorch_tpu.obs.context import format_traceparent
+
+            traceparent = format_traceparent(trace)
+            t_wire = time.time()
+        req_id, fut = self._wire.submit(
+            np.asarray(image), model=None if model is None else str(model),
+            traceparent=traceparent,
+        )
+        fut.wire_req_id = req_id
+        if trace is not None and self._spans is not None:
+            t_sent = time.time()
+            self._spans.add(
+                name="wire/submit", trace=trace.trace_id,
+                parent=trace.span_id, t0=t_wire, t1=t_sent,
+                host="router", attrs={"host": self.name, "req_id": req_id},
+            )
+            spans, name = self._spans, self.name
+
+            def _result_span(f: Future, _t0=t_sent) -> None:
+                # The delivery half: frame sent → response matched. The
+                # framed twin of the HTTP path's wire/result long-poll.
+                spans.add(
+                    name="wire/result", trace=trace.trace_id,
+                    parent=trace.span_id, t0=_t0, t1=time.time(),
+                    host="router", attrs={"host": name, "req_id": req_id},
+                )
+
+            fut.add_done_callback(_result_span)
+        return fut
+
+    def cancel(self, fut: Future) -> None:
+        """Revoke an in-flight submit: best-effort CANCEL frame for the
+        future's req_id. Server-side the pending future is cancelled and
+        the batch loop's sweep drops it before assembly; the reply is an
+        ERR_CANCELLED frame that resolves ``fut`` as cancelled-shaped.
+        Idempotent — cancelling a done or unknown req_id is a no-op."""
+        req_id = getattr(fut, "wire_req_id", None)
+        if req_id is not None and not fut.done():
+            self._wire.cancel(req_id)
+
+    def ping_wire(self, timeout_s: float = 2.0) -> bool:
+        """PING/PONG round-trip on the framed wire — the data-plane
+        liveness check (the HTTP ``alive()`` only proves the control
+        plane)."""
+        try:
+            return self._wire.ping(timeout_s=timeout_s)
+        except (ServeError, OSError, FutureTimeoutError):
+            return False
+
+    # ------------------------------------------------------------ lifecycle
+
+    def kill(self) -> None:
+        super().kill()
+        self._wire.close()
+
+    def close(self, drain: bool = True) -> None:
+        if self._closed:
+            return
+        super().close(drain=drain)
+        self._wire.close()

@@ -49,6 +49,7 @@ through real process death.
 from __future__ import annotations
 
 import dataclasses
+import http.client
 import io
 import json
 import os
@@ -60,6 +61,7 @@ import sys
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from concurrent.futures import Future, ThreadPoolExecutor
 
@@ -77,35 +79,54 @@ class _PendingResult(Exception):
     """Internal: the result long-poll sliced out (HTTP 408) — re-poll."""
 
 
-def _classify_http_error(e: urllib.error.HTTPError) -> Exception:
-    """Wire status → the typed in-process exception it stands for."""
+class _StaleConnection(Exception):
+    """Internal: a REUSED keep-alive connection died on first touch —
+    the server reaped it while idle. Not a host verdict: retry exactly
+    once on a fresh connection (reconnect-on-stale), and only THEN let
+    a failure classify host-shaped."""
+
+
+def _classify_status(status: int, body: bytes,
+                     fallback_detail: str = "") -> Exception:
+    """Wire status + JSON body → the typed in-process exception it
+    stands for (the PR 12 taxonomy, transport-independent)."""
     try:
-        payload = json.loads(e.read().decode())
+        payload = json.loads(body.decode())
     except Exception:  # noqa: BLE001 — a broken body is still a status
         payload = {}
-    detail = payload.get("detail") or payload.get("error") or str(e)
-    if e.code == 429:
+    detail = (payload.get("detail") or payload.get("error")
+              or fallback_detail or f"HTTP {status}")
+    if status == 429:
         return QueueFullError(
             detail, retry_after_ms=payload.get("retry_after_ms"),
             model=payload.get("model"),
         )
-    if e.code == 503:
+    if status == 503:
         return ServerClosedError(detail)
-    if e.code == 408:
+    if status == 408:
         return _PendingResult()
-    if e.code == 404:
+    if status == 404:
         # /result for an id this process never issued: a RESTARTED host
         # forgot its predecessor's requests — host-shaped, re-dispatch.
         err = HostUnavailableError(f"unknown on host (restarted?): {detail}")
-        err.status = e.code
+        err.status = status
         return err
-    if 400 <= e.code < 500:
+    if 400 <= status < 500:
         err = ServeError(detail)
-        err.status = e.code
+        err.status = status
         return err
-    err = HostUnavailableError(f"HTTP {e.code}: {detail}")
-    err.status = e.code
+    err = HostUnavailableError(f"HTTP {status}: {detail}")
+    err.status = status
     return err
+
+
+def _classify_http_error(e: urllib.error.HTTPError) -> Exception:
+    """Back-compat shim over ``_classify_status`` for urllib call sites."""
+    try:
+        body = e.read()
+    except Exception:  # noqa: BLE001
+        body = b""
+    return _classify_status(e.code, body, str(e))
 
 
 class RemoteHost:
@@ -146,6 +167,18 @@ class RemoteHost:
         self._facts_ttl_s = float(facts_ttl_s)
         self._rng = random.Random(seed)
         self._closed = False
+        # Keep-alive connection pool (ISSUE 16 satellite): the server
+        # side has always spoken HTTP/1.1 with Content-Length, so the
+        # only reason every call paid a TCP handshake was the client's
+        # one-shot urlopen. Connections are checked out per call and
+        # returned after a clean response; a stale one (reaped by the
+        # peer while idle) is replaced via reconnect-on-stale. Bounded
+        # RETENTION (creation is demand-driven — the poller pool is the
+        # real concurrency cap).
+        self._conns: list[http.client.HTTPConnection] = []
+        self._conns_lock = threading.Lock()
+        self._conns_cap = max(4, pollers)
+        self._netloc = urllib.parse.urlsplit(self.base_url).netloc
         # Router-process span ring for the WIRE halves of a traced
         # request (wire/submit POST, wire/result long-poll) — None keeps
         # the transport fully inert for tracing (ISSUE 13).
@@ -175,6 +208,77 @@ class RemoteHost:
 
     # --------------------------------------------------------- wire plumbing
 
+    def _checkout_conn(self, timeout: float):
+        """(conn, reused): a pooled keep-alive connection, or a fresh one
+        when the pool is dry."""
+        with self._conns_lock:
+            conn = self._conns.pop() if self._conns else None
+        if conn is not None:
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+            return conn, True
+        return http.client.HTTPConnection(self._netloc, timeout=timeout), False
+
+    def _checkin_conn(self, conn, keep: bool) -> None:
+        if keep and not self._closed:
+            with self._conns_lock:
+                if len(self._conns) < self._conns_cap:
+                    self._conns.append(conn)
+                    return
+        conn.close()
+
+    def _drop_conns(self) -> None:
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), []
+        for c in conns:
+            c.close()
+
+    def _request_once(
+        self, method: str, path: str, body: bytes | None,
+        timeout: float, ctype: str, headers: dict | None,
+    ) -> bytes:
+        """One wire call on a (pooled) persistent connection. Raises
+        ``_StaleConnection`` when a REUSED connection died on first
+        touch — the keep-alive race, retried fresh by the caller."""
+        url = self.base_url + path
+        conn, reused = self._checkout_conn(timeout)
+        try:
+            hdrs = dict(headers or {})
+            if body is not None:
+                hdrs["Content-Type"] = ctype
+            try:
+                conn.request(method, path, body=body, headers=hdrs)
+                resp = conn.getresponse()
+                data = resp.read()
+            except (http.client.BadStatusLine,
+                    http.client.CannotSendRequest,
+                    BrokenPipeError, ConnectionResetError,
+                    ConnectionAbortedError) as e:
+                conn.close()
+                if reused:
+                    # The peer reaped this idle keep-alive connection as
+                    # we touched it — reconnect-on-stale, not a verdict.
+                    raise _StaleConnection() from None
+                raise HostUnavailableError(
+                    f"{self.name} unreachable at {url}: {e}"
+                ) from None
+            except (urllib.error.URLError, ConnectionError, socket.timeout,
+                    TimeoutError, OSError, http.client.HTTPException) as e:
+                conn.close()
+                reason = getattr(e, "reason", e)
+                raise HostUnavailableError(
+                    f"{self.name} unreachable at {url}: {reason}"
+                ) from None
+        except BaseException:
+            # conn already closed on the paths above; belt-and-braces for
+            # anything that escaped before checkin.
+            conn.close()
+            raise
+        self._checkin_conn(conn, keep=not resp.will_close)
+        if 200 <= resp.status < 300:
+            return data
+        raise _classify_status(resp.status, data)
+
     def _request(
         self, method: str, path: str, body: bytes | None = None, *,
         timeout: float, retries: int = 0, ctype: str = "application/json",
@@ -182,33 +286,29 @@ class RemoteHost:
     ) -> bytes:
         """One wire call with bounded jittered retries on TRANSPORT
         failures only (the idempotent-probe discipline — callers pass
-        ``retries=0`` for submit). Typed statuses raise immediately."""
-        url = self.base_url + path
+        ``retries=0`` for submit). Typed statuses raise immediately.
+        A stale pooled connection costs one silent fresh-connection
+        retry, never a retry-budget charge or a host-shaped verdict."""
         last: Exception | None = None
         for attempt in range(retries + 1):
             try:
-                hdrs = dict(headers or {})
-                if body is not None:
-                    hdrs["Content-Type"] = ctype
-                req = urllib.request.Request(
-                    url, data=body, method=method, headers=hdrs,
-                )
-                with urllib.request.urlopen(req, timeout=timeout) as resp:
-                    return resp.read()
-            except urllib.error.HTTPError as e:
-                exc = _classify_http_error(e)
-                if isinstance(exc, HostUnavailableError) and attempt < retries:
-                    last = exc
-                else:
-                    raise exc from None
-            except (urllib.error.URLError, ConnectionError, socket.timeout,
-                    TimeoutError, OSError) as e:
-                reason = getattr(e, "reason", e)
-                last = HostUnavailableError(
-                    f"{self.name} unreachable at {url}: {reason}"
-                )
+                try:
+                    return self._request_once(
+                        method, path, body, timeout, ctype, headers
+                    )
+                except _StaleConnection:
+                    # Purge the pool first: its siblings idled just as
+                    # long, so the retry must dial fresh, not pop the
+                    # next corpse (a fresh connection never raises
+                    # _StaleConnection).
+                    self._drop_conns()
+                    return self._request_once(
+                        method, path, body, timeout, ctype, headers
+                    )
+            except HostUnavailableError as e:
+                last = e
                 if attempt >= retries:
-                    raise last from None
+                    raise
             time.sleep(
                 0.05 * (2 ** attempt) * (0.5 + self._rng.random())
             )
@@ -514,6 +614,7 @@ class RemoteHost:
         except (OSError, ServeError):
             pass  # already dead — which is the goal
         self._pool.shutdown(wait=False, cancel_futures=True)
+        self._drop_conns()
 
     def close(self, drain: bool = True) -> None:
         if self._closed:
@@ -531,6 +632,7 @@ class RemoteHost:
         # Give in-flight result polls a moment to deliver the drain's
         # resolutions, then cut the poller pool.
         self._pool.shutdown(wait=drain, cancel_futures=not drain)
+        self._drop_conns()
 
 
 # ---------------------------------------------------------------------------
@@ -806,6 +908,11 @@ _CHILD_EXCLUDE = frozenset({
     # span ring over /tracez — it mints nothing and collects nothing.
     "trace_sample_rate", "trace_slow_ms", "serve_collect_interval_s",
     "fleet_trace_file",
+    # Hedging is a ROUTER decision (ISSUE 16): the child host only ever
+    # sees the duplicate submit + the CANCEL frame; the knobs would fail
+    # its single-host validation. serve_transport DOES flow — it is what
+    # makes the child mount its framed listener.
+    "serve_hedge", "serve_hedge_factor", "serve_hedge_floor_ms",
 })
 
 
@@ -969,6 +1076,9 @@ class RemoteFleet:
             trace_sample_rate=cfg.trace_sample_rate,
             spans=self.spans,
             tenant_budgets=tenant_budgets,
+            hedge=cfg.serve_hedge,
+            hedge_factor=cfg.serve_hedge_factor,
+            hedge_floor_ms=cfg.serve_hedge_floor_ms,
         )
         if self.collector is not None:
             self.collector.start()
@@ -1007,7 +1117,7 @@ class RemoteFleet:
                 reject_rate_up=cfg.serve_scale_reject_rate,
                 interval_s=cfg.serve_retune_interval_s,
                 metrics=self._metrics,
-                transport="http",
+                transport=cfg.serve_transport,
                 logger=self._logger,
             )
             self.autoscaler.start()
@@ -1044,8 +1154,7 @@ class RemoteFleet:
             log_fh.close()
         try:
             ready = wait_port_file(port_file, self._spawn_timeout_s, proc)
-            host = RemoteHost(
-                f"http://127.0.0.1:{ready['port']}",
+            kwargs = dict(
                 name=f"h{index}", index=index, pid=ready["pid"],
                 connect_timeout_s=self.cfg.serve_connect_timeout_s,
                 read_timeout_s=self.cfg.serve_read_timeout_s,
@@ -1053,6 +1162,20 @@ class RemoteFleet:
                 logger=self._logger,
                 spans=self.spans,
             )
+            if self.cfg.serve_transport == "framed":
+                # The framed data plane (ISSUE 16): the child advertised
+                # its wire port in the readiness payload; control/probes
+                # stay on HTTP via the WireHost's RemoteHost half.
+                from mpi_pytorch_tpu.serve.client import WireHost
+
+                host = WireHost(
+                    f"http://127.0.0.1:{ready['port']}",
+                    wire_port=ready.get("wire_port"), **kwargs,
+                )
+            else:
+                host = RemoteHost(
+                    f"http://127.0.0.1:{ready['port']}", **kwargs,
+                )
         except BaseException:
             _terminate(proc)
             tail = ""

@@ -51,7 +51,8 @@ import itertools
 import random
 import threading
 import time
-from concurrent.futures import Future
+from collections import deque
+from concurrent.futures import CancelledError, Future
 from dataclasses import dataclass, field
 
 from mpi_pytorch_tpu.serve.batcher import (
@@ -114,6 +115,10 @@ class _HostState:
     # per-tenant dispatch counts of this route window.
     model_qdepth: dict = field(default_factory=dict)
     window_models: dict = field(default_factory=dict)
+    # Recent end-to-end dispatch latencies (s) on this host — the live
+    # per-host p99 the hedge deadline derives from (ISSUE 16). Bounded:
+    # hedging must react to the CURRENT tail, not the morning's.
+    latencies: deque = field(default_factory=lambda: deque(maxlen=64))
 
 
 @dataclass
@@ -142,6 +147,15 @@ class _Flight:
     # in transit" from "never assigned").
     redispatching: bool = False
     finished: bool = False
+    # Hedging state (ISSUE 16): the armed deadline timer, whether the
+    # hedge fired, which host took it, and the live wire futures of
+    # every attempt (host name → (host, future)) — the claim ledger the
+    # winner uses to revoke the loser exactly once.
+    hedge_timer: object = None
+    hedged: bool = False
+    hedge_host: str | None = None
+    hedge_deadline_ms: float = 0.0
+    attempts: dict = field(default_factory=dict)
     t_submit: float = field(default_factory=time.monotonic)
 
 
@@ -279,6 +293,9 @@ class FleetRouter:
         trace_sample_rate: float = 0.0,
         spans=None,
         tenant_budgets: dict | None = None,
+        hedge: bool = False,
+        hedge_factor: float = 3.0,
+        hedge_floor_ms: float = 20.0,
     ):
         if not hosts:
             raise ValueError("a fleet needs at least one serving host")
@@ -338,6 +355,18 @@ class FleetRouter:
             m: 0 for m in self.tenant_budgets
         }
         self.front_door_rejections = 0
+        # Hedged requests (ISSUE 16): after a per-host-p99-derived
+        # deadline, the router re-submits a still-pending request to the
+        # second-best host; first completion wins through the claim
+        # ledger (``_finish`` is already exactly-once) and the winner
+        # revokes the loser (CANCEL frame on the framed wire,
+        # ``Future.cancel()`` in-process) so the loser never occupies a
+        # batch slot.
+        self._hedge = bool(hedge)
+        self._hedge_factor = float(hedge_factor)
+        self._hedge_floor_ms = float(hedge_floor_ms)
+        self.hedges = 0
+        self.hedge_wins = 0
         self.redispatch_log: list[int] = []  # flight ids, append-only
         self.failovers: list[str] = []  # drained host names
         self._spare_warmups = 0
@@ -526,6 +555,7 @@ class FleetRouter:
             if entry.trace is not None:
                 d_ctx = entry.trace.child()
                 d_t0 = time.time()
+            t_disp = time.monotonic()
             try:
                 kwargs = {}
                 if d_ctx is not None:
@@ -568,10 +598,19 @@ class FleetRouter:
                 if self._has_candidate(exclude, entry.model):
                     continue
                 raise
+            with self._lock:
+                entry.attempts[host.name] = (host, hfut)
             hfut.add_done_callback(
-                lambda f, h=host, c=d_ctx, t0=d_t0, a=attempt:
-                self._on_host_done(entry, h, f, c, t0, a)
+                lambda f, h=host, c=d_ctx, t0=d_t0, a=attempt, td=t_disp:
+                self._on_host_done(entry, h, f, c, t0, a, td)
             )
+            if (
+                self._hedge
+                and entry.redispatches == 0
+                and not entry.hedged
+                and entry.hedge_timer is None
+            ):
+                self._arm_hedge(entry, host.name)
             return
 
     def _record_dispatch_span(self, entry, d_ctx, d_t0, host, attempt,
@@ -682,12 +721,26 @@ class FleetRouter:
             ), not loadable_fallback
 
     def _on_host_done(self, entry: _Flight, host, fut, d_ctx=None,
-                      d_t0=0.0, attempt=1) -> None:
-        exc = fut.exception()
+                      d_t0=0.0, attempt=1, t_disp=0.0) -> None:
+        cancelled = fut.cancelled()
+        exc = None if cancelled else fut.exception()
         with self._lock:
             st = self._state.get(host.name)
             if st is not None:
                 st.outstanding = max(0, st.outstanding - 1)
+        if cancelled or isinstance(exc, CancelledError):
+            # The hedge-loser resolution: the winner revoked this
+            # attempt. Cancellation is NEVER host evidence — no drain
+            # streak, no re-dispatch of a finished entry.
+            if d_ctx is not None:
+                self._record_dispatch_span(
+                    entry, d_ctx, d_t0, host, attempt, outcome="cancelled",
+                )
+            if not entry.finished:
+                # Cancelled underneath a live entry (host teardown raced
+                # the hand-over): re-dispatch, still no strike.
+                self._redispatch(entry, came_from=host.name)
+            return
         if d_ctx is not None:
             self._record_dispatch_span(
                 entry, d_ctx, d_t0, host, attempt,
@@ -695,9 +748,13 @@ class FleetRouter:
             )
         if exc is None:
             with self._lock:
-                if self._state.get(host.name) is not None:
-                    self._state[host.name].dispatch_fails = 0
-            self._finish(entry, result=fut.result())
+                st = self._state.get(host.name)
+                if st is not None:
+                    st.dispatch_fails = 0
+                    if t_disp > 0:
+                        st.latencies.append(time.monotonic() - t_disp)
+            if self._finish(entry, result=fut.result()) and entry.hedged:
+                self._settle_hedge(entry, winner=host.name)
             return
         if isinstance(exc, ServeError) and not isinstance(
             exc, (ServerClosedError, QueueFullError, HostUnavailableError)
@@ -705,7 +762,8 @@ class FleetRouter:
             # The REQUEST's own fault (bad shape, preprocess crash on its
             # payload): propagate — re-dispatching a poison request would
             # just poison another host's flush.
-            self._finish(entry, error=exc)
+            if self._finish(entry, error=exc) and entry.hedged:
+                self._settle_hedge(entry, winner=host.name)
             return
         # Host-shaped failure (closed mid-flight, device error, transport
         # failure to a remote host — ``HostUnavailableError``): count it
@@ -714,11 +772,151 @@ class FleetRouter:
         self._note_dispatch_failure(host)
         self._redispatch(entry, came_from=host.name)
 
-    def _finish(self, entry: _Flight, result=None, error=None) -> None:
+    # -------------------------------------------------------------- hedging
+
+    def _hedge_deadline_s(self, host_name: str) -> float:
+        """The hedge deadline for a dispatch to ``host_name``: the host's
+        live p99 dispatch latency × factor, floor-clamped (a cold host
+        with no samples hedges at the floor — better a cheap duplicate
+        than an unbounded wait on an unknown tail)."""
+        with self._lock:
+            st = self._state.get(host_name)
+            lats = sorted(st.latencies) if st is not None else []
+        floor = self._hedge_floor_ms / 1e3
+        if not lats:
+            return floor
+        p99 = lats[min(len(lats) - 1, int(0.99 * len(lats)))]
+        return max(p99 * self._hedge_factor, floor)
+
+    def _arm_hedge(self, entry: _Flight, primary: str) -> None:
+        deadline_s = self._hedge_deadline_s(primary)
+        timer = threading.Timer(
+            deadline_s, self._fire_hedge,
+            args=(entry, primary, round(deadline_s * 1e3, 3)),
+        )
+        timer.daemon = True
         with self._lock:
             if entry.finished:
-                return  # duplicate completion (old host outlived a drain)
+                return  # completed before the timer was even armed
+            entry.hedge_timer = timer
+            entry.hedge_deadline_ms = round(deadline_s * 1e3, 3)
+        timer.start()
+
+    def _fire_hedge(self, entry: _Flight, primary: str,
+                    deadline_ms: float) -> None:
+        """Deadline expired with the primary still pending: submit the
+        SAME request to the second-best host. The existing exactly-once
+        ledger (``_finish``) resolves the race; the loser is revoked in
+        ``_settle_hedge``. A hedge never cold-loads a tenant and never
+        re-fires — it is a bounded tail bet, not a retry loop."""
+        if self._closed:
+            return
+        with self._lock:
+            if (
+                entry.finished
+                or entry.redispatching
+                or entry.host != primary  # failed over; redispatch owns it
+                or entry.hedged
+            ):
+                return
+            entry.hedged = True
+        host, resident = self._pick(frozenset({primary}), entry.model)
+        if host is None or not resident:
+            with self._lock:
+                entry.hedged = False  # nothing to hedge to; stand down
+            return
+        with self._lock:
+            if entry.finished:
+                entry.hedged = False
+                return
+            entry.hedge_host = host.name
+            self._state[host.name].outstanding += 1
+            self.hedges += 1
+        try:
+            kwargs = {}
+            if entry.trace is not None:
+                kwargs["trace"] = entry.trace.child()
+            if entry.model is not None:
+                kwargs["model"] = entry.model
+            hfut = host.submit(entry.payload, **kwargs)
+        except BaseException:  # noqa: BLE001 — the primary still owns it
+            with self._lock:
+                self._state[host.name].outstanding -= 1
+                entry.hedge_host = None
+                self.hedges -= 1
+            return
+        with self._lock:
+            entry.attempts[host.name] = (host, hfut)
+        hfut.add_done_callback(
+            lambda f, h=host: self._on_hedge_done(entry, h, f)
+        )
+
+    def _on_hedge_done(self, entry: _Flight, host, fut) -> None:
+        with self._lock:
+            st = self._state.get(host.name)
+            if st is not None:
+                st.outstanding = max(0, st.outstanding - 1)
+        cancelled = fut.cancelled()
+        exc = None if cancelled else fut.exception()
+        if cancelled or isinstance(exc, CancelledError):
+            return  # we are the revoked loser — the winner already won
+        if exc is None:
+            if self._finish(entry, result=fut.result()):
+                with self._lock:
+                    self.hedge_wins += 1
+                self._settle_hedge(entry, winner=host.name)
+            return
+        # A failed hedge is a free loss — the primary (or the redispatch
+        # machinery) still owns the request. Host-shaped failures still
+        # feed the drain streak; backpressure does not.
+        if isinstance(exc, (ServerClosedError, HostUnavailableError)):
+            self._note_dispatch_failure(host)
+
+    def _settle_hedge(self, entry: _Flight, winner: str) -> None:
+        """Winner takes all: revoke every still-pending attempt (the
+        loser) — a CANCEL frame on hosts with a ``cancel`` surface (the
+        framed wire), ``Future.cancel()`` in-process — and write the
+        ``kind="hedge"`` record. Exactly-once: only the ``_finish``
+        winner (its return value is the claim) reaches this."""
+        losers = []
+        with self._lock:
+            for name, (lhost, lfut) in entry.attempts.items():
+                if name != winner and not lfut.done():
+                    losers.append((name, lhost, lfut))
+        cancelled = 0
+        loser_name = None
+        for name, lhost, lfut in losers:
+            loser_name = name
+            revoked = True
+            cancel = getattr(lhost, "cancel", None)
+            try:
+                if cancel is not None:
+                    cancel(lfut)
+                else:
+                    revoked = bool(lfut.cancel())
+            except Exception:  # noqa: BLE001 — loser host may be dying
+                revoked = False
+            cancelled += int(revoked)
+        if self._metrics is not None and loser_name is not None:
+            rec = {
+                "kind": "hedge",
+                "winner": winner,
+                "loser": loser_name,
+                "cancelled": cancelled,
+                "deadline_ms": entry.hedge_deadline_ms,
+            }
+            if entry.trace is not None:
+                rec["trace_id"] = entry.trace.trace_id
+            self._metrics.write(rec)
+
+    def _finish(self, entry: _Flight, result=None, error=None) -> bool:
+        """Resolve ``entry`` exactly once; returns True only for the call
+        that performed the resolution (the hedge winner's claim)."""
+        with self._lock:
+            if entry.finished:
+                return False  # duplicate completion (hedge loser / drain)
             entry.finished = True
+            timer, entry.hedge_timer = entry.hedge_timer, None
             self._inflight.pop(entry.fid, None)
             self._tokens += 1
             self._release_tenant_token(entry)
@@ -730,6 +928,8 @@ class FleetRouter:
                     else 0.9 * self._done_rate + 0.1 * inst
                 )
             self._done_t = now
+        if timer is not None:
+            timer.cancel()
         if entry.trace is not None:
             # The end-to-end ROOT span — exactly one completion per
             # trace (duplicate completions returned above). Its status/
@@ -751,6 +951,7 @@ class FleetRouter:
             entry.future.set_exception(error)
         else:
             entry.future.set_result(result)
+        return True
 
     def _redispatch(self, entry: _Flight, came_from: str) -> None:
         """Exactly-once re-dispatch: the caller must have observed the
@@ -1186,6 +1387,9 @@ class FleetRouter:
                     for name, st in sorted(self._state.items())
                 },
             }
+            if self._hedge:
+                out["hedges"] = self.hedges
+                out["hedge_wins"] = self.hedge_wins
             if self.tenant_budgets:
                 out["tenant_budgets"] = dict(self.tenant_budgets)
                 out["tenant_tokens_free"] = dict(self._tenant_tokens)

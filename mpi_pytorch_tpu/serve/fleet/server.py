@@ -189,6 +189,9 @@ class FleetServer:
             trace_sample_rate=cfg.trace_sample_rate,
             spans=self.spans,
             tenant_budgets=tenant_budgets,
+            hedge=cfg.serve_hedge,
+            hedge_factor=cfg.serve_hedge_factor,
+            hedge_floor_ms=cfg.serve_hedge_floor_ms,
         )
         if self.collector is not None:
             self.collector.start()

@@ -116,6 +116,8 @@ class ServingHost:
         poll_slice_s: float = 10.0,
         result_ttl_s: float = 60.0,
         result_hard_ttl_s: float = 600.0,
+        wire: bool = False,
+        wire_port: int = 0,
         logger=None,
     ):
         from mpi_pytorch_tpu.utils.logging import run_logger
@@ -132,11 +134,34 @@ class ServingHost:
         self._ids = itertools.count()
         self._closed = False
         self.closed_event = threading.Event()
+        # Framed data plane (ISSUE 16): a WireListener mounted NEXT TO the
+        # HTTP surface — submit/result move to persistent binary-framed
+        # connections, while probes/control/facts stay on HTTP (cold
+        # paths; one wire protocol per temperature). The port rides
+        # /healthz (and the readiness file) as ``wire_port``.
+        self.wire = None
+        if wire:
+            from mpi_pytorch_tpu.serve.wire import WireListener
+
+            host_index = getattr(server, "host_index", None)
+            self.wire = WireListener(
+                self._wire_submit,
+                host_index=-1 if host_index is None else host_index,
+                port=wire_port,
+                logger=self._logger,
+            )
+        healthz_fn = getattr(server, "_healthz", None)
+        if self.wire is not None and healthz_fn is not None:
+            base_healthz, wire_listener = healthz_fn, self.wire
+
+            def healthz_fn():
+                return dict(base_healthz(), wire_port=wire_listener.port)
+
         registry = getattr(server, "_registry", None) or _NullRegistry()
         metricsz = getattr(server, "registry_snapshot", None)
         self.http = ObsHTTPServer(
             registry,
-            healthz=getattr(server, "_healthz", None),
+            healthz=healthz_fn,
             port=port,
             metricsz=metricsz,
             get_routes={"/result/": self._handle_result,
@@ -153,6 +178,33 @@ class ServingHost:
             target=self._reap_loop, name="serve-host-reaper", daemon=True
         )
         self._reaper.start()
+
+    @property
+    def wire_port(self) -> int | None:
+        """The framed listener's port (None on an HTTP-only host)."""
+        return self.wire.port if self.wire is not None else None
+
+    def _wire_submit(self, image, model, traceparent):
+        """The WireListener's coupling into the request path: same typed
+        semantics as POST /submit, minus the HTTP wrapping — typed
+        ServeErrors propagate (the listener maps them to ERROR frames
+        with the taxonomy intact)."""
+        from mpi_pytorch_tpu.obs.context import parse_traceparent
+
+        kwargs = {}
+        ctx = parse_traceparent(traceparent)
+        if ctx is not None:
+            kwargs["trace"] = ctx
+        if model is not None:
+            kwargs["model"] = model
+        try:
+            return self.server.submit(image, **kwargs)
+        except TypeError:
+            if model is None:
+                raise
+            raise ServeError(
+                f"host is not multi-tenant (model={model!r})"
+            ) from None
 
     # ------------------------------------------------------------- routes
 
@@ -374,6 +426,11 @@ class ServingHost:
             self.server.close()
         self._reaper_stop.set()
         self.http.close()
+        # Wire listener LAST: a graceful drain resolves in-flight futures
+        # above, and their done-callbacks must still find live
+        # connections to write RESULT frames into.
+        if self.wire is not None:
+            self.wire.close()
         self.closed_event.set()
 
 
@@ -400,12 +457,17 @@ def main(argv=None) -> int:
         server,
         port=max(0, cfg.serve_port),
         read_timeout_s=cfg.serve_read_timeout_s,
+        wire=cfg.serve_transport == "framed",
         logger=logger,
     )
     payload = {
         "port": host.port, "pid": os.getpid(),
         "host_index": -1 if host_index is None else host_index,
     }
+    if host.wire_port is not None:
+        # ISSUE 16: the framed data-plane port, for WireHost's dial
+        # (absent on http-transport hosts — old readers are unaffected).
+        payload["wire_port"] = host.wire_port
     if cfg.serve_port_file:
         # Atomic: the supervisor polls for this file, and a torn read of
         # a half-written JSON must be impossible, not just unlikely.

@@ -86,6 +86,56 @@ class _InFlight:
     # TRACED requests from these (ISSUE 13); zero cost otherwise.
     t_flush: float = 0.0
     t_prep: float = 0.0
+    # The pooled host buffer this flush dispatched from (ISSUE 16):
+    # recycled by the completion loop AFTER device_get — by then the
+    # forward is done, so reuse can never race an in-flight H2D read.
+    buffer: Any = None
+
+
+class _BucketBufferPool:
+    """Pooled, bucket-padded host batch buffers (ISSUE 16 zero-copy leg).
+
+    The old assembly chain touched every request's pixels three times —
+    ``np.stack`` (copy 1), ``pad_batch`` (copy 2), ``astype`` inside
+    ``place`` (copy 3 whenever the request dtype differs from the
+    executable's) — plus one fresh [bucket, H, W, 3] allocation per
+    flush. A pooled buffer in the EXECUTABLE'S dtype collapses all of
+    it: each row is written once, straight into its padded slot
+    (``np.copyto`` converts dtype during that same pass), and
+    ``place``'s ``astype(copy=False)`` is a no-op by construction —
+    frame payload → padded slot → device, bytes touched once.
+
+    Keyed by (bucket, dtype): a precision retune may switch executable
+    sets mid-traffic, and handing a bf16 set a uint8 pooled buffer
+    would silently reintroduce the astype copy. Bounded per key — the
+    double-buffered pipeline holds at most 2 flushes in flight, so a
+    small cap covers steady state and burst allocations just fall back
+    to (counted) fresh buffers.
+    """
+
+    def __init__(self, image_hw: tuple[int, int], cap_per_key: int = 4):
+        self._hw = tuple(image_hw)
+        self._cap = cap_per_key
+        self._free: dict[tuple, list[np.ndarray]] = {}
+        self._lock = threading.Lock()
+        self.allocations = 0  # fresh buffers ever made (reuse = no bump)
+
+    def acquire(self, bucket: int, dtype) -> np.ndarray:
+        key = (int(bucket), np.dtype(dtype).str)
+        with self._lock:
+            free = self._free.get(key)
+            if free:
+                return free.pop()
+            self.allocations += 1
+        h, w = self._hw
+        return np.empty((bucket, h, w, 3), np.dtype(dtype))
+
+    def release(self, buf: np.ndarray) -> None:
+        key = (buf.shape[0], buf.dtype.str)
+        with self._lock:
+            free = self._free.setdefault(key, [])
+            if len(free) < self._cap:
+                free.append(buf)
 
 
 class InferenceServer:
@@ -317,7 +367,14 @@ class InferenceServer:
                 "served": 0, "failed": 0, "rejected": 0, "batches": 0,
                 "padded_rows": 0, "preprocess_failures": 0, "worker_respawns": 0,
                 "by_bucket": {b: 0 for b in self.buckets},
+                # Zero-copy ledger (ISSUE 16): host-side pixel copies made
+                # assembling batches, and requests revoked by CANCEL before
+                # they could occupy a batch slot. input_copies / served is
+                # the bytes-touched-once invariant as a CI-checked number
+                # (exactly 1.0 on the pooled path).
+                "input_copies": 0, "cancelled": 0,
             }
+            self._bufpool = _BucketBufferPool(self.cfg.image_size)
             self._batch_thread = threading.Thread(
                 target=self._batch_loop, name="serve-batch", daemon=True
             )
@@ -534,8 +591,6 @@ class InferenceServer:
     # ------------------------------------------------------------- batch loop
 
     def _batch_loop(self) -> None:
-        from mpi_pytorch_tpu.train.trainer import pad_batch
-
         while True:
             flush = self._batcher.next_flush()
             if flush is None:
@@ -597,6 +652,21 @@ class InferenceServer:
                 if prep_failures:
                     with self._lock:
                         self._stats["preprocess_failures"] += prep_failures
+                # CANCEL sweep (ISSUE 16): a hedged loser revoked while
+                # queued/preprocessing must never occupy a batch slot —
+                # its winner already landed elsewhere, so dispatching it
+                # would burn bucket rows on a result nobody will read.
+                if any(r.future.cancelled() for r in good):
+                    kept = [
+                        (req, row) for req, row in zip(good, rows)
+                        if not req.future.cancelled()
+                    ]
+                    with self._lock:
+                        self._stats["cancelled"] += len(good) - len(kept)
+                    good = [req for req, _ in kept]
+                    rows = [row for _, row in kept]
+                    if not good:
+                        continue  # the whole flush was revoked — no outage
                 if not good:
                     # Nothing to dispatch, so no kind="serve" record will
                     # carry these failures — a whole-flush casualty is the
@@ -626,8 +696,22 @@ class InferenceServer:
                 # per-set state).
                 exe = self._exe
                 bucket = pick_bucket(len(good), self._batcher.active_buckets)
-                labels = np.full((len(good),), -1, np.int32)
-                images, labels = pad_batch(np.stack(rows), labels, bucket)
+                # Zero-copy assembly (ISSUE 16): each request's pixels are
+                # written ONCE, straight into their padded slot of a
+                # pooled buffer already in the executable's dtype —
+                # np.copyto converts dtype during that same single pass,
+                # so place()'s astype(copy=False) below is a no-op and the
+                # bytes go frame payload → padded slot → device. The old
+                # stack → pad_batch → astype chain touched them up to
+                # three times and allocated a fresh batch every flush.
+                images = self._bufpool.acquire(bucket, exe.image_dtype)
+                for i, row in enumerate(rows):
+                    np.copyto(images[i], row, casting="unsafe")
+                if len(rows) < bucket:
+                    images[len(rows):] = 0  # recycled buffers hold stale rows
+                with self._lock:
+                    self._stats["input_copies"] += len(rows)
+                labels = np.full((bucket,), -1, np.int32)
                 dispatch_args = {"bucket": bucket, "requests": len(good)}
                 if self._tracer.enabled:
                     dispatch_args["req_ids"] = [r.req_id for r in good]
@@ -648,6 +732,7 @@ class InferenceServer:
                         precision=exe.precision,
                         t_flush=t_flush,
                         t_prep=t_prep,
+                        buffer=images,
                     )
                 )
             except BaseException as e:  # noqa: BLE001 — keep serving
@@ -753,8 +838,24 @@ class InferenceServer:
                 # flush, never a torn read. (On a failure above, _fail in
                 # the handler below still resolves the not-done futures
                 # with the error — callers never hang.)
+                cancelled_late = 0
                 for i, req in enumerate(item.requests):
+                    # A hedged loser cancelled AFTER dispatch (its winner
+                    # landed while this flush was on-device): the slot was
+                    # spent, but set_result on a cancelled future would
+                    # raise InvalidStateError — skip and count it.
+                    if req.future.cancelled():
+                        cancelled_late += 1
+                        continue
                     req.future.set_result(rows[i].astype(np.int32, copy=False))
+                if cancelled_late:
+                    with self._lock:
+                        self._stats["cancelled"] += cancelled_late
+                # Recycle the flush's pooled host buffer: device_get
+                # blocked until the forward finished, so no in-flight H2D
+                # read can race the next flush's writes into it.
+                if item.buffer is not None:
+                    self._bufpool.release(item.buffer)
             except BaseException as e:  # noqa: BLE001 — keep serving
                 self._logger.error("serve completion loop error: %s", e)
                 self._fail(item.requests, e)
@@ -897,6 +998,16 @@ class InferenceServer:
             out = dict(self._stats, by_bucket=dict(self._stats["by_bucket"]))
         out["queue_depth"] = self._batcher.qsize()
         out["compiles_after_warmup"] = self.compiles_after_warmup()
+        # The zero-copy invariant as a number (ISSUE 16): host-side pixel
+        # copies per served request — exactly 1.0 on the pooled path
+        # (each request's bytes are touched once between arrival and
+        # device_put), asserted by tests/test_wire.py. buffer_allocations
+        # proves the pool recycles (it stops growing at steady state).
+        if out["served"]:
+            out["copies_per_request"] = round(
+                out["input_copies"] / out["served"], 6
+            )
+        out["buffer_allocations"] = self._bufpool.allocations
         out["topk"] = self.topk
         out["buckets"] = list(self.buckets)
         out["precision"] = self.precision

@@ -1,0 +1,754 @@
+"""Binary framed persistent serving transport (ISSUE 16).
+
+The HTTP path ships every request as ``.npy``-over-POST on a FRESH TCP
+connection and long-polls the result back as another request — at
+millions-of-users load the per-request cost is connection setup +
+headers + an extra buffer copy per hop, none of it chip time (ROADMAP
+open item 3). This module is the fast data plane: a length-prefixed
+binary frame codec carried over a SMALL POOL of persistent connections
+per (client, host) pair, with request pipelining and out-of-order
+response matching by ``req_id`` — one multiplexed stream instead of two
+HTTP round-trips per request.
+
+Frame layout (little-endian, ``docs/SERVING.md`` has the full spec)::
+
+    prefix  : magic b"MPTW" | version u8 | ftype u8 | flags u16
+              | req_id u64 | header_len u32 | payload_len u32   (24 B)
+    header  : per-ftype binary struct (below) — never JSON, never base64
+    payload : raw array bytes (C-order), exactly payload_len
+
+Frame types: SUBMIT (array header + image bytes), RESULT (array header
++ top-k int32 bytes), ERROR (typed-failure header: the PR 12 taxonomy
+as a u16 kind + detail + retry_after_ms — the 429 hint rides the wire),
+CANCEL (hedge-loser revocation, header/payload empty), PING/PONG
+(handshake + liveness). Array headers carry dtype token, shape, model
+id, and the W3C traceparent, so multi-tenancy (ISSUE 14) and
+distributed tracing (ISSUE 13) survive the transport switch intact.
+
+Decode failures are TYPED and immediate — a truncated, malformed,
+oversized, or version-skewed frame raises (never hangs, never resyncs:
+a framing error poisons the stream, so the connection is torn down and
+its in-flight requests fail host-shaped, which the router re-dispatches).
+
+``WireListener`` is the server half mounted next to the HTTP surface by
+``serve/host.py`` (the port rides the readiness file as ``wire_port``);
+``WireClient`` is the client half under ``serve/client.py``'s
+``WireHost``. Both are host-only (no jax) and unit-tested against fake
+peers in ``tests/test_wire.py``.
+
+Chaos: ``maybe_fault_wire_delay()`` honors ``MPT_FAULT_WIRE_DELAY_MS``
+(+ ``_HOST`` scope + ``_JITTER_MS``) on the server's response path —
+a deterministic slow wire on one host, the lever the hedge drill uses.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import CancelledError, Future
+
+import numpy as np
+
+from mpi_pytorch_tpu.serve.batcher import (
+    HostUnavailableError,
+    ModelNotResidentError,
+    PreprocessError,
+    QueueFullError,
+    ServeError,
+    ServerClosedError,
+    UnknownModelError,
+)
+from mpi_pytorch_tpu.utils.env import env_int
+
+MAGIC = b"MPTW"
+WIRE_VERSION = 1
+
+# Frame types.
+SUBMIT = 1
+RESULT = 2
+ERROR = 3
+CANCEL = 4
+PING = 5
+PONG = 6
+_FRAME_TYPES = frozenset((SUBMIT, RESULT, ERROR, CANCEL, PING, PONG))
+
+# prefix: magic | version | ftype | flags | req_id | header_len | payload_len
+PREFIX = struct.Struct("<4sBBHQII")
+PREFIX_LEN = PREFIX.size  # 24
+
+# Caps: a frame is read fully into memory before dispatch, so both halves
+# are bounded — an oversized length field is rejected from the PREFIX
+# alone (no allocation happens first).
+MAX_HEADER_BYTES = 64 * 1024
+MAX_PAYLOAD_BYTES = 64 * 1024 * 1024
+
+# Wire dtype tokens: the closed set of array dtypes the serving wire
+# carries (request pixels + top-k results). Closed on purpose — an
+# unknown token is a malformed frame, not a pickle.
+_DTYPE_BY_TOKEN = {
+    1: np.dtype(np.uint8),
+    2: np.dtype(np.int8),
+    3: np.dtype(np.int16),
+    4: np.dtype(np.int32),
+    5: np.dtype(np.int64),
+    6: np.dtype(np.float16),
+    7: np.dtype(np.float32),
+    8: np.dtype(np.float64),
+    9: np.dtype(np.bool_),
+}
+_TOKEN_BY_DTYPE = {dt.str: tok for tok, dt in _DTYPE_BY_TOKEN.items()}
+
+# ERROR-frame kinds: the PR 12 failure taxonomy as wire enums. The
+# client maps each back to the EXACT typed exception, so the router's
+# request-shaped-vs-host-shaped dispatch logic needs no transport
+# special-casing.
+ERR_QUEUE_FULL = 1
+ERR_CLOSED = 2
+ERR_UNKNOWN_MODEL = 3
+ERR_NOT_RESIDENT = 4
+ERR_PREPROCESS = 5
+ERR_REQUEST = 6  # generic request-shaped ServeError
+ERR_INTERNAL = 7  # host-shaped: anything non-ServeError server-side
+ERR_CANCELLED = 8
+
+_ERR_CLASSES = {
+    ERR_CLOSED: ServerClosedError,
+    ERR_UNKNOWN_MODEL: UnknownModelError,
+    ERR_NOT_RESIDENT: ModelNotResidentError,
+    ERR_PREPROCESS: PreprocessError,
+    ERR_REQUEST: ServeError,
+    ERR_INTERNAL: HostUnavailableError,
+}
+
+
+class WireError(ServeError):
+    """Base class for framing errors. A framing error is CONNECTION
+    poison: after one, stream offsets are untrusted, so the peer must
+    tear the connection down (in-flight requests fail host-shaped and
+    the router re-dispatches them)."""
+
+
+class MalformedFrameError(WireError):
+    """Bad magic, unknown frame type / dtype token, or a header whose
+    contents do not parse — the stream is not (or no longer) MPTW."""
+
+
+class FrameTooLargeError(WireError):
+    """A length field exceeds the header/payload cap. Rejected from the
+    prefix alone, BEFORE any allocation."""
+
+
+class WireVersionError(WireError):
+    """Peer speaks a different MPTW version — refuse loudly instead of
+    misparsing a future layout."""
+
+
+class TruncatedFrameError(WireError):
+    """The stream ended mid-frame (peer died / short read) — distinct
+    from malformed: the bytes were fine, there were just too few."""
+
+
+# --------------------------------------------------------------------------
+# codec (pure, host-only, unit-testable)
+# --------------------------------------------------------------------------
+
+
+def encode_frame(ftype: int, req_id: int, header: bytes = b"",
+                 payload: bytes = b"") -> bytes:
+    """One wire frame as bytes (prefix + header + payload)."""
+    if ftype not in _FRAME_TYPES:
+        raise MalformedFrameError(f"unknown frame type {ftype}")
+    if len(header) > MAX_HEADER_BYTES or len(payload) > MAX_PAYLOAD_BYTES:
+        raise FrameTooLargeError(
+            f"frame over cap (header {len(header)} B, payload "
+            f"{len(payload)} B; caps {MAX_HEADER_BYTES}/{MAX_PAYLOAD_BYTES})"
+        )
+    return (
+        PREFIX.pack(MAGIC, WIRE_VERSION, ftype, 0, req_id,
+                    len(header), len(payload))
+        + header + payload
+    )
+
+
+def decode_prefix(buf: bytes) -> tuple[int, int, int, int]:
+    """(ftype, req_id, header_len, payload_len) from a 24-byte prefix.
+    Every refusal is typed: truncation, bad magic, version skew,
+    unknown type, over-cap lengths."""
+    if len(buf) < PREFIX_LEN:
+        raise TruncatedFrameError(
+            f"prefix truncated ({len(buf)}/{PREFIX_LEN} bytes)"
+        )
+    magic, version, ftype, _flags, req_id, hlen, plen = PREFIX.unpack(
+        buf[:PREFIX_LEN]
+    )
+    if magic != MAGIC:
+        raise MalformedFrameError(f"bad magic {magic!r} (want {MAGIC!r})")
+    if version != WIRE_VERSION:
+        raise WireVersionError(
+            f"peer speaks MPTW v{version}, this end v{WIRE_VERSION}"
+        )
+    if ftype not in _FRAME_TYPES:
+        raise MalformedFrameError(f"unknown frame type {ftype}")
+    if hlen > MAX_HEADER_BYTES or plen > MAX_PAYLOAD_BYTES:
+        raise FrameTooLargeError(
+            f"declared lengths over cap (header {hlen} B, payload {plen} B)"
+        )
+    return ftype, req_id, hlen, plen
+
+
+def pack_array_header(arr: np.ndarray, model: str | None = None,
+                      traceparent: str | None = None) -> bytes:
+    """SUBMIT/RESULT header: dtype token, shape, model id, traceparent."""
+    token = _TOKEN_BY_DTYPE.get(arr.dtype.str)
+    if token is None:
+        raise MalformedFrameError(
+            f"dtype {arr.dtype} is not a wire dtype "
+            f"(supported: {sorted(str(d) for d in _DTYPE_BY_TOKEN.values())})"
+        )
+    parts = [struct.pack("<BB", token, arr.ndim),
+             struct.pack(f"<{arr.ndim}I", *arr.shape)]
+    for s in (model or "", traceparent or ""):
+        b = s.encode("utf-8")
+        parts.append(struct.pack("<H", len(b)) + b)
+    return b"".join(parts)
+
+
+def unpack_array_header(header: bytes) -> tuple[np.dtype, tuple, str | None,
+                                                str | None]:
+    """(dtype, shape, model, traceparent) from an array header."""
+    try:
+        token, ndim = struct.unpack_from("<BB", header, 0)
+        shape = struct.unpack_from(f"<{ndim}I", header, 2)
+        off = 2 + 4 * ndim
+        strs = []
+        for _ in range(2):
+            (n,) = struct.unpack_from("<H", header, off)
+            off += 2
+            if off + n > len(header):
+                raise struct.error("string past header end")
+            strs.append(header[off:off + n].decode("utf-8"))
+            off += n
+    except (struct.error, UnicodeDecodeError) as e:
+        raise MalformedFrameError(f"unparseable array header: {e}") from None
+    dtype = _DTYPE_BY_TOKEN.get(token)
+    if dtype is None:
+        raise MalformedFrameError(f"unknown dtype token {token}")
+    return dtype, shape, strs[0] or None, strs[1] or None
+
+
+def decode_array(header: bytes, payload: bytes | memoryview) -> tuple[
+        np.ndarray, str | None, str | None]:
+    """(array, model, traceparent) from an array frame. The array is a
+    VIEW over the received payload buffer — the zero-copy contract: the
+    server's batch loop copies it once, straight into the padded bucket
+    slot ``device_put`` consumes."""
+    dtype, shape, model, trace = unpack_array_header(header)
+    want = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize if shape \
+        else dtype.itemsize
+    if len(payload) != want:
+        raise MalformedFrameError(
+            f"payload is {len(payload)} B but dtype {dtype} shape "
+            f"{tuple(shape)} needs {want} B"
+        )
+    arr = np.frombuffer(payload, dtype=dtype).reshape(shape)
+    return arr, model, trace
+
+
+def encode_error_header(kind: int, detail: str,
+                        retry_after_ms: float | None = None,
+                        model: str | None = None) -> bytes:
+    parts = [struct.pack(
+        "<Hd", kind,
+        float("nan") if retry_after_ms is None else float(retry_after_ms),
+    )]
+    for s in (detail, model or ""):
+        b = s.encode("utf-8")[:2048]
+        parts.append(struct.pack("<H", len(b)) + b)
+    return b"".join(parts)
+
+
+def decode_error_header(header: bytes) -> tuple[int, str, float | None,
+                                                str | None]:
+    """(kind, detail, retry_after_ms, model) from an ERROR header."""
+    try:
+        kind, retry = struct.unpack_from("<Hd", header, 0)
+        off = 10
+        strs = []
+        for _ in range(2):
+            (n,) = struct.unpack_from("<H", header, off)
+            off += 2
+            strs.append(header[off:off + n].decode("utf-8"))
+            off += n
+    except (struct.error, UnicodeDecodeError) as e:
+        raise MalformedFrameError(f"unparseable error header: {e}") from None
+    return (kind, strs[0], None if retry != retry else retry,
+            strs[1] or None)
+
+
+def exception_to_error_header(exc: BaseException) -> bytes:
+    """The PR 12 taxonomy → ERROR header, typed hints included (the 429's
+    retry_after_ms and rejected-model ride as fields, not prose)."""
+    if isinstance(exc, QueueFullError):
+        return encode_error_header(ERR_QUEUE_FULL, str(exc),
+                                   exc.retry_after_ms, exc.model)
+    if isinstance(exc, ServerClosedError):
+        return encode_error_header(ERR_CLOSED, str(exc))
+    if isinstance(exc, UnknownModelError):
+        return encode_error_header(ERR_UNKNOWN_MODEL, str(exc))
+    if isinstance(exc, ModelNotResidentError):
+        return encode_error_header(ERR_NOT_RESIDENT, str(exc))
+    if isinstance(exc, PreprocessError):
+        return encode_error_header(ERR_PREPROCESS, str(exc))
+    if isinstance(exc, CancelledError):
+        return encode_error_header(ERR_CANCELLED, "request cancelled")
+    if isinstance(exc, ServeError):
+        return encode_error_header(ERR_REQUEST, str(exc))
+    return encode_error_header(
+        ERR_INTERNAL, f"{type(exc).__name__}: {exc}"
+    )
+
+
+def error_header_to_exception(header: bytes) -> BaseException:
+    """ERROR header → the exact typed exception the in-process path
+    would have raised (the transport must not blur the taxonomy)."""
+    kind, detail, retry_after_ms, model = decode_error_header(header)
+    if kind == ERR_QUEUE_FULL:
+        return QueueFullError(detail, retry_after_ms=retry_after_ms,
+                              model=model)
+    if kind == ERR_CANCELLED:
+        return CancelledError(detail)
+    cls = _ERR_CLASSES.get(kind)
+    if cls is None:
+        raise MalformedFrameError(f"unknown error kind {kind}")
+    return cls(detail)
+
+
+# --------------------------------------------------------------------------
+# framed stream I/O
+# --------------------------------------------------------------------------
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Exactly ``n`` bytes from ``sock``, or TruncatedFrameError on EOF
+    mid-read (a clean EOF at a frame BOUNDARY is signalled by the
+    zero-byte first read — callers treat n_read == 0 as peer-closed)."""
+    if n == 0:
+        return b""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            if got == 0:
+                raise ConnectionResetError("peer closed")
+            raise TruncatedFrameError(
+                f"stream ended mid-frame ({got}/{n} bytes)"
+            )
+        got += r
+    return bytes(buf)
+
+
+def read_frame(sock: socket.socket) -> tuple[int, int, bytes, bytes]:
+    """The next (ftype, req_id, header, payload) off ``sock``. Raises
+    ConnectionResetError on a clean peer close at a frame boundary, a
+    typed WireError on anything else."""
+    ftype, req_id, hlen, plen = decode_prefix(_recv_exact(sock, PREFIX_LEN))
+    header = _recv_exact(sock, hlen) if hlen else b""
+    payload = _recv_exact(sock, plen) if plen else b""
+    return ftype, req_id, header, payload
+
+
+# --------------------------------------------------------------------------
+# chaos: deterministic slow wire (ISSUE 16 satellite)
+# --------------------------------------------------------------------------
+
+
+def maybe_fault_wire_delay(host_index: int) -> float:
+    """Sleep on the response path when the ``MPT_FAULT_WIRE_DELAY_MS``
+    gate targets this host (``MPT_FAULT_WIRE_DELAY_HOST``; unset/-1 =
+    every host), plus an optional bounded jitter
+    (``MPT_FAULT_WIRE_DELAY_JITTER_MS``, deterministic per-call phase so
+    a drill's delay profile replays). Returns the ms slept (0 = gate
+    cold) so call sites can stamp fault records."""
+    delay_ms = env_int("MPT_FAULT_WIRE_DELAY_MS", 0)
+    if delay_ms <= 0:
+        return 0.0
+    target = env_int("MPT_FAULT_WIRE_DELAY_HOST", -1)
+    if target >= 0 and target != host_index:
+        return 0.0
+    jitter = env_int("MPT_FAULT_WIRE_DELAY_JITTER_MS", 0)
+    if jitter > 0:
+        # Deterministic phase: a counter-derived triangle wave, not a
+        # PRNG — the same drill sleeps the same schedule every run.
+        with _jitter_lock:
+            global _jitter_phase
+            _jitter_phase = (_jitter_phase + 1) % (2 * jitter)
+            delay_ms += abs(jitter - _jitter_phase)
+    time.sleep(delay_ms / 1e3)
+    return float(delay_ms)
+
+
+_jitter_phase = 0
+_jitter_lock = threading.Lock()
+
+
+# --------------------------------------------------------------------------
+# server half
+# --------------------------------------------------------------------------
+
+
+class WireListener:
+    """The serving host's framed wire surface: accept persistent
+    connections, decode SUBMIT frames straight into the request path,
+    and write RESULT/ERROR frames back out of order as futures land.
+
+    ``submit_fn(image, model, trace) -> Future`` is the only coupling to
+    the serving stack (``serve/host.py`` binds it to the real server's
+    submit; tests bind a fake). ``trace`` is the raw traceparent string
+    — parsing it is the submit_fn's business, same as the HTTP header
+    path. CANCEL frames call ``Future.cancel()`` on the pending future:
+    a request the batch loop has not yet assembled is revoked before it
+    can occupy a batch slot (the hedge-loser contract)."""
+
+    def __init__(self, submit_fn, *, host_index: int = -1, port: int = 0,
+                 logger=None):
+        self._submit_fn = submit_fn
+        self._host_index = host_index
+        self._logger = logger
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", port))
+        self._lsock.listen(32)
+        self.port = self._lsock.getsockname()[1]
+        self._closed = False
+        self._conns: set[socket.socket] = set()
+        self._lock = threading.Lock()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="wire-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    # -- connection handling
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _addr = self._lsock.accept()
+            except OSError:
+                return  # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                if self._closed:
+                    conn.close()
+                    return
+                self._conns.add(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="wire-conn", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()  # RESULT writers race (out-of-order)
+        pending: dict[int, Future] = {}
+        pend_lock = threading.Lock()
+        try:
+            while True:
+                try:
+                    ftype, req_id, header, payload = read_frame(conn)
+                except ConnectionResetError:
+                    return  # peer closed cleanly between frames
+                except WireError as e:
+                    # Framing error = connection poison: refuse loudly
+                    # once (best effort), then tear down.
+                    if self._logger is not None:
+                        self._logger.warning("wire: dropping conn: %s", e)
+                    self._try_send(conn, send_lock, encode_frame(
+                        ERROR, 0, exception_to_error_header(e)))
+                    return
+                if ftype == PING:
+                    self._try_send(conn, send_lock,
+                                   encode_frame(PONG, req_id))
+                elif ftype == CANCEL:
+                    with pend_lock:
+                        fut = pending.get(req_id)
+                    if fut is not None:
+                        fut.cancel()
+                elif ftype == SUBMIT:
+                    self._handle_submit(conn, send_lock, pending, pend_lock,
+                                        req_id, header, payload)
+                # RESULT/ERROR/PONG from a client are ignored: this end
+                # only ever receives SUBMIT/CANCEL/PING.
+        except OSError:
+            return
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            # In-flight futures whose connection died: nobody is left to
+            # receive the result — cancel so the batch loop can skip.
+            with pend_lock:
+                for fut in pending.values():
+                    fut.cancel()
+
+    def _handle_submit(self, conn, send_lock, pending, pend_lock,
+                       req_id, header, payload) -> None:
+        try:
+            image, model, trace = decode_array(header, payload)
+        except WireError as e:
+            self._try_send(conn, send_lock, encode_frame(
+                ERROR, req_id, exception_to_error_header(e)))
+            return
+        try:
+            fut = self._submit_fn(image, model, trace)
+        except BaseException as e:  # typed admission rejection (429/503/…)
+            self._reply_error(conn, send_lock, req_id, e)
+            return
+        with pend_lock:
+            pending[req_id] = fut
+
+        def _done(f: Future, rid=req_id) -> None:
+            with pend_lock:
+                pending.pop(rid, None)
+            maybe_fault_wire_delay(self._host_index)
+            if f.cancelled():
+                self._reply_error(conn, send_lock, rid, CancelledError())
+                return
+            exc = f.exception()
+            if exc is not None:
+                self._reply_error(conn, send_lock, rid, exc)
+                return
+            result = np.ascontiguousarray(f.result())
+            self._try_send(conn, send_lock, encode_frame(
+                RESULT, rid, pack_array_header(result),
+                result.tobytes()))
+
+        fut.add_done_callback(_done)
+
+    def _reply_error(self, conn, send_lock, req_id, exc) -> None:
+        self._try_send(conn, send_lock, encode_frame(
+            ERROR, req_id, exception_to_error_header(exc)))
+
+    @staticmethod
+    def _try_send(conn, send_lock, frame: bytes) -> None:
+        try:
+            with send_lock:
+                conn.sendall(frame)
+        except OSError:
+            pass  # peer gone; its reader loop will notice and clean up
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = list(self._conns), set()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+# --------------------------------------------------------------------------
+# client half
+# --------------------------------------------------------------------------
+
+
+class _WireConn:
+    """One persistent connection: a send lock (pipelined writers race)
+    and a reader thread matching RESULT/ERROR frames to futures by
+    req_id (out-of-order completion is the POINT of the framed wire —
+    a slow request never head-of-line-blocks the stream)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float):
+        self.sock = socket.create_connection((host, port), timeout=timeout_s)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock.settimeout(None)  # reader blocks; liveness is PING's job
+        self.send_lock = threading.Lock()
+        self.inflight: dict[int, Future] = {}
+        self.inflight_lock = threading.Lock()
+        self.dead = False
+        self.reader = threading.Thread(
+            target=self._read_loop, name="wire-reader", daemon=True
+        )
+        self.reader.start()
+
+    def _read_loop(self) -> None:
+        err: BaseException = HostUnavailableError("wire connection lost")
+        try:
+            while True:
+                ftype, req_id, header, payload = read_frame(self.sock)
+                if ftype == RESULT:
+                    fut = self._pop(req_id)
+                    if fut is not None:
+                        try:
+                            arr, _model, _trace = decode_array(
+                                header, payload)
+                        except WireError as e:
+                            fut.set_exception(e)
+                        else:
+                            # Copy: the recv buffer is reused per frame
+                            # read, the result outlives it. Results are
+                            # top-k index rows — tiny.
+                            fut.set_result(np.array(arr))
+                elif ftype == ERROR:
+                    fut = self._pop(req_id)
+                    if fut is not None:
+                        fut.set_exception(error_header_to_exception(header))
+                elif ftype == PONG:
+                    fut = self._pop(req_id)
+                    if fut is not None:
+                        fut.set_result(True)
+        except ConnectionResetError:
+            pass  # server closed between frames
+        except WireError as e:
+            err = e
+        except OSError as e:
+            err = HostUnavailableError(f"wire read failed: {e}")
+        finally:
+            self.dead = True
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            with self.inflight_lock:
+                flights, self.inflight = dict(self.inflight), {}
+            for fut in flights.values():
+                if not fut.done():
+                    fut.set_exception(
+                        err if isinstance(err, ServeError)
+                        else HostUnavailableError(str(err))
+                    )
+
+    def _pop(self, req_id: int) -> Future | None:
+        with self.inflight_lock:
+            return self.inflight.pop(req_id, None)
+
+    def send(self, frame: bytes) -> None:
+        with self.send_lock:
+            self.sock.sendall(frame)
+
+    def close(self) -> None:
+        self.dead = True
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class WireClient:
+    """The client half: a small pool of persistent connections to ONE
+    host, pipelined submits fanned across them round-robin, responses
+    matched by req_id. Reconnect-on-stale: a dead connection's in-flight
+    futures fail host-shaped (the router's re-dispatch food) and the
+    slot is re-dialed on next use."""
+
+    def __init__(self, host: str, port: int, *, pool: int = 2,
+                 connect_timeout_s: float = 2.0):
+        self._host = host
+        self._port = port
+        self._timeout = connect_timeout_s
+        self._conns: list[_WireConn | None] = [None] * max(1, int(pool))
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._next_conn = 0
+        self._closed = False
+
+    def _req_id(self) -> int:
+        with self._lock:
+            self._next_id += 1
+            return self._next_id
+
+    def _conn(self) -> _WireConn:
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("wire client closed")
+            i = self._next_conn % len(self._conns)
+            self._next_conn += 1
+            c = self._conns[i]
+            if c is None or c.dead:
+                try:
+                    c = _WireConn(self._host, self._port, self._timeout)
+                except OSError as e:
+                    raise HostUnavailableError(
+                        f"wire connect to {self._host}:{self._port} "
+                        f"failed: {e}"
+                    ) from None
+                self._conns[i] = c
+            return c
+
+    def submit(self, image: np.ndarray, *, model: str | None = None,
+               traceparent: str | None = None) -> tuple[int, Future]:
+        """Pipeline one request; returns (req_id, Future). The future
+        lands a top-k int32 array, a typed ServeError, or cancellation.
+        req_id is the CANCEL handle."""
+        image = np.ascontiguousarray(image)
+        req_id = self._req_id()
+        frame = encode_frame(
+            SUBMIT, req_id, pack_array_header(image, model, traceparent),
+            image.tobytes(),
+        )
+        conn = self._conn()
+        fut: Future = Future()
+        fut.set_running_or_notify_cancel()  # cancel() rides CANCEL frames
+        with conn.inflight_lock:
+            conn.inflight[req_id] = fut
+        try:
+            conn.send(frame)
+        except OSError as e:
+            with conn.inflight_lock:
+                conn.inflight.pop(req_id, None)
+            conn.dead = True
+            raise HostUnavailableError(f"wire submit failed: {e}") from None
+        return req_id, fut
+
+    def cancel(self, req_id: int) -> None:
+        """Best-effort CANCEL frame for ``req_id`` (the hedge-loser
+        revocation). Sent on every live pooled connection — CANCEL is
+        idempotent and an unknown req_id is a no-op server-side, so
+        over-delivery is free and under-delivery (a dead conn) is
+        already handled by that conn's teardown cancelling its
+        in-flight futures."""
+        frame = encode_frame(CANCEL, req_id)
+        with self._lock:
+            conns = [c for c in self._conns if c is not None and not c.dead]
+        for c in conns:
+            try:
+                c.send(frame)
+            except OSError:
+                pass
+
+    def ping(self, timeout_s: float = 2.0) -> bool:
+        """Handshake/liveness: PING → PONG round-trip on one pooled
+        connection (dials it if needed)."""
+        req_id = self._req_id()
+        conn = self._conn()
+        fut: Future = Future()
+        with conn.inflight_lock:
+            conn.inflight[req_id] = fut
+        conn.send(encode_frame(PING, req_id))
+        return bool(fut.result(timeout=timeout_s))
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            conns, self._conns = list(self._conns), [None]
+        for c in conns:
+            if c is not None:
+                c.close()

@@ -135,6 +135,23 @@ FAULT_GATES: dict[str, str] = {
         "kill the MPT_FAULT_SERVE_KILL_HOST host after this many requests "
         "have been dispatched to it (0 = gate off)"
     ),
+    "MPT_FAULT_WIRE_DELAY_MS": (
+        "fake a slow wire: the framed serving transport (serve/wire.py) "
+        "sleeps this many ms before writing each RESULT/ERROR frame — "
+        "requests land and execute on time, their RESPONSES crawl, which "
+        "is exactly the tail shape hedged requests exist to beat. Scoped "
+        "with MPT_FAULT_WIRE_DELAY_HOST; the hedge drill's lever"
+    ),
+    "MPT_FAULT_WIRE_DELAY_HOST": (
+        "restrict MPT_FAULT_WIRE_DELAY_MS to this fleet-host index "
+        "(unset/-1 = every host) — one laggy host, so the router's "
+        "per-host p99 deadline fires deterministically"
+    ),
+    "MPT_FAULT_WIRE_DELAY_JITTER_MS": (
+        "add a bounded DETERMINISTIC jitter (a counter-phased triangle "
+        "wave, never a PRNG) on top of MPT_FAULT_WIRE_DELAY_MS — a laggy "
+        "wire that wobbles, with a delay schedule that replays exactly"
+    ),
     "MPT_PREEMPT_FILE": (
         "path to a preemption sentinel: when the file exists, the trainer's "
         "watchdog stops at the next safe boundary, saves, and exits 0 "

@@ -321,8 +321,15 @@ def test_load_aware_dispatch_avoids_slow_host(
     """A fake-slow host (MPT_FAULT_DELAY_PROCESS targets fleet-host 0,
     MPT_FAULT_DELAY_STEP_MS delays its every dispatch) builds queue
     depth; the router's EWMA scores must observe it via the registry
-    snapshots and route the bulk of the traffic to the healthy host."""
-    monkeypatch.setenv("MPT_FAULT_DELAY_STEP_MS", "250")
+    snapshots and route the bulk of the traffic to the healthy host.
+
+    The injected delay must DOMINATE the real step time and the arrival
+    rate must be one the healthy host can actually drain — on a slow
+    single-core box, 250 ms/step against a 100 req/s wave saturated BOTH
+    hosts equally (lockstep scores, ~50/50 split) and the premise
+    collapsed. 1 s/step at 25 req/s keeps h1's queue near-empty while
+    h0 visibly wedges, on any hardware."""
+    monkeypatch.setenv("MPT_FAULT_DELAY_STEP_MS", "1000")
     monkeypatch.setenv("MPT_FAULT_DELAY_PROCESS", "0")
     fleet = _make_fleet(fleet_cfg, shared_exe)
     try:
@@ -330,7 +337,7 @@ def test_load_aware_dispatch_avoids_slow_host(
         futs = []
         for i in range(40):
             futs.append(fleet.submit(images[i % 8]))
-            time.sleep(0.01)
+            time.sleep(0.04)
         for f in futs:
             assert f.result(timeout=120).shape == (3,)
         by_host = fleet.router.stats()["dispatched_by_host"]

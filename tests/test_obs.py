@@ -507,8 +507,10 @@ def test_slo_straggler_alert_preempts_run(tmp_path, monkeypatch):
     sentinel = str(tmp_path / "preempt.sentinel")
     # The delay must dominate the noisy natural CPU step time so the 2x
     # drift ratio is unambiguous — the run preempts ~2 delayed steps in,
-    # so the extra wall cost stays at a few seconds.
-    monkeypatch.setenv("MPT_FAULT_DELAY_STEP_MS", "1500")
+    # so the extra wall cost stays at a few seconds. Natural steps on a
+    # loaded single-core box reach ~2 s, which put 1500 ms under the 2x
+    # ratio; 6 s keeps the ratio >= 3-4x on any hardware.
+    monkeypatch.setenv("MPT_FAULT_DELAY_STEP_MS", "6000")
     monkeypatch.setenv("MPT_FAULT_DELAY_AFTER_STEP", "4")
     cfg = _telemetry_cfg(
         str(tmp_path),

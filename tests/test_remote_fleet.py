@@ -921,6 +921,15 @@ def test_kill_serve_host_finds_announces_and_strikes(tmp_path):
     target = subprocess.Popen([sys.executable, "-c", sleeper, *argv_extra, "7"])
     metrics = str(tmp_path / "kill.jsonl")
     try:
+        # Between fork and exec a child's /proc cmdline still shows the
+        # PARENT's argv (no marker) — on a busy single-core box the scan
+        # can win that race. Wait until both children have exec'd.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if inject_faults.find_serve_host_pids(5) == [decoy.pid] and \
+                    inject_faults.find_serve_host_pids(7) == [target.pid]:
+                break
+            time.sleep(0.05)
         pids = inject_faults.find_serve_host_pids(7)
         assert pids == [target.pid]
         assert inject_faults.main(

@@ -1,0 +1,775 @@
+"""Framed wire transport tests (ISSUE 16).
+
+Four layers, mirroring the module split:
+
+- the pure frame codec (round-trips across every wire dtype; every
+  refusal typed and immediate — truncated, malformed, oversized,
+  version-skewed frames raise, never hang);
+- WireListener + WireClient against a jax-free fake submit_fn
+  (pipelining, out-of-order completion, CANCEL, typed errors across the
+  wire, connection-death host-shaping);
+- WireHost + ServingHost(wire=True) end to end, including the router
+  hedge drill under an injected wire delay (exactly-once resolution,
+  loser revoked);
+- the real InferenceServer's zero-copy ledger (copies_per_request ==
+  1.0 — the bytes-touched-once invariant as a number).
+"""
+
+import socket
+import struct
+import time
+from concurrent.futures import CancelledError, Future
+
+import numpy as np
+import pytest
+
+
+def _wait_for(cond, timeout_s=5.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------- codec
+
+
+def test_frame_roundtrip_every_wire_dtype():
+    from mpi_pytorch_tpu.serve import wire
+
+    rng = np.random.default_rng(0)
+    for token, dtype in wire._DTYPE_BY_TOKEN.items():
+        if dtype == np.bool_:
+            arr = rng.integers(0, 2, size=(2, 3)).astype(dtype)
+        elif np.issubdtype(dtype, np.floating):
+            arr = rng.standard_normal((4, 2, 3)).astype(dtype)
+        else:
+            arr = rng.integers(0, 100, size=(5,)).astype(dtype)
+        frame = wire.encode_frame(
+            wire.SUBMIT, 42,
+            wire.pack_array_header(arr, "resnet18", "00-aa-bb-01"),
+            arr.tobytes(),
+        )
+        ftype, req_id, hlen, plen = wire.decode_prefix(frame)
+        assert (ftype, req_id) == (wire.SUBMIT, 42)
+        header = frame[wire.PREFIX_LEN:wire.PREFIX_LEN + hlen]
+        payload = frame[wire.PREFIX_LEN + hlen:wire.PREFIX_LEN + hlen + plen]
+        out, model, trace = wire.decode_array(header, payload)
+        assert out.dtype == dtype and out.shape == arr.shape
+        np.testing.assert_array_equal(out, arr)
+        assert (model, trace) == ("resnet18", "00-aa-bb-01")
+
+
+def test_decode_array_is_a_view_not_a_copy():
+    """The zero-copy contract at the codec layer: the decoded array is a
+    view over the received payload buffer (the ONE copy happens later,
+    straight into the pooled bucket slot)."""
+    from mpi_pytorch_tpu.serve import wire
+
+    arr = np.arange(24, dtype=np.uint8).reshape(2, 3, 4)
+    header = wire.pack_array_header(arr)
+    payload = arr.tobytes()
+    out, _, _ = wire.decode_array(header, payload)
+    assert not out.flags.owndata  # frombuffer view, no allocation
+    assert not out.flags.writeable  # bytes-backed — a copy would be writable
+
+
+def test_empty_model_and_trace_decode_to_none():
+    from mpi_pytorch_tpu.serve import wire
+
+    arr = np.zeros((2,), np.int32)
+    _out, model, trace = wire.decode_array(
+        wire.pack_array_header(arr), arr.tobytes()
+    )
+    assert model is None and trace is None
+
+
+def test_typed_frame_rejections():
+    from mpi_pytorch_tpu.serve import wire
+
+    # Truncated prefix: typed, immediate.
+    with pytest.raises(wire.TruncatedFrameError):
+        wire.decode_prefix(b"MPTW\x01")
+    # Bad magic.
+    bad = wire.PREFIX.pack(b"HTTP", wire.WIRE_VERSION, wire.SUBMIT, 0,
+                           1, 0, 0)
+    with pytest.raises(wire.MalformedFrameError):
+        wire.decode_prefix(bad)
+    # Version skew refuses loudly (never misparses a future layout).
+    skew = wire.PREFIX.pack(wire.MAGIC, 99, wire.SUBMIT, 0, 1, 0, 0)
+    with pytest.raises(wire.WireVersionError):
+        wire.decode_prefix(skew)
+    # Unknown frame type.
+    unk = wire.PREFIX.pack(wire.MAGIC, wire.WIRE_VERSION, 200, 0, 1, 0, 0)
+    with pytest.raises(wire.MalformedFrameError):
+        wire.decode_prefix(unk)
+    # Oversized declared lengths are rejected from the prefix ALONE —
+    # before any allocation could happen.
+    big = wire.PREFIX.pack(wire.MAGIC, wire.WIRE_VERSION, wire.SUBMIT, 0,
+                           1, 0, wire.MAX_PAYLOAD_BYTES + 1)
+    with pytest.raises(wire.FrameTooLargeError):
+        wire.decode_prefix(big)
+    # The encoder enforces the same caps.
+    with pytest.raises(wire.FrameTooLargeError):
+        wire.encode_frame(wire.SUBMIT, 1, b"x" * (wire.MAX_HEADER_BYTES + 1))
+    with pytest.raises(wire.MalformedFrameError):
+        wire.encode_frame(77, 1)
+
+
+def test_typed_header_rejections():
+    from mpi_pytorch_tpu.serve import wire
+
+    arr = np.zeros((4,), np.float32)
+    # Unparseable / unknown-token array headers.
+    with pytest.raises(wire.MalformedFrameError):
+        wire.unpack_array_header(b"\x01")
+    with pytest.raises(wire.MalformedFrameError):
+        wire.unpack_array_header(
+            struct.pack("<BB", 99, 1) + struct.pack("<I", 4) + b"\0\0\0\0"
+        )
+    # Non-wire dtype never encodes (closed set — not a pickle).
+    with pytest.raises(wire.MalformedFrameError):
+        wire.pack_array_header(np.zeros((2,), np.complex64))
+    # Payload length must match dtype × shape exactly.
+    with pytest.raises(wire.MalformedFrameError):
+        wire.decode_array(wire.pack_array_header(arr), arr.tobytes()[:-1])
+    # Unknown error kind.
+    with pytest.raises(wire.MalformedFrameError):
+        wire.error_header_to_exception(
+            wire.encode_error_header(222, "from the future")
+        )
+
+
+def test_error_taxonomy_survives_the_wire():
+    """Every typed serving failure maps to an ERROR header and BACK to
+    the exact class — the router's request-vs-host-shaped logic must
+    need no transport branches."""
+    from mpi_pytorch_tpu.serve import wire
+    from mpi_pytorch_tpu.serve.batcher import (
+        HostUnavailableError,
+        ModelNotResidentError,
+        PreprocessError,
+        QueueFullError,
+        ServeError,
+        ServerClosedError,
+        UnknownModelError,
+    )
+
+    qf = QueueFullError("full", retry_after_ms=123.5, model="vit")
+    back = wire.error_header_to_exception(wire.exception_to_error_header(qf))
+    assert isinstance(back, QueueFullError)
+    assert back.retry_after_ms == 123.5 and back.model == "vit"
+
+    for exc, want in [
+        (ServerClosedError("bye"), ServerClosedError),
+        (UnknownModelError("who"), UnknownModelError),
+        (ModelNotResidentError("cold"), ModelNotResidentError),
+        (PreprocessError("bad pixels"), PreprocessError),
+        (ServeError("request-shaped"), ServeError),
+        (CancelledError(), CancelledError),
+        # Anything non-ServeError server-side is host-shaped to clients.
+        (RuntimeError("device exploded"), HostUnavailableError),
+    ]:
+        back = wire.error_header_to_exception(
+            wire.exception_to_error_header(exc)
+        )
+        assert type(back) is want, (exc, back)
+
+
+# --------------------------------------------- listener + client (jax-free)
+
+
+class FakeWireBackend:
+    """submit_fn target: records submissions, resolves (or holds)
+    futures without any serving stack behind it."""
+
+    def __init__(self):
+        from mpi_pytorch_tpu.serve.batcher import QueueFullError
+
+        self._QueueFullError = QueueFullError
+        self.mode = "ok"  # ok | pending | reject
+        self.submits = []  # (image copy, model, trace)
+        self.futures = []
+
+    def submit_fn(self, image, model, trace):
+        self.submits.append((np.array(image), model, trace))
+        if self.mode == "reject":
+            raise self._QueueFullError(
+                "queue full", retry_after_ms=321.0, model=model
+            )
+        fut = Future()
+        self.futures.append(fut)
+        if self.mode == "ok":
+            fut.set_result(
+                np.full((3,), int(np.asarray(image).reshape(-1)[0]),
+                        np.int32)
+            )
+        return fut
+
+
+@pytest.fixture()
+def framed():
+    """A live (backend, WireListener, WireClient) triple on loopback."""
+    from mpi_pytorch_tpu.serve.wire import WireClient, WireListener
+
+    backend = FakeWireBackend()
+    listener = WireListener(backend.submit_fn, host_index=0)
+    client = WireClient("127.0.0.1", listener.port, pool=1)
+    yield backend, listener, client
+    client.close()
+    listener.close()
+
+
+def test_wire_submit_roundtrip_and_metadata(framed):
+    backend, _listener, client = framed
+    img = np.full((4, 4, 3), 7, np.uint8)
+    req_id, fut = client.submit(img, model="resnet18",
+                                traceparent="00-ab-cd-01")
+    out = fut.result(timeout=5)
+    np.testing.assert_array_equal(out, np.full((3,), 7, np.int32))
+    assert out.dtype == np.int32 and req_id > 0
+    got, model, trace = backend.submits[0]
+    np.testing.assert_array_equal(got, img)
+    assert (model, trace) == ("resnet18", "00-ab-cd-01")
+
+
+def test_out_of_order_completion_no_head_of_line_blocking(framed):
+    """Two pipelined requests on ONE connection; the second completes
+    first — the whole point of response matching by req_id."""
+    backend, _listener, client = framed
+    backend.mode = "pending"
+    _r1, fut1 = client.submit(np.full((2, 2), 1, np.uint8))
+    _r2, fut2 = client.submit(np.full((2, 2), 2, np.uint8))
+    _wait_for(lambda: len(backend.futures) == 2, what="both submits")
+    backend.futures[1].set_result(np.full((3,), 2, np.int32))
+    np.testing.assert_array_equal(
+        fut2.result(timeout=5), np.full((3,), 2, np.int32)
+    )
+    assert not fut1.done()  # the slow request blocked nobody
+    backend.futures[0].set_result(np.full((3,), 1, np.int32))
+    np.testing.assert_array_equal(
+        fut1.result(timeout=5), np.full((3,), 1, np.int32)
+    )
+
+
+def test_ping_pong_handshake(framed):
+    _backend, _listener, client = framed
+    assert client.ping(timeout_s=5.0) is True
+
+
+def test_typed_error_crosses_the_wire(framed):
+    from mpi_pytorch_tpu.serve.batcher import QueueFullError
+
+    backend, _listener, client = framed
+    backend.mode = "reject"
+    _rid, fut = client.submit(np.zeros((2, 2), np.uint8), model="vit")
+    with pytest.raises(QueueFullError) as ei:
+        fut.result(timeout=5)
+    # The 429 hints rode the wire as fields, not prose.
+    assert ei.value.retry_after_ms == 321.0
+    assert ei.value.model == "vit"
+
+
+def test_cancel_revokes_server_side_and_resolves_client_side(framed):
+    backend, _listener, client = framed
+    backend.mode = "pending"
+    req_id, fut = client.submit(np.zeros((2, 2), np.uint8))
+    # The client future is in running state: local cancel() is refused —
+    # revocation is the CANCEL frame's job, not the local future's.
+    assert fut.cancel() is False
+    _wait_for(lambda: backend.futures, what="server-side submit")
+    client.cancel(req_id)
+    _wait_for(lambda: backend.futures[0].cancelled(),
+              what="server-side revocation")
+    with pytest.raises(CancelledError):
+        fut.result(timeout=5)
+
+
+def test_cancel_unknown_req_id_is_a_noop(framed):
+    _backend, _listener, client = framed
+    client.cancel(999999)  # must not raise, poison the stream, or hang
+    _rid, fut = client.submit(np.full((2, 2), 5, np.uint8))
+    np.testing.assert_array_equal(
+        fut.result(timeout=5), np.full((3,), 5, np.int32)
+    )
+
+
+def test_malformed_stream_is_refused_then_torn_down(framed):
+    """Garbage on a fresh connection: one typed ERROR frame (req_id 0)
+    comes back, then the server hangs up — a framing error poisons the
+    stream, it is never resynced."""
+    from mpi_pytorch_tpu.serve import wire
+    from mpi_pytorch_tpu.serve.batcher import ServeError
+
+    _backend, listener, _client = framed
+    sock = socket.create_connection(("127.0.0.1", listener.port), timeout=5)
+    try:
+        sock.sendall(b"GET / HTTP/1.1\r\n" + b"\x00" * 16)
+        ftype, req_id, header, _payload = wire.read_frame(sock)
+        assert (ftype, req_id) == (wire.ERROR, 0)
+        assert isinstance(wire.error_header_to_exception(header), ServeError)
+        sock.settimeout(5)
+        try:
+            assert sock.recv(1) == b""  # FIN: stream closed
+        except ConnectionResetError:
+            pass  # RST (unread bytes in the server's buffer): also closed
+    finally:
+        sock.close()
+
+
+def test_listener_death_fails_inflight_host_shaped(framed):
+    """A dead connection's in-flight futures fail with the host-shaped
+    error — the router's re-dispatch food, same verdict as the HTTP
+    twin."""
+    from mpi_pytorch_tpu.serve.batcher import HostUnavailableError
+
+    backend, listener, client = framed
+    backend.mode = "pending"
+    _rid, fut = client.submit(np.zeros((2, 2), np.uint8))
+    _wait_for(lambda: backend.submits, what="submit to land")
+    listener.close()
+    with pytest.raises(HostUnavailableError):
+        fut.result(timeout=5)
+
+
+# ----------------------------------------------------- chaos: slow wire
+
+
+def test_wire_delay_gate_targets_one_host(monkeypatch):
+    from mpi_pytorch_tpu.serve import wire
+
+    assert wire.maybe_fault_wire_delay(0) == 0.0  # cold gate: free
+    monkeypatch.setenv("MPT_FAULT_WIRE_DELAY_MS", "30")
+    monkeypatch.setenv("MPT_FAULT_WIRE_DELAY_HOST", "1")
+    t0 = time.monotonic()
+    assert wire.maybe_fault_wire_delay(0) == 0.0  # not the target
+    assert time.monotonic() - t0 < 0.02
+    slept = wire.maybe_fault_wire_delay(1)
+    assert slept == 30.0
+    monkeypatch.setenv("MPT_FAULT_WIRE_DELAY_HOST", "-1")
+    assert wire.maybe_fault_wire_delay(0) == 30.0  # -1 = every host
+
+
+def test_wire_delay_jitter_is_deterministic(monkeypatch):
+    from mpi_pytorch_tpu.serve import wire
+
+    monkeypatch.setenv("MPT_FAULT_WIRE_DELAY_MS", "10")
+    monkeypatch.setenv("MPT_FAULT_WIRE_DELAY_JITTER_MS", "4")
+    monkeypatch.setattr(wire, "_jitter_phase", 0)
+    first = [wire.maybe_fault_wire_delay(0) for _ in range(3)]
+    monkeypatch.setattr(wire, "_jitter_phase", 0)
+    second = [wire.maybe_fault_wire_delay(0) for _ in range(3)]
+    assert first == second == [13.0, 12.0, 11.0]  # triangle, not a PRNG
+    assert all(10.0 <= d <= 14.0 for d in first)
+
+
+# ------------------------------------------------- WireHost + ServingHost
+
+
+class FakeInferenceServer:
+    """Duck-typed server for ServingHost: the wire path without jax."""
+
+    host_index = 0
+
+    def __init__(self, topk=3, value=None):
+        self.topk = topk
+        self.value = value  # None → echo first pixel
+        self.mode = "ok"  # ok | pending
+        self.submits = 0
+        self.pending = []
+        self.closed = False
+
+    def submit(self, image, trace=None):
+        self.submits += 1
+        fut = Future()
+        if self.mode == "pending":
+            self.pending.append(fut)
+            return fut
+        v = self.value
+        if v is None:
+            v = int(np.asarray(image).reshape(-1)[0])
+        fut.set_result(np.full((self.topk,), v, np.int32))
+        return fut
+
+    def _healthz(self):
+        return {
+            "status": "closing" if self.closed else "ok",
+            "queue_depth": 0, "compiles_after_warmup": 0,
+            "served": self.submits, "rejected": 0, "buckets": [1, 4],
+            "precision": "bf16", "queue_capacity": 8, "max_wait_ms": 2.0,
+            "active_buckets": [1, 4], "precisions": ["bf16"],
+            "parity_top1": None, "topk": self.topk,
+            "host_index": self.host_index, "pid": None,
+        }
+
+    def close(self, drain=True):
+        self.closed = True
+
+
+def _make_framed_host(name, index, value):
+    from mpi_pytorch_tpu.serve.client import WireHost
+    from mpi_pytorch_tpu.serve.host import ServingHost
+
+    server = FakeInferenceServer(value=value)
+    server.host_index = index
+    host = ServingHost(server, port=0, wire=True)
+    whost = WireHost(
+        f"http://127.0.0.1:{host.port}", name=name, index=index,
+        poll_slice_s=0.2, result_timeout_s=5.0, probe_retries=1,
+    )
+    return server, host, whost
+
+
+@pytest.fixture()
+def framed_host():
+    server, host, whost = _make_framed_host("h0", 0, value=None)
+    yield server, host, whost
+    whost._pool.shutdown(wait=False, cancel_futures=True)
+    whost._wire.close()
+    host.close()
+
+
+def test_wirehost_discovers_port_and_serves(framed_host):
+    """wire_port rides /healthz: the HTTP surface IS the handshake."""
+    server, host, whost = framed_host
+    assert whost.transport == "framed"
+    assert whost.wire_port == host.wire_port
+    fut = whost.submit(np.full((4, 4, 3), 9, np.uint8))
+    np.testing.assert_array_equal(
+        fut.result(timeout=5), np.full((3,), 9, np.int32)
+    )
+    assert whost.ping_wire() is True
+    # Control plane is inherited HTTP: same host facts, same probes.
+    assert whost.alive() is True
+
+
+def test_wirehost_cancel_sends_the_cancel_frame(framed_host):
+    server, _host, whost = framed_host
+    server.mode = "pending"
+    fut = whost.submit(np.zeros((4, 4, 3), np.uint8))
+    _wait_for(lambda: server.pending, what="server-side submit")
+    whost.cancel(fut)
+    _wait_for(lambda: server.pending[0].cancelled(),
+              what="server-side revocation")
+    with pytest.raises(CancelledError):
+        fut.result(timeout=5)
+
+
+def test_wirehost_refuses_http_only_host():
+    """Against a host running without the framed listener the typed
+    verdict is immediate — not a hang on a port that never answers."""
+    from mpi_pytorch_tpu.serve.batcher import HostUnavailableError
+    from mpi_pytorch_tpu.serve.client import WireHost
+    from mpi_pytorch_tpu.serve.host import ServingHost
+
+    server = FakeInferenceServer()
+    host = ServingHost(server, port=0)  # wire=False
+    try:
+        with pytest.raises(HostUnavailableError):
+            WireHost(f"http://127.0.0.1:{host.port}", name="h9", index=9,
+                     probe_retries=1)
+    finally:
+        host.close()
+
+
+def test_remotehost_reuses_keepalive_connections(framed_host):
+    """Satellite: the control plane parks its connection instead of
+    dialing per request."""
+    _server, _host, whost = framed_host
+    assert whost.alive() is True
+    _wait_for(lambda: whost._conns, what="a parked connection")
+    conn = whost._conns[0]
+    for _ in range(3):
+        assert whost.alive() is True
+    assert len(whost._conns) == 1
+    assert whost._conns[0] is conn  # same socket, reused
+
+
+# ------------------------------------------------------------ hedge drill
+
+
+class _Recorder:
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append(dict(rec))
+
+    def hedge_records(self):
+        return [r for r in self.records if r.get("kind") == "hedge"]
+
+
+class SilentHost:
+    """Router-unit host: accepts submits, optionally never resolves."""
+
+    transport = "local"
+
+    def __init__(self, name, index, respond=True):
+        self.name = name
+        self.index = index
+        self.respond = respond
+        self.queue_capacity = 8
+        self.submitted = 0
+        self.pending = []
+        self.closed = False
+        self.queue_depth = 0
+
+    def submit(self, payload):
+        self.submitted += 1
+        fut = Future()
+        if self.respond:
+            fut.set_result(np.full((3,), self.index, np.int32))
+        else:
+            self.pending.append(fut)
+        return fut
+
+    def snapshot(self):
+        return {"counters": {}, "gauges": {"serve/queue_depth": 0.0},
+                "histograms": {}}
+
+    def alive(self):
+        return not self.closed
+
+    def qsize(self):
+        return self.queue_depth
+
+    def stats(self):
+        return {"served": self.submitted, "rejected": 0, "padded_rows": 0,
+                "compiles_after_warmup": 0}
+
+    def compiles_after_warmup(self):
+        return 0
+
+    def close(self, drain=True):
+        self.closed = True
+
+    def kill(self):
+        self.closed = True
+
+
+def _prime_scores(router, scores):
+    """Pin the dispatch scores so the drill's primary pick is
+    deterministic (fresh snapshots, no probe race — the probe interval
+    is set far beyond the test)."""
+    now = time.monotonic()
+    with router._lock:
+        for name, score in scores.items():
+            router._state[name].score = float(score)
+            router._state[name].snapshot_t = now
+
+
+def test_hedge_fires_resolves_exactly_once_and_revokes_loser():
+    from mpi_pytorch_tpu.serve.fleet.router import FleetRouter
+
+    slow = SilentHost("slow", 0, respond=False)  # never answers
+    fast = SilentHost("fast", 1)
+    rec = _Recorder()
+    router = FleetRouter(
+        [slow, fast], metrics=rec, hedge=True, hedge_floor_ms=40.0,
+        probe_interval_s=30.0, stale_after_s=60.0,
+    )
+    try:
+        _prime_scores(router, {"slow": 0.0, "fast": 5.0})
+        fut = router.submit(np.zeros((2, 2), np.uint8))
+        out = fut.result(timeout=5)
+        # The hedge (to the second-best host) won; the request resolved
+        # EXACTLY once, with the winner's result.
+        np.testing.assert_array_equal(out, np.full((3,), 1, np.int32))
+        assert slow.submitted == 1 and fast.submitted == 1
+        stats = router.stats()
+        assert stats["hedges"] == 1 and stats["hedge_wins"] == 1
+        assert stats["inflight"] == 0
+        assert stats["tokens_free"] == stats["budget"]  # token returned once
+        # The loser was revoked — it never occupies a batch slot.
+        _wait_for(lambda: slow.pending[0].cancelled(),
+                  what="loser revocation")
+        _wait_for(lambda: rec.hedge_records(), what="the hedge record")
+        (hrec,) = rec.hedge_records()
+        assert hrec["winner"] == "fast" and hrec["loser"] == "slow"
+        assert hrec["cancelled"] == 1
+        assert hrec["deadline_ms"] == 40.0  # no samples yet → the floor
+    finally:
+        router.close()
+
+
+def test_fast_primary_never_hedges():
+    from mpi_pytorch_tpu.serve.fleet.router import FleetRouter
+
+    a, b = SilentHost("a", 0), SilentHost("b", 1)
+    rec = _Recorder()
+    router = FleetRouter(
+        [a, b], metrics=rec, hedge=True, hedge_floor_ms=40.0,
+        probe_interval_s=30.0, stale_after_s=60.0,
+    )
+    try:
+        _prime_scores(router, {"a": 0.0, "b": 5.0})
+        for i in range(5):
+            router.submit(np.zeros((2, 2), np.uint8)).result(timeout=5)
+        time.sleep(0.15)  # past any armed deadline
+        stats = router.stats()
+        assert stats["hedges"] == 0 and stats["hedge_wins"] == 0
+        assert b.submitted == 0  # every request resolved on the primary
+        assert rec.hedge_records() == []
+    finally:
+        router.close()
+
+
+def test_stats_omit_hedge_counters_when_off():
+    from mpi_pytorch_tpu.serve.fleet.router import FleetRouter
+
+    router = FleetRouter(
+        [SilentHost("a", 0)], probe_interval_s=30.0, stale_after_s=60.0,
+    )
+    try:
+        assert "hedges" not in router.stats()  # absent-when-off: old
+        assert "hedge_wins" not in router.stats()  # streams stay identical
+    finally:
+        router.close()
+
+
+def test_hedge_drill_over_framed_wire_with_injected_delay(monkeypatch):
+    """The ISSUE's acceptance drill, end to end: two framed hosts, the
+    wire-delay gate slows host 0's response path, the router hedges to
+    host 1 after the floor deadline, the request resolves exactly once
+    with the fast host's answer, and the loser is revoked with a CANCEL
+    frame."""
+    from mpi_pytorch_tpu.serve.fleet.router import FleetRouter
+
+    monkeypatch.setenv("MPT_FAULT_WIRE_DELAY_MS", "400")
+    monkeypatch.setenv("MPT_FAULT_WIRE_DELAY_HOST", "0")
+    s0, h0, w0 = _make_framed_host("h0", 0, value=0)
+    s1, h1, w1 = _make_framed_host("h1", 1, value=1)
+    rec = _Recorder()
+    router = FleetRouter(
+        [w0, w1], metrics=rec, hedge=True, hedge_floor_ms=50.0,
+        probe_interval_s=30.0, stale_after_s=60.0,
+    )
+    try:
+        _prime_scores(router, {"h0": 0.0, "h1": 5.0})
+        fut = router.submit(np.zeros((4, 4, 3), np.uint8))
+        out = fut.result(timeout=5)
+        np.testing.assert_array_equal(out, np.full((3,), 1, np.int32))
+        _wait_for(lambda: rec.hedge_records(), what="the hedge record")
+        (hrec,) = rec.hedge_records()
+        assert hrec["winner"] == "h1" and hrec["loser"] == "h0"
+        stats = router.stats()
+        assert stats["hedges"] == 1 and stats["hedge_wins"] == 1
+        # Exactly-once under the late loser: host 0's delayed RESULT
+        # eventually lands and must be a no-op (the claim ledger already
+        # paid out) — not a double resolution, error, or host strike.
+        time.sleep(0.6)
+        stats = router.stats()
+        assert stats["hedges"] == 1 and stats["hedge_wins"] == 1
+        assert stats["inflight"] == 0 and stats["failovers"] == []
+        assert stats["tokens_free"] == stats["budget"]
+        np.testing.assert_array_equal(fut.result(), out)  # unchanged
+    finally:
+        monkeypatch.delenv("MPT_FAULT_WIRE_DELAY_MS")
+        router.close()
+        for whost, host in ((w0, h0), (w1, h1)):
+            whost._pool.shutdown(wait=False, cancel_futures=True)
+            whost._wire.close()
+            host.close()
+
+
+# --------------------------------------------------- zero-copy ledger (jax)
+
+
+@pytest.fixture(scope="module")
+def real_server(tmp_path_factory):
+    """A real InferenceServer with the same shapes as tests/test_serve.py
+    (in-process XLA compile cache makes the second compile cheap)."""
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.serve import InferenceServer
+
+    scratch = tmp_path_factory.mktemp("wire_serve")
+    cfg = Config(
+        model_name="resnet18", num_classes=32, width=32, height=32,
+        synthetic_data=True, compute_dtype="float32",
+        serve_buckets="1,8", serve_max_wait_ms=5.0, serve_topk=3,
+        serve_queue_depth=64, loader_workers=4,
+        metrics_file=str(scratch / "wire_serve_metrics.jsonl"),
+        log_file="", eval_log_file="",
+    )
+    cfg.validate_config()
+    srv = InferenceServer(cfg, load_checkpoint=False)
+    yield srv
+    srv.close()
+
+
+def test_zero_copy_ledger_is_exactly_one_copy_per_request(real_server):
+    """The tentpole invariant as a number: between arrival and
+    device_put each request's pixels are touched ONCE (straight into the
+    pooled, bucket-padded buffer the executable consumes)."""
+    rng = np.random.default_rng(1)
+    images = [
+        rng.integers(0, 256, size=(32, 32, 3)).astype(np.uint8)
+        for _ in range(13)
+    ]
+    preds = real_server.predict_batch(images, timeout=120)
+    assert preds.shape == (13, 3)
+    stats = real_server.stats()
+    assert stats["input_copies"] == stats["served"]
+    assert stats["copies_per_request"] == 1.0
+    allocs = stats["buffer_allocations"]
+    assert allocs >= 1
+    # Steady state: another round must serve from the recycled pool,
+    # not allocate fresh buffers.
+    real_server.predict_batch(images, timeout=120)
+    stats = real_server.stats()
+    assert stats["copies_per_request"] == 1.0
+    assert stats["buffer_allocations"] <= allocs + 1
+
+
+def test_cancel_before_assembly_frees_the_batch_slot(real_server):
+    """A request revoked while still queued is swept before bucket
+    assembly: counted as cancelled, never served, no inference run."""
+    # Bucket 1 would flush a lone request instantly; pin the active set
+    # to 8 so the request sits out the deadline — revocable in-queue.
+    real_server.set_active_buckets((8,))
+    real_server.set_max_wait_ms(200.0)
+    try:
+        served0 = real_server.stats()["served"]
+        cancelled0 = real_server.stats()["cancelled"]
+        fut = real_server.submit(np.zeros((32, 32, 3), np.uint8))
+        assert fut.cancel() is True  # still queued — revocable
+        _wait_for(
+            lambda: real_server.stats()["cancelled"] == cancelled0 + 1,
+            what="the cancel sweep",
+        )
+        assert real_server.stats()["served"] == served0
+    finally:
+        real_server.set_max_wait_ms(5.0)
+        real_server.set_active_buckets((1, 8))
+
+
+def test_child_argv_never_forwards_hedge_knobs(tmp_path):
+    """Hedging is a ROUTER decision: a spawned serving-host child is a
+    single host, and forwarding serve_hedge trips its >=2-fleet-hosts
+    validation before the child ever reports ready (the bench --hedge
+    leg died exactly this way). The child argv must still carry the
+    framed transport — that is what mounts the wire listener — and
+    re-parsing the argv must build a VALID single-host config."""
+    from mpi_pytorch_tpu.config import Config, parse_config
+    from mpi_pytorch_tpu.serve.fleet.remote import child_host_args
+
+    cfg = Config()
+    cfg.serve_fleet_hosts = 3
+    cfg.serve_transport = "framed"
+    cfg.serve_hedge = True
+    cfg.serve_hedge_factor = 2.5
+    cfg.serve_hedge_floor_ms = 15.0
+    argv = child_host_args(
+        cfg, 1, str(tmp_path / "port"), str(tmp_path / "metrics.jsonl"))
+
+    assert "--serve-hedge" not in argv
+    assert "--serve-hedge-factor" not in argv
+    assert "--serve-hedge-floor-ms" not in argv
+    assert argv[argv.index("--serve-transport") + 1] == "framed"
+
+    child = parse_config(argv)
+    assert child.serve_transport == "framed"
+    assert child.serve_hedge is False
+    assert child.serve_host_index == 1

@@ -332,6 +332,14 @@ def run_point_tenants(server, pool, models, weights, *, mode, requests,
     return rows
 
 
+def _sum_host_stat(stats: dict, key: str) -> int:
+    """Sum ``key`` across a fleet's per-host stats (or read it straight
+    off a single server's stats)."""
+    if "hosts" in stats:
+        return sum(s.get(key, 0) for s in stats["hosts"].values())
+    return stats.get(key, 0)
+
+
 def run_point(server, pool, *, mode, requests, concurrency, rps, seed, timeout_s,
               fleet_hosts=0):
     stats0 = server.stats()
@@ -360,6 +368,16 @@ def run_point(server, pool, *, mode, requests, concurrency, rps, seed, timeout_s
         "compiles_after_warmup": stats1["compiles_after_warmup"],
         **_percentiles(lat_ms),
     }
+    # Zero-copy assertion (ISSUE 16): input bytes touched exactly once
+    # between the transport and device_put — the ledger-checked number.
+    copies = _sum_host_stat(stats1, "input_copies") - _sum_host_stat(
+        stats0, "input_copies"
+    )
+    if served > 0 and copies > 0:
+        row["copies_per_request"] = round(copies / served, 6)
+    hedges1 = stats1.get("router", {}).get("hedges")
+    if hedges1 is not None:
+        row["hedged"] = hedges1 - (stats0.get("router", {}).get("hedges") or 0)
     if fleet_hosts:
         row["fleet_hosts"] = fleet_hosts
         row["per_host"] = _per_host_breakdown(
@@ -393,12 +411,22 @@ def main() -> int:
                     "instead of a single server; rows gain fleet_hosts + "
                     "the per_host fill/latency breakdown")
     ap.add_argument("--transport", default="local",
-                    choices=("local", "remote"),
+                    choices=("local", "remote", "framed"),
                     help="remote (needs --fleet N): each host is a REAL "
                     "python -m mpi_pytorch_tpu.serve.host subprocess and "
                     "requests cross the wire (serve/fleet/remote.py); rows "
                     "gain transport='http' so check_regression never "
-                    "compares them against in-process baselines")
+                    "compares them against in-process baselines. framed "
+                    "(ISSUE 16): same subprocess fleet, but the data plane "
+                    "is the binary framed wire (serve/wire.py — persistent "
+                    "pooled connections, pipelining, CANCEL); rows stamp "
+                    "transport='framed' (its own trend line)")
+    ap.add_argument("--hedge", action="store_true",
+                    help="with --transport framed and --fleet >= 2: hedge "
+                    "tail requests to the second-best host after a per-host "
+                    "p99-derived deadline, first completion wins, loser "
+                    "CANCELled over the wire; rows stamp "
+                    "transport='framed+hedge' and the hedged count")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--timeout-s", type=float, default=120.0)
     ap.add_argument("--fused-head", action="store_true",
@@ -464,8 +492,14 @@ def main() -> int:
     from mpi_pytorch_tpu.config import Config
     from mpi_pytorch_tpu.serve import FleetServer, InferenceServer, RemoteFleet
 
-    if args.transport == "remote" and args.fleet <= 0:
-        print("--transport remote needs --fleet N (N >= 1)", file=sys.stderr)
+    if args.transport in ("remote", "framed") and args.fleet <= 0:
+        print(f"--transport {args.transport} needs --fleet N (N >= 1)",
+              file=sys.stderr)
+        return 2
+    if args.hedge and (args.transport != "framed" or args.fleet < 2):
+        print("--hedge needs --transport framed and --fleet >= 2 (a hedge "
+              "needs a second host and a CANCEL-capable wire)",
+              file=sys.stderr)
         return 2
     if args.trace_sample_rate > 0 and args.fleet <= 0:
         # The trace id is minted at the FRONT DOOR, which is the fleet
@@ -474,7 +508,7 @@ def main() -> int:
               "minting front door)", file=sys.stderr)
         return 2
     cache_dir = ""
-    if args.transport == "remote":
+    if args.transport in ("remote", "framed"):
         # Remote hosts are fresh processes: a shared persistent
         # compilation cache is what keeps an N-host build at ~one compile
         # set (the warm-start recipe, docs/SERVING.md "Remote fleet").
@@ -540,6 +574,9 @@ def main() -> int:
             serve_precision=serve_precision,
             serve_models=args.models,
             serve_pack_budget_mb=args.pack_budget_mb,
+            serve_transport="framed" if args.transport == "framed"
+            else "http",
+            serve_hedge=args.hedge,
             compilation_cache_dir=cache_dir,
             trace_sample_rate=args.trace_sample_rate,
             # The collector is what derives the per-phase breakdown; a
@@ -549,7 +586,7 @@ def main() -> int:
             metrics_file="", log_file="", eval_log_file="",
         )
         cfg.validate_config()
-        if args.transport == "remote":
+        if args.transport in ("remote", "framed"):
             server = RemoteFleet(cfg)
         elif args.fleet > 0:
             server = FleetServer(cfg, load_checkpoint=False)
@@ -602,6 +639,11 @@ def main() -> int:
                             )
                             if args.transport == "remote":
                                 row["transport"] = "http"
+                            elif args.transport == "framed":
+                                row["transport"] = (
+                                    "framed+hedge" if args.hedge
+                                    else "framed"
+                                )
                             if per_phase and not tenant_models:
                                 # Per-phase spans are not tenant-split:
                                 # attach only to single-model rows.

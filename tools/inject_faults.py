@@ -166,6 +166,9 @@ def fault_env(
     nonfinite_at_step: int | None = None,
     decode_fail: int | None = None,
     preempt_at_step: int | None = None,
+    wire_delay_ms: int | None = None,
+    wire_delay_host: int | None = None,
+    wire_delay_jitter_ms: int | None = None,
     base: dict | None = None,
 ) -> dict:
     """The env-var dict arming the in-process gates — hand it to a trainer
@@ -185,6 +188,9 @@ def fault_env(
         "MPT_FAULT_NONFINITE_AT_STEP": nonfinite_at_step,
         "MPT_FAULT_DECODE_N": decode_fail,
         "MPT_FAULT_PREEMPT_AT_STEP": preempt_at_step,
+        "MPT_FAULT_WIRE_DELAY_MS": wire_delay_ms,
+        "MPT_FAULT_WIRE_DELAY_HOST": wire_delay_host,
+        "MPT_FAULT_WIRE_DELAY_JITTER_MS": wire_delay_jitter_ms,
     }
     env = dict(base) if base else {}
     for name, value in values.items():
