@@ -236,10 +236,16 @@ class RemoteHost:
     def _request_once(
         self, method: str, path: str, body: bytes | None,
         timeout: float, ctype: str, headers: dict | None,
+        idempotent: bool = True,
     ) -> bytes:
         """One wire call on a (pooled) persistent connection. Raises
         ``_StaleConnection`` when a REUSED connection died on first
-        touch — the keep-alive race, retried fresh by the caller."""
+        touch — the keep-alive race, retried fresh by the caller —
+        but ONLY for idempotent calls: a broken reused connection may
+        have died AFTER the server accepted the request, so a silent
+        retry of ``POST /submit`` would dispatch a duplicate inference.
+        Non-idempotent calls surface the break as
+        ``HostUnavailableError`` and let the router decide."""
         url = self.base_url + path
         conn, reused = self._checkout_conn(timeout)
         try:
@@ -255,7 +261,7 @@ class RemoteHost:
                     BrokenPipeError, ConnectionResetError,
                     ConnectionAbortedError) as e:
                 conn.close()
-                if reused:
+                if reused and idempotent:
                     # The peer reaped this idle keep-alive connection as
                     # we touched it — reconnect-on-stale, not a verdict.
                     raise _StaleConnection() from None
@@ -282,19 +288,22 @@ class RemoteHost:
     def _request(
         self, method: str, path: str, body: bytes | None = None, *,
         timeout: float, retries: int = 0, ctype: str = "application/json",
-        headers: dict | None = None,
+        headers: dict | None = None, idempotent: bool = True,
     ) -> bytes:
         """One wire call with bounded jittered retries on TRANSPORT
         failures only (the idempotent-probe discipline — callers pass
         ``retries=0`` for submit). Typed statuses raise immediately.
         A stale pooled connection costs one silent fresh-connection
-        retry, never a retry-budget charge or a host-shaped verdict."""
+        retry, never a retry-budget charge or a host-shaped verdict —
+        unless ``idempotent=False`` (submit), where even THAT retry is
+        forbidden: the break is ambiguous about server-side acceptance."""
         last: Exception | None = None
         for attempt in range(retries + 1):
             try:
                 try:
                     return self._request_once(
-                        method, path, body, timeout, ctype, headers
+                        method, path, body, timeout, ctype, headers,
+                        idempotent,
                     )
                 except _StaleConnection:
                     # Purge the pool first: its siblings idled just as
@@ -303,7 +312,8 @@ class RemoteHost:
                     # _StaleConnection).
                     self._drop_conns()
                     return self._request_once(
-                        method, path, body, timeout, ctype, headers
+                        method, path, body, timeout, ctype, headers,
+                        idempotent,
                     )
             except HostUnavailableError as e:
                 last = e
@@ -394,6 +404,7 @@ class RemoteHost:
             "POST", path, buf.getvalue(),
             timeout=self.connect_timeout_s, retries=0,
             ctype="application/octet-stream", headers=headers,
+            idempotent=False,
         ).decode())
         rid = resp["req_id"]
         if trace is not None and self._spans is not None:

@@ -41,7 +41,7 @@ import os
 import queue
 import threading
 import time
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import Future, InvalidStateError, ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Any
 
@@ -847,7 +847,15 @@ class InferenceServer:
                     if req.future.cancelled():
                         cancelled_late += 1
                         continue
-                    req.future.set_result(rows[i].astype(np.int32, copy=False))
+                    try:
+                        req.future.set_result(
+                            rows[i].astype(np.int32, copy=False))
+                    except InvalidStateError:
+                        # CANCEL landed between the check and set_result
+                        # (the wire thread races this loop). Count it
+                        # here — letting it escape to the handler below
+                        # would mis-fail the whole flush host-shaped.
+                        cancelled_late += 1
                 if cancelled_late:
                     with self._lock:
                         self._stats["cancelled"] += cancelled_late

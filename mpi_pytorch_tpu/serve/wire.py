@@ -43,6 +43,7 @@ a deterministic slow wire on one host, the lever the hedge drill uses.
 
 from __future__ import annotations
 
+import queue
 import socket
 import struct
 import threading
@@ -279,6 +280,8 @@ def decode_error_header(header: bytes) -> tuple[int, str, float | None,
         for _ in range(2):
             (n,) = struct.unpack_from("<H", header, off)
             off += 2
+            if off + n > len(header):
+                raise struct.error("string past header end")
             strs.append(header[off:off + n].decode("utf-8"))
             off += n
     except (struct.error, UnicodeDecodeError) as e:
@@ -400,6 +403,74 @@ _jitter_lock = threading.Lock()
 # --------------------------------------------------------------------------
 
 
+class _ConnWriter:
+    """Per-connection outbound frame queue + dedicated writer thread.
+
+    Result delivery is decoupled from result PRODUCTION: a future's
+    done-callback (which runs on the server's single completion loop)
+    only encodes and enqueues — the blocking ``sendall`` (and the chaos
+    fault-gate sleep) happen here, so one client with a stalled TCP
+    window stalls only its own connection, never the completion loop or
+    any other connection. The queue is bounded: a client too slow to
+    drain ``maxsize`` result frames is a laggard, and its connection is
+    torn down rather than buffered without bound (the reader loop wakes
+    on the shutdown and fails its in-flight requests host-shaped, which
+    the router re-dispatches)."""
+
+    def __init__(self, conn: socket.socket, host_index: int,
+                 maxsize: int = 256):
+        self._conn = conn
+        self._host_index = host_index
+        self._q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self.dead = False
+        self._thread = threading.Thread(
+            target=self._loop, name="wire-writer", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            frame, fault = self._q.get()
+            if frame is None or self.dead:
+                return
+            if fault:
+                maybe_fault_wire_delay(self._host_index)
+            try:
+                self._conn.sendall(frame)
+            except OSError:
+                self.dead = True
+                return  # peer gone; the reader loop handles cleanup
+
+    def send(self, frame: bytes, *, fault: bool = False) -> None:
+        """Enqueue a frame (never blocks). ``fault=True`` applies the
+        chaos wire-delay gate on the writer thread before the write —
+        the response-path semantics the hedge drill depends on."""
+        if self.dead:
+            return
+        try:
+            self._q.put_nowait((frame, fault))
+        except queue.Full:
+            # Laggard client: maxsize undrained frames deep. Tear the
+            # connection down; the reader loop notices and cleans up.
+            self.dead = True
+            try:
+                self._conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def close(self, drain_s: float = 0.5) -> None:
+        """Stop the writer after a bounded best-effort drain of frames
+        already queued (the final ERROR frame on a poisoned connection
+        rides this). A writer stuck in ``sendall`` is unblocked by the
+        caller's socket shutdown right after."""
+        try:
+            self._q.put_nowait((None, False))
+        except queue.Full:
+            pass  # stalled writer; the dead flag + shutdown end it
+        self._thread.join(timeout=drain_s)
+        self.dead = True
+
+
 class WireListener:
     """The serving host's framed wire surface: accept persistent
     connections, decode SUBMIT frames straight into the request path,
@@ -451,7 +522,7 @@ class WireListener:
             ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        send_lock = threading.Lock()  # RESULT writers race (out-of-order)
+        writer = _ConnWriter(conn, self._host_index)
         pending: dict[int, Future] = {}
         pend_lock = threading.Lock()
         try:
@@ -465,19 +536,18 @@ class WireListener:
                     # once (best effort), then tear down.
                     if self._logger is not None:
                         self._logger.warning("wire: dropping conn: %s", e)
-                    self._try_send(conn, send_lock, encode_frame(
+                    writer.send(encode_frame(
                         ERROR, 0, exception_to_error_header(e)))
                     return
                 if ftype == PING:
-                    self._try_send(conn, send_lock,
-                                   encode_frame(PONG, req_id))
+                    writer.send(encode_frame(PONG, req_id))
                 elif ftype == CANCEL:
                     with pend_lock:
                         fut = pending.get(req_id)
                     if fut is not None:
                         fut.cancel()
                 elif ftype == SUBMIT:
-                    self._handle_submit(conn, send_lock, pending, pend_lock,
+                    self._handle_submit(writer, pending, pend_lock,
                                         req_id, header, payload)
                 # RESULT/ERROR/PONG from a client are ignored: this end
                 # only ever receives SUBMIT/CANCEL/PING.
@@ -486,61 +556,66 @@ class WireListener:
         finally:
             with self._lock:
                 self._conns.discard(conn)
+            writer.close()
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 conn.close()
             except OSError:
                 pass
             # In-flight futures whose connection died: nobody is left to
             # receive the result — cancel so the batch loop can skip.
+            # Snapshot-and-clear under the lock, cancel OUTSIDE it:
+            # Future.cancel() on a pending future runs _done
+            # synchronously, and _done's first statement takes pend_lock.
             with pend_lock:
-                for fut in pending.values():
-                    fut.cancel()
+                futs = list(pending.values())
+                pending.clear()
+            for fut in futs:
+                fut.cancel()
 
-    def _handle_submit(self, conn, send_lock, pending, pend_lock,
+    def _handle_submit(self, writer, pending, pend_lock,
                        req_id, header, payload) -> None:
         try:
             image, model, trace = decode_array(header, payload)
         except WireError as e:
-            self._try_send(conn, send_lock, encode_frame(
-                ERROR, req_id, exception_to_error_header(e)))
+            self._reply_error(writer, req_id, e)
             return
         try:
             fut = self._submit_fn(image, model, trace)
         except BaseException as e:  # typed admission rejection (429/503/…)
-            self._reply_error(conn, send_lock, req_id, e)
+            self._reply_error(writer, req_id, e)
             return
         with pend_lock:
             pending[req_id] = fut
 
         def _done(f: Future, rid=req_id) -> None:
+            # Runs on whatever thread resolves the future — the server's
+            # SINGLE completion loop. Only encode + enqueue here; the
+            # blocking socket write (and the chaos fault sleep) happen on
+            # this connection's writer thread, so a stalled client never
+            # head-of-line-blocks other requests or connections.
             with pend_lock:
                 pending.pop(rid, None)
-            maybe_fault_wire_delay(self._host_index)
             if f.cancelled():
-                self._reply_error(conn, send_lock, rid, CancelledError())
+                self._reply_error(writer, rid, CancelledError(), fault=True)
                 return
             exc = f.exception()
             if exc is not None:
-                self._reply_error(conn, send_lock, rid, exc)
+                self._reply_error(writer, rid, exc, fault=True)
                 return
             result = np.ascontiguousarray(f.result())
-            self._try_send(conn, send_lock, encode_frame(
+            writer.send(encode_frame(
                 RESULT, rid, pack_array_header(result),
-                result.tobytes()))
+                result.tobytes()), fault=True)
 
         fut.add_done_callback(_done)
 
-    def _reply_error(self, conn, send_lock, req_id, exc) -> None:
-        self._try_send(conn, send_lock, encode_frame(
-            ERROR, req_id, exception_to_error_header(exc)))
-
-    @staticmethod
-    def _try_send(conn, send_lock, frame: bytes) -> None:
-        try:
-            with send_lock:
-                conn.sendall(frame)
-        except OSError:
-            pass  # peer gone; its reader loop will notice and clean up
+    def _reply_error(self, writer, req_id, exc, *, fault=False) -> None:
+        writer.send(encode_frame(
+            ERROR, req_id, exception_to_error_header(exc)), fault=fault)
 
     def close(self) -> None:
         self._closed = True

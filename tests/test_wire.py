@@ -140,6 +140,12 @@ def test_typed_header_rejections():
         wire.error_header_to_exception(
             wire.encode_error_header(222, "from the future")
         )
+    # Truncated ERROR header: a string running past the end is typed
+    # malformed, never a silently-shortened detail.
+    with pytest.raises(wire.MalformedFrameError):
+        wire.decode_error_header(
+            wire.encode_error_header(wire.ERR_REQUEST, "long detail")[:-4]
+        )
 
 
 def test_error_taxonomy_survives_the_wire():
@@ -332,6 +338,22 @@ def test_listener_death_fails_inflight_host_shaped(framed):
     listener.close()
     with pytest.raises(HostUnavailableError):
         fut.result(timeout=5)
+
+
+def test_conn_death_cancels_every_inflight_server_side(framed):
+    """Client hangs up with several requests in flight on ONE
+    connection: teardown must cancel EVERY pending server-side future —
+    cancel() runs the done-callback synchronously, so holding pend_lock
+    across it would deadlock the wire-conn thread on the first future
+    and leave the rest uncancelled, silently occupying batch slots."""
+    backend, _listener, client = framed
+    backend.mode = "pending"
+    for i in range(4):
+        client.submit(np.full((2, 2), i, np.uint8))
+    _wait_for(lambda: len(backend.futures) == 4, what="all submits to land")
+    client.close()
+    _wait_for(lambda: all(f.cancelled() for f in backend.futures),
+              what="server-side cancellation of every in-flight future")
 
 
 # ----------------------------------------------------- chaos: slow wire
