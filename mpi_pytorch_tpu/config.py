@@ -359,6 +359,15 @@ class Config:
     # the plan fits; a single over-budget tenant is rejected loudly.
     # 0 = unbounded (the plan is still computed and explained).
     serve_pack_budget_mb: float = 0.0
+    # --- model-parallel residency (serve/sharding.py, ISSUE 17) ---
+    # K > 1 serves this host MODEL-PARALLEL over a nested (data, model)
+    # mesh: params FSDP-shard over K chips (the serve residency fsdp:K),
+    # batch rows shard over the remaining data-slices, and buckets smaller
+    # than the data degree pad to it. 1 = replicated, byte-identical to
+    # before. Zoo tenants pick residency per-spec (shard=K / shard=tp:K)
+    # or get it from the packing planner instead; this knob is the
+    # single-model and bench_serve surface.
+    serve_shard_degree: int = 1
 
     # --- fleet serving (mpi_pytorch_tpu/serve/fleet/, ISSUE 9) ---
     # N > 0 builds an in-process N-host fleet (FleetServer: N InferenceServer
@@ -815,6 +824,17 @@ class Config:
         if self.serve_queue_depth < 1:
             raise ValueError(
                 f"serve_queue_depth must be >= 1, got {self.serve_queue_depth}"
+            )
+        if self.serve_shard_degree < 1:
+            raise ValueError(
+                f"serve_shard_degree must be >= 1 (1 = replicated), "
+                f"got {self.serve_shard_degree}"
+            )
+        if self.serve_shard_degree > 1 and self.serve_models:
+            raise ValueError(
+                "serve_shard_degree is the single-model model-parallel "
+                "knob; zoo tenants pick residency per-spec (shard=K) or "
+                "from the packing planner"
             )
         if self.serve_fleet_hosts < 0:
             raise ValueError(

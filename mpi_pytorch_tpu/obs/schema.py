@@ -162,7 +162,15 @@ from typing import Any, Mapping
 #      framed wire of ``serve/wire.py`` — check_regression already keys
 #      transport into the serve trend-line identity). All absent on
 #      HTTP/in-process serving — streams stay byte-identical to v11.
-SCHEMA_VERSION = 12
+#  13: the model-parallel-residency generation (ISSUE 17): ``serve``
+#      flushes and ``serve_bench`` rows may carry ``shard_degree`` (how
+#      many chips one copy of the serving params spans — absent on
+#      replicated tenants, so pre-sharding streams stay byte-identical
+#      to v12); ``fleet`` swap_in/retune records may carry ``residency``
+#      (the tenant's weight layout after the event — "replicated" /
+#      "tp:K" / "fsdp:K"), ``reshard_bytes`` (total bytes the bounded
+#      per-leaf cross-topology reshard moved), and ``shard_degree``.
+SCHEMA_VERSION = 13
 
 _NUM = (int, float)
 _INT = (int,)
@@ -260,6 +268,9 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # v10: the tenant this flush served (flushes are single-tenant
         # by construction — serve/zoo/) — absent on untenanted servers.
         "model": (str,),
+        # v13: chips one copy of the params spans (model-parallel
+        # tenants only — absent on replicated serving).
+        "shard_degree": _INT,
     },
     "serve_bench": {
         "model": (str,), "offered_rps": _NUM, "rejected": _INT,
@@ -292,6 +303,10 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # input copies per served request (1.0 = bytes touched exactly
         # once between the wire and device_put). Absent elsewhere.
         "hedged": _INT, "copies_per_request": _NUM,
+        # v13: the --serve-shard-degree axis — a sharded row is a
+        # different trend line than a replicated one
+        # (check_regression keys it).
+        "shard_degree": _INT,
     },
     "resume": {
         "from_devices": _INT, "from_mesh": (str,), "to_mesh": (str,),
@@ -351,6 +366,11 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         "hosts_from": _INT, "hosts_to": _INT, "reason": (str,),
         "reject_rate": _NUM, "queue_depth": _INT, "restarts": _INT,
         "transport": (str,),
+        # v13: the model-parallel residency axis — the tenant's weight
+        # layout after a swap_in/retune ("replicated"/"tp:K"/"fsdp:K"),
+        # the bytes the bounded cross-topology reshard moved getting
+        # there, and the chip span (absent on replicated events).
+        "residency": (str,), "reshard_bytes": _INT, "shard_degree": _INT,
     },
     # v6: which step the rollback triggered at, what it restored (the
     # checkpoint's filed epoch + path), how many rollbacks this run has

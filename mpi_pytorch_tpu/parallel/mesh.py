@@ -88,11 +88,40 @@ def create_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
     return Mesh(arr, (cfg.data_axis, cfg.model_axis, cfg.pipe_axis))
 
 
+def create_serve_mesh(shard_degree: int, devices: list | None = None) -> Mesh:
+    """The nested ``(data, model)`` SERVE mesh (ISSUE 17): ``model`` spans
+    ``shard_degree`` chips (one tenant's TP/FSDP split), ``data`` the rest
+    (distinct batch rows — and, fleet-wise, distinct tenants — land on
+    distinct data-slices). The axis names are FIXED to the trainer defaults
+    so every helper below (``data_axis_names``, ``model_axis_name``,
+    ``shard_first_divisible``) reads a serve mesh exactly like a flat
+    training mesh — PR 15's axis-name discipline, reused rather than
+    reinvented. ``shard_degree == 1`` is the degenerate replicated layout
+    (``(n, 1)``, identical to ``serve.server.local_replica_mesh``)."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    k = int(shard_degree)
+    if k < 1:
+        raise ValueError(f"serve shard degree must be >= 1, got {shard_degree}")
+    if n % k != 0:
+        raise ValueError(
+            f"{n} device(s) not divisible by serve shard degree {k}; a "
+            "sharded tenant occupies exactly K chips per data-slice"
+        )
+    arr = np.asarray(devices).reshape(n // k, k)
+    return Mesh(arr, (SERVE_DATA_AXIS, SERVE_MODEL_AXIS))
+
+
 # ---------------------------------------------------------------------------
 # Nested (hierarchical) data-axis helpers — the one vocabulary every layer
 # keys the pod/ici factoring on, so "is this mesh hierarchical" can never
 # drift between the step, the state sharder, and the trainer.
 # ---------------------------------------------------------------------------
+
+# Serve-mesh axis names are FIXED like the pod/ici pair (not MeshConfig-
+# renameable): residency records, the packing planner's per-chip byte
+# arithmetic, and the reshard path all key on them.
+SERVE_DATA_AXIS, SERVE_MODEL_AXIS = "data", "model"
 
 # The nested data-axis names are FIXED (unlike the flat axis, which
 # MeshConfig can rename): the traffic ledger classifies collectives by

@@ -19,6 +19,18 @@ the chips (the batched forward uses the whole replica's devices); smaller
 buckets run replicated (``_row_sharding`` in evaluate.py applies the same
 rule to the output pin). AOT executables do NOT auto-reshard inputs, so
 ``place()`` is the one true device-placement path for serve batches.
+
+On a nested ``(data, model)`` serve mesh (ISSUE 17) replication is the
+WRONG fallback — a replicated batch row would run the full forward on
+every data-slice — so buckets smaller than the data degree PAD to it
+instead (``host_rows``): the executable compiles at the padded row count
+sharded over ``data``, the host buffer is allocated padded (one pixel
+copy, ``copies_per_request`` still 1.0), and the completion path's
+request-count slice keeps filler rows from ever reaching a response.
+``residency`` (``serve/sharding.py``) makes the set model-parallel: the
+state is resharded TP/FSDP over ``model`` through the bounded per-leaf
+redistribution path before lowering, so the compiled executables bake
+the sharded layout in.
 """
 
 from __future__ import annotations
@@ -48,7 +60,10 @@ class BucketExecutables:
     swap (``InferenceServer.set_precision``).
     """
 
-    def __init__(self, cfg, state, mesh, *, logger=None, precision: str = "bf16"):
+    def __init__(
+        self, cfg, state, mesh, *, logger=None, precision: str = "bf16",
+        residency=None, prequantized: bool = False,
+    ):
         import jax
         import jax.numpy as jnp
 
@@ -89,7 +104,11 @@ class BucketExecutables:
         else:
             self.image_dtype = np.dtype(cfg.input_dtype)
 
-        if precision == "int8":
+        if precision == "int8" and not prequantized:
+            # prequantized=True is the residency-conversion path
+            # (zoo/pool.reshard): the state is a prior int8 set's tree,
+            # already carrying scales — re-quantizing int8 leaves would
+            # corrupt them.
             from mpi_pytorch_tpu.ops import quantize as qz
 
             # The shared seeded calibration batch (quantize.calibration_
@@ -107,6 +126,21 @@ class BucketExecutables:
             state = qz.quantize_state(
                 state, keep_head_int8=self.fused_head, act_scale=act_scale
             )
+        from mpi_pytorch_tpu.serve import sharding as shd
+
+        self.residency = residency if residency is not None else shd.REPLICATED
+        self.reshard_stats = None
+        if residency is not None:
+            # An explicit residency (the zoo's sharded/convert paths; None =
+            # legacy pre-placed state, byte-identical behavior) reshards
+            # AFTER quantization so int8 kernels and their per-channel
+            # scales get deterministic serve specs (the lowering below
+            # bakes whatever shardings the concrete leaves carry). Pure
+            # device_puts through the bounded per-leaf path — zero
+            # compiles, so the warm-probe discipline is undisturbed.
+            state, self.reshard_stats = shd.reshard_state(
+                state, mesh, residency, logger=logger
+            )
         predict = _make_predict_step(
             mesh, compute_dtype, fused_head=self.fused_head, topk=self.topk,
             int8_head=(precision == "int8" and self.fused_head),
@@ -116,15 +150,32 @@ class BucketExecutables:
         self._compiled = {}
         self._shardings = {}
         self._image_hw = h, w = cfg.image_size
+        # Pad-to-degree (nested serve mesh only — model axis > 1): a bucket
+        # smaller than the data degree would otherwise fall back to full
+        # replication, running the whole forward on every data-slice. The
+        # executable compiles at the padded row count, rows sharded over
+        # ``data``; filler rows are masked off by the completion path's
+        # request-count slice. model == 1 meshes keep the legacy shapes
+        # byte-identical.
+        from mpi_pytorch_tpu.parallel.mesh import data_axis_size, model_axis_name
+
+        self._data_degree = data_axis_size(mesh)
+        self._model_degree = int(mesh.shape[model_axis_name(mesh)])
+        d = self._data_degree
+        self._padded = {
+            b: (-(-b // d) * d if self._model_degree > 1 else b)
+            for b in self.buckets
+        }
         options = cfg.parsed_compiler_options()
         for bucket in self.buckets:
+            rows = self._padded[bucket]
             img_sh, lbl_sh = self._shardings.setdefault(
-                bucket, self._batch_shardings(bucket)
+                rows, self._batch_shardings(rows)
             )
             img_aval = jax.ShapeDtypeStruct(
-                (bucket, h, w, 3), self.image_dtype, sharding=img_sh
+                (rows, h, w, 3), self.image_dtype, sharding=img_sh
             )
-            lbl_aval = jax.ShapeDtypeStruct((bucket,), np.int32, sharding=lbl_sh)
+            lbl_aval = jax.ShapeDtypeStruct((rows,), np.int32, sharding=lbl_sh)
             self._compiled[bucket] = (
                 jax.jit(predict)
                 .lower(state, (img_aval, lbl_aval))
@@ -135,13 +186,26 @@ class BucketExecutables:
         self._baseline = compile_count()
         self._warm = False
 
-    def _batch_shardings(self, bucket: int):
-        """(images, labels) shardings for one bucket — ONE divisibility
-        rule with the predict step's output pin (``evaluate._row_sharding``):
-        inputs and outputs must never diverge on when a batch shards."""
+    @property
+    def shard_degree(self) -> int:
+        """Chips one copy of this set's params spans (1 = replicated)."""
+        return self.residency.degree if self.residency.sharded else 1
+
+    def host_rows(self, bucket: int) -> int:
+        """The HOST buffer row count for ``bucket`` — the padded-to-degree
+        shape the bucket's executable was compiled on. The server allocates
+        its pooled input buffers at this size directly, so degree padding
+        costs zero extra pixel copies."""
+        return self._padded[bucket]
+
+    def _batch_shardings(self, rows: int):
+        """(images, labels) shardings for one padded row count — ONE
+        divisibility rule with the predict step's output pin
+        (``evaluate._row_sharding``): inputs and outputs must never diverge
+        on when a batch shards."""
         from mpi_pytorch_tpu.evaluate import _row_sharding
 
-        sh = _row_sharding(self._mesh, bucket)
+        sh = _row_sharding(self._mesh, rows)
         return sh, sh
 
     def place(self, images: np.ndarray, labels: np.ndarray):
@@ -174,8 +238,9 @@ class BucketExecutables:
 
         h, w = self._image_hw
         for bucket in self.buckets:
-            images = np.zeros((bucket, h, w, 3), self.image_dtype)
-            labels = np.full((bucket,), -1, np.int32)
+            rows = self._padded[bucket]
+            images = np.zeros((rows, h, w, 3), self.image_dtype)
+            labels = np.full((rows,), -1, np.int32)
             preds = self(bucket, self.place(images, labels))
             jax.block_until_ready(preds)
         self._baseline = self._compile_count()
@@ -213,6 +278,18 @@ def measure_parity_top1(exe_ref, exe_q, *, samples: int = 32, seed: int = 0) -> 
     h, w = exe_ref._image_hw
     rng = np.random.default_rng(seed)
     agree = total = 0
+
+    def run(exe, images, labels):
+        # The two sets may carry different degree padding (a sharded set
+        # vs its single-chip reference): feed each its own host shape,
+        # compare the logical rows only.
+        rows = exe.host_rows(bucket)
+        imgs = np.zeros((rows, h, w, 3), images.dtype)
+        imgs[:bucket] = images
+        lbls = np.full((rows,), -1, labels.dtype)
+        out = np.asarray(jax.device_get(exe(bucket, exe.place(imgs, lbls))))
+        return out.reshape(out.shape[0], -1)[:bucket]
+
     for _ in range(max(1, -(-samples // bucket))):
         if exe_ref.image_dtype == np.uint8:
             images = rng.integers(0, 256, size=(bucket, h, w, 3)).astype(np.uint8)
@@ -221,12 +298,8 @@ def measure_parity_top1(exe_ref, exe_q, *, samples: int = 32, seed: int = 0) -> 
             # sample is in-distribution for the normalize output.
             images = rng.normal(size=(bucket, h, w, 3)).astype(np.float32)
         labels = np.full((bucket,), -1, np.int32)
-        p_ref = np.asarray(
-            jax.device_get(exe_ref(bucket, exe_ref.place(images, labels)))
-        ).reshape(bucket, -1)
-        p_q = np.asarray(
-            jax.device_get(exe_q(bucket, exe_q.place(images, labels)))
-        ).reshape(bucket, -1)
+        p_ref = run(exe_ref, images, labels)
+        p_q = run(exe_q, images, labels)
         agree += int((p_ref[:, 0] == p_q[:, 0]).sum())
         total += bucket
     parity = round(agree / total, 4)

@@ -241,6 +241,14 @@ class FleetController:
                 # model-labelled knob axis (absent on untenanted hosts,
                 # records byte-identical to v9).
                 record["model"] = model
+            res = getattr(host, "residency", None)
+            if res and res != "replicated":
+                # Schema-v13: a sharded tenant is one logical host over K
+                # chips — a retune record that tunes it must say so.
+                record["residency"] = res
+                record["shard_degree"] = int(
+                    getattr(host, "shard_degree", 1)
+                )
             if prec_to != prec_from:
                 # Schema-v7: a precision switch carries the measured
                 # top-1 parity delta between the two sets — the accuracy

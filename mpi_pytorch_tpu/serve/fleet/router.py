@@ -90,6 +90,13 @@ def aggregate_tenant_stats(host_stats, rejections_by_model) -> dict:
             agg["served"] += s.get("served", 0)
             agg["rejected"] += s.get("rejected", 0)
             agg["padded_rows"] += s.get("padded_rows", 0)
+            if s.get("shard_degree"):
+                # A sharded tenant occupies K chips per host — the
+                # per-tenant bench column says so (ISSUE 17).
+                agg["shard_degree"] = max(
+                    agg.get("shard_degree", 1), int(s["shard_degree"])
+                )
+                agg["residency"] = s.get("residency", "replicated")
     for model, n in (rejections_by_model or {}).items():
         _agg(model)["front_door_rejections"] = n
     return out
@@ -252,6 +259,19 @@ class LocalHost:
         """int8-vs-bf16 startup top-1 agreement (None when the host holds
         a single precision set) — stamped on precision retune records."""
         return self.server.parity_top1
+
+    # -- model-parallel residency (ISSUE 17) ---------------------------
+    @property
+    def residency(self) -> str:
+        """Weight layout of this host's model — "replicated" unless the
+        server compiled sharded sets (a sharded host is one logical host
+        occupying shard_degree chips; admission and retune records carry
+        it)."""
+        return getattr(self.server, "residency", "replicated")
+
+    @property
+    def shard_degree(self) -> int:
+        return int(getattr(self.server, "shard_degree", 1))
 
     def compiles_after_warmup(self) -> int:
         return self.server.compiles_after_warmup()

@@ -218,6 +218,7 @@ class InferenceServer:
                 else next(iter(executables.values()))._mesh
             )
         else:
+            shard_k = int(getattr(cfg, "serve_shard_degree", 1) or 1)
             if mesh is None:
                 if jax.process_count() > 1:
                     raise ServeError(
@@ -225,9 +226,17 @@ class InferenceServer:
                         "mesh=serve.local_replica_mesh() (a global mesh would "
                         "turn every flush into a pod-wide collective)"
                     )
-                from mpi_pytorch_tpu.parallel.mesh import create_mesh
+                if shard_k > 1:
+                    # The nested (data, model) serve mesh (ISSUE 17): this
+                    # host's params span shard_k chips TP/FSDP-style, batch
+                    # rows shard over the remaining data-slices.
+                    from mpi_pytorch_tpu.parallel.mesh import create_serve_mesh
 
-                mesh = create_mesh(cfg.mesh)
+                    mesh = create_serve_mesh(shard_k)
+                else:
+                    from mpi_pytorch_tpu.parallel.mesh import create_mesh
+
+                    mesh = create_mesh(cfg.mesh)
             if any(
                 d.process_index != jax.process_index() for d in mesh.devices.flat
             ):
@@ -239,9 +248,18 @@ class InferenceServer:
 
             if state is None:
                 state = self._build_state(cfg, mesh, load_checkpoint)
-            from mpi_pytorch_tpu.train.step import place_state_on_mesh
+            if shard_k > 1:
+                # Placement is deferred to BucketExecutables, which reshards
+                # the (possibly quantized) state through the bounded
+                # per-leaf path under the serve residency.
+                from mpi_pytorch_tpu.serve.sharding import Residency
 
-            state = place_state_on_mesh(state, mesh)
+                build_residency = Residency("fsdp", shard_k)
+            else:
+                from mpi_pytorch_tpu.train.step import place_state_on_mesh
+
+                state = place_state_on_mesh(state, mesh)
+                build_residency = None
 
         # metrics=None → the cfg's stream (kind="serve" records); pass an
         # explicit MetricsWriter to share a stream, or one over "" to mute.
@@ -315,7 +333,7 @@ class InferenceServer:
                 self._exe_sets = {
                     p: BucketExecutables(
                         cfg, state, self.mesh, logger=self._logger,
-                        precision=p,
+                        precision=p, residency=build_residency,
                     )
                     for p in precisions
                 }
@@ -704,14 +722,19 @@ class InferenceServer:
                 # bytes go frame payload → padded slot → device. The old
                 # stack → pad_batch → astype chain touched them up to
                 # three times and allocated a fresh batch every flush.
-                images = self._bufpool.acquire(bucket, exe.image_dtype)
+                # Host buffers allocate at the executable's PADDED row
+                # count (host_rows == bucket on model=1 meshes): degree
+                # padding on the nested serve mesh costs zero extra pixel
+                # copies — each request's bytes are still written once.
+                host_rows = exe.host_rows(bucket)
+                images = self._bufpool.acquire(host_rows, exe.image_dtype)
                 for i, row in enumerate(rows):
                     np.copyto(images[i], row, casting="unsafe")
-                if len(rows) < bucket:
+                if len(rows) < host_rows:
                     images[len(rows):] = 0  # recycled buffers hold stale rows
                 with self._lock:
                     self._stats["input_copies"] += len(rows)
-                labels = np.full((bucket,), -1, np.int32)
+                labels = np.full((host_rows,), -1, np.int32)
                 dispatch_args = {"bucket": bucket, "requests": len(good)}
                 if self._tracer.enabled:
                     dispatch_args["req_ids"] = [r.req_id for r in good]
@@ -803,6 +826,11 @@ class InferenceServer:
                     # is a live axis (multi-set or non-default) — pure-bf16
                     # servers keep their records byte-identical to v6.
                     record["precision"] = item.precision
+                if self.shard_degree > 1:
+                    # Schema-v13: a model-parallel flush says how many
+                    # chips one copy of the params spans — replicated
+                    # tenants keep their records byte-identical to v12.
+                    record["shard_degree"] = self.shard_degree
                 if self.model is not None:
                     # Schema-v10: the tenant this (single-tenant, by
                     # construction) flush served — absent on untenanted
@@ -949,6 +977,20 @@ class InferenceServer:
         between (the controller's precision axis reads this)."""
         return tuple(sorted(self._exe_sets))
 
+    @property
+    def shard_degree(self) -> int:
+        """Chips one copy of this server's params spans (1 = replicated;
+        every precision set shares one residency by construction)."""
+        return getattr(self._exe, "shard_degree", 1)
+
+    @property
+    def residency(self) -> str:
+        """The tenant's weight layout (``serve/sharding.py`` vocabulary):
+        ``"replicated"``, ``"tp:K"`` or ``"fsdp:K"`` — what swap-in and
+        retune records say about where this model's bytes live."""
+        res = getattr(self._exe, "residency", None)
+        return str(res) if res is not None else "replicated"
+
     def set_precision(self, precision: str) -> None:
         """Switch the ACTIVE executable set — the fleet controller's
         precision lever (bf16 under SLO headroom, int8 under p99
@@ -1019,6 +1061,9 @@ class InferenceServer:
         out["topk"] = self.topk
         out["buckets"] = list(self.buckets)
         out["precision"] = self.precision
+        if self.shard_degree > 1:
+            out["shard_degree"] = self.shard_degree
+            out["residency"] = self.residency
         if self.parity_top1 is not None:
             out["parity_top1"] = self.parity_top1
         if self.model is not None:
@@ -1102,6 +1147,11 @@ class InferenceServer:
             "active_buckets": list(self.active_buckets),
             "precisions": list(self.precisions),
             "parity_top1": self.parity_top1,
+            # Model-parallel residency facts (ISSUE 17): a router/admission
+            # layer reading this host knows it is ONE logical host whose
+            # params span shard_degree chips.
+            "residency": self.residency,
+            "shard_degree": self.shard_degree,
             "topk": stats["topk"],
             "host_index": self.host_index,
             "pid": os.getpid(),

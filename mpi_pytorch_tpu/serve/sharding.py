@@ -1,0 +1,218 @@
+"""Serve-side model-parallel residency: TP/FSDP param sharding over the
+nested ``(data, model)`` serve mesh, plus bounded cross-topology resharding.
+
+A tenant's RESIDENCY names how its weights sit on the serve mesh:
+
+- ``replicated`` — every chip holds the full tree (the only option before
+  ISSUE 17; still the right one for models that fit).
+- ``tp:K`` — Megatron-style tensor parallelism over ``model``: the
+  64.5k-class head kernel/bias column-shard over K chips (the trainer's
+  ``param_specs`` head rule, reused verbatim on the serve mesh), trunk
+  replicated. Cheap where it counts: the head is ~25% of resnet18's bytes.
+- ``fsdp:K`` — every leaf shards its first K-divisible dimension over
+  ``model`` (the ZeRO shard-selection rule, ``shard_first_divisible``).
+  At rest each chip holds ~1/K of the weights; XLA all-gathers each
+  layer just before use inside the compiled bucket executable.
+
+Cross-topology moves (replicated↔tp↔fsdp, degree changes) go through
+``reshard_state``: host-stage one leaf at a time, then place each target
+device's shard directly from the host buffer via the PR 7 bounded
+redistribution core (``train/state.redistribute_to``) — the peak device
+transient is ONE shard and there is never a device-side gather of the
+full tree (arXiv 2112.01075's discipline; arXiv 2004.13336 is the
+cross-replica residency blueprint). The per-leaf byte/chunk accounting
+rides back on ``ReshardStats`` and lands on swap-in records as
+``reshard_bytes`` (schema v13).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpi_pytorch_tpu.parallel.mesh import (
+    is_head_kernel,
+    model_axis_name,
+    shard_first_divisible,
+)
+
+RESIDENCY_KINDS = ("replicated", "tp", "fsdp")
+
+
+@dataclasses.dataclass(frozen=True)
+class Residency:
+    """One tenant's weight layout on the serve mesh."""
+
+    kind: str = "replicated"
+    degree: int = 1
+
+    def __post_init__(self):
+        if self.kind not in RESIDENCY_KINDS:
+            raise ValueError(
+                f"unknown residency kind {self.kind!r} "
+                f"(expected one of {RESIDENCY_KINDS})"
+            )
+        if self.kind == "replicated" and self.degree != 1:
+            raise ValueError("replicated residency has degree 1 by definition")
+        if self.kind != "replicated" and self.degree < 2:
+            raise ValueError(
+                f"{self.kind} residency needs degree >= 2, got {self.degree}"
+            )
+
+    @property
+    def sharded(self) -> bool:
+        return self.kind != "replicated"
+
+    def __str__(self) -> str:
+        return self.kind if not self.sharded else f"{self.kind}:{self.degree}"
+
+
+REPLICATED = Residency()
+
+
+def parse_residency(text: str | None) -> Residency:
+    """``"replicated"``/``""``/None → replicated; ``"tp:K"``/``"fsdp:K"``
+    → sharded; bare ``"K"`` (the zoo spec's ``shard=K`` shorthand) → fsdp:K
+    — FSDP is the default split because it divides EVERY leaf, so it is the
+    one that makes a too-big tenant fit."""
+    if not text or text == "replicated":
+        return REPLICATED
+    s = str(text).strip().lower()
+    if s.isdigit():
+        return Residency("fsdp", int(s))
+    kind, sep, deg = s.partition(":")
+    if not sep or kind not in ("tp", "fsdp") or not deg.isdigit():
+        raise ValueError(
+            f"unparseable residency {text!r} (expected 'replicated', "
+            "'tp:K', 'fsdp:K', or bare 'K' for fsdp:K)"
+        )
+    return Residency(kind, int(deg))
+
+
+@dataclasses.dataclass
+class ReshardStats:
+    """Byte accounting for one residency move, chunk-bounded by
+    construction: ``peak_chunk_bytes`` is the largest single device_put the
+    move performed — the transient-HBM bound the tests assert."""
+
+    residency: str = "replicated"
+    leaves: int = 0
+    sharded_leaves: int = 0
+    bytes_moved: int = 0
+    peak_chunk_bytes: int = 0
+
+    def to_record(self) -> dict:
+        return {
+            "residency": self.residency,
+            "leaves": self.leaves,
+            "sharded_leaves": self.sharded_leaves,
+            "bytes_moved": int(self.bytes_moved),
+            "peak_chunk_bytes": int(self.peak_chunk_bytes),
+        }
+
+
+def serve_param_specs(tree: Any, mesh, residency: Residency) -> Any:
+    """PartitionSpecs for a serve state tree under ``residency``. TP reuses
+    the trainer's head rule (``is_head_kernel`` + last-dim split); FSDP
+    shards every leaf's first K-divisible dim over the MODEL axis — the
+    serve twist on the ZeRO rule: the trainer FSDPs over ``data`` because
+    its data axis is the big one, but a serve tenant's K chips are the
+    ``model`` axis, and the ``data`` axis must keep holding independent
+    batch rows (and other tenants)."""
+    model_axis = mesh.axis_names[-1] if len(mesh.axis_names) == 1 else model_axis_name(mesh)
+    msize = int(mesh.shape[model_axis])
+    if residency.sharded and residency.degree != msize:
+        raise ValueError(
+            f"residency {residency} does not match the mesh model axis "
+            f"({model_axis}={msize}); build the serve mesh with "
+            f"create_serve_mesh({residency.degree})"
+        )
+
+    def spec(path, leaf):
+        shape = tuple(np.shape(leaf))
+        if not residency.sharded or msize == 1 or not shape:
+            return P()
+        if residency.kind == "fsdp":
+            return shard_first_divisible(shape, model_axis, msize)
+        is_head, is_kernel = is_head_kernel(path)
+        if not is_head:
+            return P()
+        if is_kernel and len(shape) >= 2 and shape[-1] % msize == 0:
+            return P(*([None] * (len(shape) - 1) + [model_axis]))
+        if len(shape) == 1 and shape[0] % msize == 0:
+            return P(model_axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, tree)
+
+
+def serve_shardings(tree: Any, mesh, residency: Residency) -> Any:
+    specs = serve_param_specs(tree, mesh, residency)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def _shard_nbytes(shape, dtype, sharding) -> int:
+    shard_shape = sharding.shard_shape(tuple(shape))
+    n = 1
+    for d in shard_shape:
+        n *= int(d)
+    return n * np.dtype(dtype).itemsize
+
+
+def reshard_state(
+    state: Any, mesh, residency: Residency, *, logger=None
+) -> tuple[Any, ReshardStats]:
+    """Move a (possibly already device-resident, possibly differently
+    sharded, possibly on a different mesh) state tree to ``residency`` on
+    ``mesh``. One leaf at a time: host-stage (``device_get`` assembles from
+    the source's addressable shards on HOST — no device gather), then place
+    each target shard directly (``redistribute_to``). Leaves already carrying
+    the target sharding are left in place and cost zero bytes. Returns the
+    resharded tree plus the chunk-bounded byte accounting."""
+    from mpi_pytorch_tpu.train.state import redistribute_to
+    from mpi_pytorch_tpu.utils.env import fault_countdown
+
+    shardings = serve_shardings(state, mesh, residency)
+    stats = ReshardStats(residency=str(residency))
+    fail_mid_tree = fault_countdown("MPT_FAULT_RESHARD_N")
+
+    def move(leaf, target):
+        if not hasattr(leaf, "ndim"):
+            return leaf
+        stats.leaves += 1
+        if fail_mid_tree and stats.leaves > 1:
+            # After the first leaf has been placed: the half-moved state
+            # the failure-path tests need (MPT_FAULT_RESHARD_N).
+            raise RuntimeError(
+                "injected fault: residency reshard died mid-tree "
+                "(MPT_FAULT_RESHARD_N)"
+            )
+        if isinstance(leaf, jax.Array) and leaf.sharding == target:
+            return leaf
+        if not target.spec == P():
+            stats.sharded_leaves += 1
+        if leaf.ndim == 0:
+            return jax.device_put(np.asarray(leaf), target)
+        host = np.asarray(jax.device_get(leaf))
+        chunk = _shard_nbytes(host.shape, host.dtype, target)
+        n_puts = len(target.addressable_devices_indices_map(host.shape))
+        stats.bytes_moved += chunk * n_puts
+        stats.peak_chunk_bytes = max(stats.peak_chunk_bytes, chunk)
+        return redistribute_to(host, target)
+
+    moved = jax.tree_util.tree_map(move, state, shardings)
+    if logger is not None:
+        logger.info(
+            "resharded state to %s: %d/%d leaves sharded, %.1f MB moved, "
+            "peak chunk %.2f MB",
+            residency, stats.sharded_leaves, stats.leaves,
+            stats.bytes_moved / 1e6, stats.peak_chunk_bytes / 1e6,
+        )
+    return moved, stats

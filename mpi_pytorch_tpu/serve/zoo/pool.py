@@ -33,8 +33,12 @@ from mpi_pytorch_tpu.serve.batcher import ServeError
 
 
 def state_resident_bytes(state) -> int:
-    """Leaf-size accounting over a (possibly quantized) serving state —
-    the measured half of the packing plan's arithmetic."""
+    """Leaf-size accounting over a (possibly quantized, possibly SHARDED)
+    serving state — the measured half of the packing plan's arithmetic.
+    PER-CHIP bytes: a sharded leaf counts one shard (``shard_shape``), a
+    replicated leaf its full size — so a tenant's measurement is directly
+    comparable against the per-chip packing budget regardless of
+    residency (ISSUE 17 satellite 1)."""
     import jax
     import numpy as np
 
@@ -44,6 +48,16 @@ def state_resident_bytes(state) -> int:
         dtype = getattr(leaf, "dtype", None)
         if size is None or dtype is None:
             continue
+        sharding = getattr(leaf, "sharding", None)
+        shape = getattr(leaf, "shape", None)
+        if sharding is not None and shape is not None:
+            try:
+                shard = sharding.shard_shape(tuple(shape))
+                size = 1
+                for d in shard:
+                    size *= int(d)
+            except Exception:
+                size = int(getattr(leaf, "size"))
         total += int(size) * int(np.dtype(dtype).itemsize)
     return total
 
@@ -75,7 +89,13 @@ class ZooExecutablePool:
         self._sets: dict[str, dict] = {}
         self._bytes: dict[str, int] = {}
         self._refs: dict[str, int] = {}
+        # model → residency string ("replicated"/"tp:K"/"fsdp:K"). Kept
+        # alongside _bytes even after eviction: a measurement is only
+        # valid at the residency it was taken at, and the planner gates
+        # on exactly that (registry._plan_entry).
+        self._residency: dict[str, str] = {}
         self._mesh = mesh
+        self._serve_meshes: dict[int, object] = {}
 
     @property
     def mesh(self):
@@ -94,6 +114,22 @@ class ZooExecutablePool:
             self._mesh = create_mesh(self.cfg.mesh)
         return self._mesh
 
+    def serve_mesh(self, degree: int):
+        """The nested ``(data, model)`` mesh a ``shard:K`` tenant compiles
+        over — built from the pool's OWN device set (so a local-replica
+        pool stays on its replica) and cached per degree; degree 1 is the
+        flat mesh."""
+        if degree <= 1:
+            return self.mesh
+        cached = self._serve_meshes.get(degree)
+        if cached is None:
+            from mpi_pytorch_tpu.parallel.mesh import create_serve_mesh
+
+            devices = list(self.mesh.devices.flatten())
+            cached = create_serve_mesh(degree, devices=devices)
+            self._serve_meshes[degree] = cached
+        return cached
+
     def resident(self) -> tuple[str, ...]:
         with self._lock:
             return tuple(sorted(self._sets))
@@ -104,6 +140,17 @@ class ZooExecutablePool:
         with self._lock:
             return dict(self._bytes)
 
+    def residency(self, model: str) -> str:
+        with self._lock:
+            return self._residency.get(model, "replicated")
+
+    def residencies(self) -> dict[str, str]:
+        """model → residency string for every tenant that has ever been
+        built — paired with ``measured_bytes`` so the planner knows WHICH
+        layout each measurement belongs to."""
+        with self._lock:
+            return dict(self._residency)
+
     def compiles_after_warmup(self) -> int:
         with self._lock:
             sets = [e for m in self._sets.values() for e in m.values()]
@@ -111,40 +158,66 @@ class ZooExecutablePool:
 
     # ------------------------------------------------------------ build
 
-    def _build(self, model: str) -> tuple[dict, int]:
-        """Load: per-tenant state + one UNWARMED set per precision."""
+    def _build(self, model: str, residency=None) -> tuple[dict, int, str]:
+        """Load: per-tenant state + one UNWARMED set per precision, built
+        at ``residency`` (defaults to the spec's ``shard=`` option; the
+        planner may override via ``ensure``)."""
+        from mpi_pytorch_tpu.serve.sharding import parse_residency
+
         tenant_cfg = self.registry.tenant_cfg(model)
+        if residency is None:
+            residency = parse_residency(self.registry.spec(model).shard)
         if self._build_fn is not None:
+            # The test seam builds replicated fakes; residency is
+            # recorded as requested so planner plumbing stays testable.
             sets = self._build_fn(tenant_cfg, self.mesh)
             return sets, sum(
                 state_resident_bytes(getattr(e, "_state", ()))
                 for e in sets.values()
-            )
+            ), str(residency)
         from mpi_pytorch_tpu.serve.executables import BucketExecutables
         from mpi_pytorch_tpu.serve.server import InferenceServer
         from mpi_pytorch_tpu.train.step import place_state_on_mesh
 
-        state = InferenceServer._build_state(
-            tenant_cfg, self.mesh, self._load_checkpoint
-        )
-        state = place_state_on_mesh(state, self.mesh)
+        if residency.sharded:
+            # Sharded build: compile over the nested (data, model) mesh
+            # and let BucketExecutables reshard post-quantization.
+            # place_state_on_mesh is deliberately BYPASSED — the trainer's
+            # param_specs would TP the head over the nested mesh's model
+            # axis before the serve specs get a say.
+            mesh = self.serve_mesh(residency.degree)
+            state = InferenceServer._build_state(
+                tenant_cfg, mesh, self._load_checkpoint
+            )
+            build_residency = residency
+        else:
+            mesh = self.mesh
+            state = InferenceServer._build_state(
+                tenant_cfg, mesh, self._load_checkpoint
+            )
+            state = place_state_on_mesh(state, mesh)
+            build_residency = None
         sets = {
             p: BucketExecutables(
-                tenant_cfg, state, self.mesh, logger=self._logger,
-                precision=p,
+                tenant_cfg, state, mesh, logger=self._logger,
+                precision=p, residency=build_residency,
             )
             for p in tenant_cfg.parsed_serve_precisions()
         }
         # Measured resident bytes: each set holds ITS state (int8 sets a
-        # quantized copy) — sum over sets, PR 6's leaf accounting.
+        # quantized copy) — sum over sets, PR 6's leaf accounting,
+        # per-chip under sharding (state_resident_bytes).
         measured = sum(
             state_resident_bytes(e._state) for e in sets.values()
         )
-        return sets, measured
+        return sets, measured, str(residency)
 
-    def ensure(self, model: str) -> dict:
+    def ensure(self, model: str, residency=None) -> dict:
         """The tenant's warmed sets — building, warming, and PROBING them
         on first use (the cold swap-in's load + warm-probe halves).
+        ``residency`` overrides the spec's layout for a FRESH build (the
+        packing planner's ``shard:K`` pick); a tenant already resident is
+        returned as-is — converting a live tenant is ``reshard``'s job.
         Idempotent; refcounted per ``release``."""
         self.registry.spec(model)  # unknown tenant raises typed, early
         with self._lock:
@@ -155,7 +228,7 @@ class ZooExecutablePool:
         # Build OUTSIDE the lock: a cold swap-in compiling for seconds
         # must not block another tenant's lookup.
         try:
-            sets, measured = self._build(model)
+            sets, measured, res_str = self._build(model, residency)
             # Warm EVERY set, then rebaseline ALL (the compile listener
             # is process-global — InferenceServer.__init__'s
             # discipline), then the warm PROBE: run each bucket once
@@ -188,6 +261,7 @@ class ZooExecutablePool:
             if model not in self._sets:  # lost builds are discarded, loudly
                 self._sets[model] = sets
                 self._bytes[model] = measured
+                self._residency[model] = res_str
                 self._refs[model] = 0
             else:
                 self._logger.warning(
@@ -196,6 +270,71 @@ class ZooExecutablePool:
                 )
             self._refs[model] += 1
             return self._sets[model]
+
+    def reshard(self, model: str, residency) -> tuple[dict, int]:
+        """Convert a RESIDENT tenant's sets to a new residency IN PLACE —
+        the cross-topology half of the ISSUE 17 tentpole. Each precision's
+        already-quantized state moves through the bounded per-leaf path
+        (``prequantized=True`` so int8 scales are never re-derived), new
+        executables compile over the target mesh, and the full warm →
+        rebaseline → warm-probe gate runs before the swap: a conversion
+        that would compile under traffic raises ``ColdSwapError`` and the
+        OLD sets stay live and zero-compile (the rebaseline-in-finally
+        discipline covers both exits). Returns the new sets plus the total
+        ``reshard_bytes`` actually moved."""
+        from mpi_pytorch_tpu.serve.executables import BucketExecutables
+        from mpi_pytorch_tpu.serve.sharding import parse_residency
+
+        if isinstance(residency, str):
+            residency = parse_residency(residency)
+        with self._lock:
+            old_sets = self._sets.get(model)
+            if old_sets is None:
+                raise ServeError(
+                    f"cannot reshard {model!r}: not resident in the pool"
+                )
+            if self._residency.get(model, "replicated") == str(residency):
+                return old_sets, 0
+        tenant_cfg = self.registry.tenant_cfg(model)
+        mesh = self.serve_mesh(residency.degree if residency.sharded else 1)
+        try:
+            new_sets = {}
+            moved = 0
+            for p, exe in old_sets.items():
+                ns = BucketExecutables(
+                    tenant_cfg, exe._state, mesh, logger=self._logger,
+                    precision=p, residency=residency, prequantized=True,
+                )
+                if ns.reshard_stats is not None:
+                    moved += ns.reshard_stats.bytes_moved
+                new_sets[p] = ns
+            for exe in new_sets.values():
+                if not exe.warm:
+                    exe.warmup()
+            for exe in new_sets.values():
+                exe.rebaseline()
+            self.warm_probe(new_sets, model)
+        finally:
+            # Same process-global-listener discipline as ensure(): the
+            # conversion's compiles landed on every OTHER resident set's
+            # counter (and, on the failure path, on this tenant's still-
+            # live old sets) — rebaseline them all so a failed reshard
+            # leaves every resident tenant's zero-compile assertion
+            # intact.
+            with self._lock:
+                others = [
+                    e for m, sets_ in self._sets.items()
+                    for e in sets_.values()
+                ]
+            for exe in others:
+                exe.rebaseline()
+        with self._lock:
+            self._sets[model] = new_sets
+            self._bytes[model] = sum(
+                state_resident_bytes(e._state) for e in new_sets.values()
+            )
+            self._residency[model] = str(residency)
+        return new_sets, int(moved)
 
     @staticmethod
     def warm_probe(sets: dict, model: str) -> None:
@@ -209,8 +348,14 @@ class ZooExecutablePool:
         for exe in sets.values():
             h, w = exe._image_hw
             for bucket in exe.buckets:
-                images = np.zeros((bucket, h, w, 3), exe.image_dtype)
-                labels = np.full((bucket,), -1, np.int32)
+                # Sharded sets pad buckets to the data degree — probe at
+                # the HOST rows the server will actually ship.
+                rows = (
+                    exe.host_rows(bucket)
+                    if hasattr(exe, "host_rows") else bucket
+                )
+                images = np.zeros((rows, h, w, 3), exe.image_dtype)
+                labels = np.full((rows,), -1, np.int32)
                 exe(bucket, exe.place(images, labels))
         compiles = sum(e.compiles_since_warmup() for e in sets.values())
         if compiles != 0:

@@ -27,8 +27,19 @@ Keys: ``ckpt`` (checkpoint dir), ``precision`` (bf16|int8|both),
 ``buckets`` (``|``-separated sizes — ``,`` is the tenant separator),
 ``admission`` (per-tenant front-door token budget; 0 = an equal share of
 the fleet budget), ``cold`` (don't build at startup; the first routed
-request cold-swaps the model in from the persistent compilation cache).
-An alias lets two tenants share an architecture (A/B checkpoints).
+request cold-swaps the model in from the persistent compilation cache),
+``shard`` (model-parallel residency, ISSUE 17: ``K``/``fsdpK`` = FSDP
+over K chips, ``tpK`` = head-only tensor parallelism — ``:`` can't
+appear inside an option, so the spec syntax is ``shard=fsdp4``, not
+``shard=fsdp:4``). An alias lets two tenants share an architecture
+(A/B checkpoints).
+
+The planner itself holds a THIRD residency option beyond
+resident-replicated and evicted: when the resident set is over budget,
+``plan_packing`` tries converting the largest replicated tenants to
+``fsdp:K`` (per-chip bytes ≈ params/K) before the caller reaches for
+eviction — and ``plan.explain()`` shows the per-chip arithmetic that
+made sharding win.
 """
 
 from __future__ import annotations
@@ -63,6 +74,7 @@ class ModelSpec:
     buckets: str = ""  # "" = the fleet cfg's serve_buckets
     admission: int = 0  # per-tenant front-door tokens; 0 = equal share
     cold: bool = False  # True = not built at startup; swap-in on demand
+    shard: str = ""  # "" = replicated; else "tp:K"/"fsdp:K" (ISSUE 17)
 
 
 def parse_model_specs(text: str) -> tuple[ModelSpec, ...]:
@@ -96,10 +108,21 @@ def parse_model_specs(text: str) -> tuple[ModelSpec, ...]:
                 kwargs["buckets"] = value.replace("|", ",")
             elif key == "admission":
                 kwargs["admission"] = int(value)
+            elif key == "shard":
+                import re
+
+                m = re.fullmatch(r"(tp|fsdp)?(\d+)", value.strip().lower())
+                if not m or int(m.group(2)) < 2:
+                    raise ValueError(
+                        f"tenant {name!r}: shard must be K, tpK or fsdpK "
+                        f"with K >= 2 (got {value!r}); ':' can't appear "
+                        "inside a spec option, so shard=fsdp4 means fsdp:4"
+                    )
+                kwargs["shard"] = f"{m.group(1) or 'fsdp'}:{m.group(2)}"
             else:
                 raise ValueError(
                     f"tenant {name!r}: unknown spec key {key!r} (expected "
-                    "ckpt|precision|buckets|admission|cold)"
+                    "ckpt|precision|buckets|admission|cold|shard)"
                 )
         if arch not in SUPPORTED_MODELS:
             raise ValueError(
@@ -146,15 +169,58 @@ def _spec_param_bytes(shapes, precision: str) -> int:
     return total
 
 
+def _sharded_param_bytes(shapes, precision: str, residency) -> tuple[int, int]:
+    """Per-CHIP ``(param_bytes, scale_overhead_bytes)`` under a sharded
+    residency: leaves the residency divides cost 1/K per chip, per-channel
+    int8 scales stay whole on every chip (they ride each shard's dequant),
+    non-divisible leaves stay replicated. TP divides only the head
+    (``is_head_kernel`` — the trainer's rule), FSDP any K-divisible dim."""
+    import jax
+
+    from mpi_pytorch_tpu.parallel.mesh import is_head_kernel
+
+    k = residency.degree
+    total = scales = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(shapes)[0]:
+        shape = tuple(int(d) for d in leaf.shape)
+        n = 1
+        for d in shape:
+            n *= d
+        if residency.kind == "fsdp":
+            divides = any(d > 0 and d % k == 0 for d in shape)
+        else:  # tp: the head only
+            is_head, is_kernel = is_head_kernel(path)
+            divides = is_head and (
+                (is_kernel and len(shape) >= 2 and shape[-1] % k == 0)
+                or (len(shape) == 1 and shape[0] % k == 0)
+            )
+        if precision == "int8" and len(shape) >= 2:
+            sc = 4 * shape[-1]  # per-channel f32 scales, replicated
+            total += (n // k if divides else n) + sc
+            scales += sc
+        else:
+            b = n * 4
+            total += b // k if divides else b
+    return total, scales
+
+
 def estimate_model_bytes(
     arch: str, num_classes: int, image_size: int, buckets, precision: str,
+    *, residency=None, n_devices: int = 0,
 ) -> dict:
     """Resident-byte estimate for one tenant's executable sets, from
     abstract shapes only (``jax.eval_shape`` — no device memory, no
     compute): params via leaf accounting, plus per-bucket activation
     high-water (the input batch and the [bucket, num_classes] logits —
     at the 64.5k-class head the logits ARE the spike). An estimate for
-    the PLANNER; the pool re-measures from the built state."""
+    the PLANNER; the pool re-measures from the built state.
+
+    A sharded ``residency`` makes every number PER CHIP (ISSUE 17):
+    params/K + the per-channel scale overhead, and activations at
+    ``ceil(bucket / data_degree)`` rows — batch rows (and the 64.5k-class
+    logits spike) divide over ``data``, not ``model``, so the activation
+    term shrinks with the OTHER mesh factor. A tenant whose sharded
+    footprint fits must never be rejected by the replicated estimate."""
     import jax
     import jax.numpy as jnp
 
@@ -170,25 +236,52 @@ def estimate_model_bytes(
         lambda r, x: model.init(r, x, train=True), rngs, dummy
     )
     precisions = ("bf16", "int8") if precision == "both" else (precision,)
-    params = sum(_spec_param_bytes(shapes, p) for p in precisions)
+    params_repl = sum(_spec_param_bytes(shapes, p) for p in precisions)
+    row_bytes = image_size * image_size * 3 * 4 + num_classes * 4
+    per_bucket_repl = {int(b): int(b) * row_bytes for b in buckets}
+    out = {
+        "params_bytes": int(params_repl),
+        "per_bucket_bytes": per_bucket_repl,
+        "total_bytes": int(params_repl) + max(per_bucket_repl.values(), default=0),
+    }
+    if residency is None or not residency.sharded:
+        return out
+    k = residency.degree
+    if n_devices and (n_devices % k or k > n_devices):
+        raise ValueError(
+            f"residency {residency} does not divide {n_devices} device(s)"
+        )
+    data_degree = max(1, (n_devices or k) // k)
+    params = scale_overhead = 0
+    for p in precisions:
+        pb, sb = _sharded_param_bytes(shapes, p, residency)
+        params += pb
+        scale_overhead += sb
     per_bucket = {
-        int(b): int(b) * (image_size * image_size * 3 * 4 + num_classes * 4)
-        for b in buckets
+        int(b): (-(-int(b) // data_degree)) * row_bytes for b in buckets
     }
-    return {
-        "params_bytes": int(params),
-        "per_bucket_bytes": per_bucket,
-        "total_bytes": int(params) + max(per_bucket.values(), default=0),
-    }
+    out.update(
+        replicated_total_bytes=out["total_bytes"],
+        params_bytes=int(params),
+        scale_overhead_bytes=int(scale_overhead),
+        per_bucket_bytes=per_bucket,
+        total_bytes=int(params) + max(per_bucket.values(), default=0),
+        residency=str(residency),
+        data_degree=data_degree,
+    )
+    return out
 
 
 @dataclass
 class PlanEntry:
     model: str
-    params_bytes: int
-    bucket_bytes: dict  # bucket -> bytes
-    total_bytes: int
+    params_bytes: int  # per chip when sharded
+    bucket_bytes: dict  # bucket -> bytes (per chip when sharded)
+    total_bytes: int  # per chip when sharded
     measured: bool = False  # True when sized from the BUILT state
+    residency: str = "replicated"  # "tp:K"/"fsdp:K" = model-parallel
+    replicated_bytes: int = 0  # the estimate sharding beat (sharded only)
+    scale_bytes: int = 0  # per-channel int8 scale overhead (sharded only)
 
 
 @dataclass
@@ -217,18 +310,39 @@ class PackingPlan:
         ]
         for e in sorted(self.entries, key=lambda e: -e.total_bytes):
             worst = max(e.bucket_bytes.values(), default=0)
-            lines.append(
-                f"  {e.model}: params {e.params_bytes / mb:.1f} MB + "
-                f"largest-bucket activations {worst / mb:.1f} MB = "
-                f"{e.total_bytes / mb:.1f} MB"
-                f" ({'measured' if e.measured else 'estimated'})"
-            )
+            if e.residency != "replicated":
+                # The per-chip arithmetic that made sharding win over
+                # eviction: params/K (+ whole per-channel scales) + the
+                # data-degree-divided activation high-water.
+                k = int(e.residency.rsplit(":", 1)[-1])
+                scales = (
+                    f" (incl {e.scale_bytes / mb:.1f} MB scales)"
+                    if e.scale_bytes else ""
+                )
+                lines.append(
+                    f"  {e.model} [{e.residency}]: params/{k} "
+                    f"{e.params_bytes / mb:.1f} MB/chip{scales} + "
+                    f"largest-bucket activations {worst / mb:.1f} MB/chip "
+                    f"= {e.total_bytes / mb:.1f} MB/chip — replicated "
+                    f"would be {e.replicated_bytes / mb:.1f} MB"
+                    f" ({'measured' if e.measured else 'estimated'})"
+                )
+            else:
+                lines.append(
+                    f"  {e.model}: params {e.params_bytes / mb:.1f} MB + "
+                    f"largest-bucket activations {worst / mb:.1f} MB = "
+                    f"{e.total_bytes / mb:.1f} MB"
+                    f" ({'measured' if e.measured else 'estimated'})"
+                )
         return "\n".join(lines)
+
+    def entry(self, model: str) -> PlanEntry | None:
+        return next((e for e in self.entries if e.model == model), None)
 
     def to_record(self) -> dict:
         """The stamp swap-in/evict records carry (MB, JSON-clean)."""
         mb = 1024 * 1024
-        return {
+        out = {
             "budget_mb": (
                 None if self.budget_bytes is None
                 else round(self.budget_bytes / mb, 1)
@@ -239,6 +353,13 @@ class PackingPlan:
                 e.model: round(e.total_bytes / mb, 1) for e in self.entries
             },
         }
+        sharded = {
+            e.model: e.residency for e in self.entries
+            if e.residency != "replicated"
+        }
+        if sharded:
+            out["residency"] = sharded
+        return out
 
 
 class ModelRegistry:
@@ -300,46 +421,142 @@ class ModelRegistry:
             s.model: (s.admission or share) for s in self._specs.values()
         }
 
-    def estimate_bytes(self, model: str) -> dict:
+    def estimate_bytes(
+        self, model: str, residency=None, n_devices: int = 0
+    ) -> dict:
         """Cached abstract-shape estimate for one tenant (planner input;
-        the pool overrides with measured bytes once the state is built)."""
-        if model not in self._estimates:
-            spec = self.spec(model)
+        the pool overrides with measured bytes once the state is built).
+        ``residency`` (``serve/sharding.Residency``) makes the estimate
+        per-chip; None = the spec's own residency."""
+        from mpi_pytorch_tpu.serve.sharding import parse_residency
+
+        spec = self.spec(model)
+        if residency is None:
+            residency = parse_residency(spec.shard)
+        if not residency.sharded and model in self._estimates:
+            # Bare-name entries are the pre-v13 cache shape AND the test
+            # seam (tests inject replicated estimates by model name).
+            return self._estimates[model]
+        key = (model, str(residency), int(n_devices) if residency.sharded else 0)
+        if key not in self._estimates:
             cfg = self.tenant_cfg(model)
-            self._estimates[model] = estimate_model_bytes(
+            self._estimates[key] = estimate_model_bytes(
                 spec.arch, cfg.num_classes, cfg.image_size[0],
                 cfg.parsed_serve_buckets(),
                 spec.precision or cfg.serve_precision,
+                residency=residency, n_devices=n_devices,
             )
-        return self._estimates[model]
+        return self._estimates[key]
+
+    def _plan_entry(
+        self, model: str, residency, n_devices: int,
+        measured: dict[str, int], residencies: dict[str, str],
+    ) -> PlanEntry:
+        est = self.estimate_bytes(model, residency=residency, n_devices=n_devices)
+        res_str = est.get("residency", "replicated")
+        # A measured (built-state) size only describes the residency it
+        # was measured AT — a proposed conversion falls back to the
+        # estimate until the pool re-measures the resharded state.
+        use_measured = (
+            model in measured
+            and residencies.get(model, "replicated") == res_str
+        )
+        total = measured[model] if use_measured else est["total_bytes"]
+        return PlanEntry(
+            model=model,
+            params_bytes=est["params_bytes"],
+            bucket_bytes=est["per_bucket_bytes"],
+            total_bytes=int(total),
+            measured=use_measured,
+            residency=res_str,
+            replicated_bytes=int(est.get("replicated_total_bytes", 0)),
+            scale_bytes=int(est.get("scale_overhead_bytes", 0)),
+        )
 
     def plan_packing(
         self, models, budget_bytes: int | None,
         measured: dict[str, int] | None = None,
+        *, n_devices: int = 0, residencies: dict[str, str] | None = None,
     ) -> PackingPlan:
         """The packing plan for ``models`` co-resident on one host.
         ``measured`` (model → bytes, from the pool's built states)
-        overrides the estimate where available. A SINGLE tenant
-        exceeding the budget alone is a spec error and raises
-        ``PackingError`` loudly — no eviction can ever make it fit."""
+        overrides the estimate where available; ``residencies`` names the
+        layout each measurement was taken at.
+
+        Third residency option (ISSUE 17): when the replicated set is over
+        budget and the host has chips to shard over (``n_devices``), the
+        planner converts the largest replicated tenants to ``fsdp:K`` —
+        smallest K first, so a tenant never spans more chips than the
+        budget requires — BEFORE the caller reaches for eviction. A single
+        tenant exceeding the budget even at the deepest shard degree is a
+        spec error and raises ``PackingError`` loudly."""
+        from mpi_pytorch_tpu.serve.sharding import Residency, parse_residency
+
         plan = PackingPlan(budget_bytes=budget_bytes)
         measured = measured or {}
+        residencies = residencies or {}
+        degrees = [
+            k for k in range(2, max(2, n_devices) + 1)
+            if n_devices and n_devices % k == 0
+        ]
         for model in models:
-            est = self.estimate_bytes(model)
-            total = measured.get(model, est["total_bytes"])
-            entry = PlanEntry(
-                model=model,
-                params_bytes=est["params_bytes"],
-                bucket_bytes=est["per_bucket_bytes"],
-                total_bytes=int(total),
-                measured=model in measured,
+            spec_res = parse_residency(
+                residencies.get(model) or self.spec(model).shard
+            )
+            entry = self._plan_entry(
+                model, spec_res, n_devices, measured, residencies
             )
             if budget_bytes is not None and entry.total_bytes > budget_bytes:
-                single = PackingPlan(budget_bytes=budget_bytes, entries=[entry])
-                raise PackingError(
-                    f"tenant {model!r} alone exceeds the packing budget — "
-                    "no eviction can make it fit. "
-                    + single.explain()
-                )
+                # Too big even alone at its declared residency: shard
+                # deeper before rejecting — the whole point of the third
+                # residency option is that "doesn't fit replicated" no
+                # longer means "can't be served".
+                for k in degrees:
+                    if k <= spec_res.degree:
+                        continue
+                    cand = self._plan_entry(
+                        model, Residency("fsdp", k), n_devices,
+                        measured, residencies,
+                    )
+                    if cand.total_bytes <= budget_bytes:
+                        entry = cand
+                        break
+                else:
+                    single = PackingPlan(
+                        budget_bytes=budget_bytes, entries=[entry]
+                    )
+                    raise PackingError(
+                        f"tenant {model!r} alone exceeds the packing budget "
+                        "at every shard degree — no eviction can make it "
+                        "fit. " + single.explain()
+                    )
             plan.entries.append(entry)
+        if budget_bytes is not None and not plan.fits and degrees:
+            # Over budget together: convert the largest replicated tenants
+            # to fsdp:K (smallest K that helps) until the plan fits — the
+            # explain() lines show the per-chip arithmetic of each win.
+            for entry in sorted(plan.entries, key=lambda e: -e.total_bytes):
+                if plan.fits:
+                    break
+                if entry.residency != "replicated":
+                    continue
+                others = plan.total_bytes - entry.total_bytes
+                for k in degrees:
+                    cand = self._plan_entry(
+                        entry.model, Residency("fsdp", k), n_devices,
+                        measured, residencies,
+                    )
+                    if others + cand.total_bytes <= budget_bytes:
+                        plan.entries[plan.entries.index(entry)] = cand
+                        break
+                else:
+                    # No single degree closes the gap alone: take the
+                    # deepest shard anyway if it helps, and keep
+                    # converting the next-largest tenant.
+                    cand = self._plan_entry(
+                        entry.model, Residency("fsdp", degrees[-1]),
+                        n_devices, measured, residencies,
+                    )
+                    if cand.total_bytes < entry.total_bytes:
+                        plan.entries[plan.entries.index(entry)] = cand
         return plan

@@ -38,6 +38,7 @@ from mpi_pytorch_tpu.serve.batcher import (
     ModelNotResidentError,
     QueueFullError,
     ServeError,
+    ServerClosedError,
     UnknownModelError,
 )
 from mpi_pytorch_tpu.serve.fleet.router import LocalHost
@@ -98,9 +99,7 @@ class ZooServer:
         # The STARTUP packing plan: the non-cold residents must fit
         # together with nothing to evict — over budget here is a spec
         # error, rejected loudly with the plan's arithmetic.
-        plan = self.registry.plan_packing(
-            startup, self._budget_bytes, measured=self.pool.measured_bytes()
-        )
+        plan = self._plan_with(startup)
         if not plan.fits:
             from mpi_pytorch_tpu.serve.zoo.registry import PackingError
 
@@ -158,8 +157,14 @@ class ZooServer:
             return dict(self._tenants)
 
     def _plan_with(self, models) -> object:
+        # n_devices + residencies unlock the planner's third option
+        # (shard:K) and gate measured bytes on the layout they were
+        # measured at (ISSUE 17).
         return self.registry.plan_packing(
-            models, self._budget_bytes, measured=self.pool.measured_bytes()
+            models, self._budget_bytes,
+            measured=self.pool.measured_bytes(),
+            n_devices=int(self.pool.mesh.devices.size),
+            residencies=self.pool.residencies(),
         )
 
     def _activate(self, model: str, event: str | None = "swap_in") -> None:
@@ -195,7 +200,25 @@ class ZooServer:
                 victim = evictable[0]
                 self._evict_locked_out(victim, reason=f"lru for {model}")
                 resident.remove(victim)
-            sets = self.pool.ensure(model)  # load + warm-probe (pool gates)
+            # The plan may have picked the THIRD residency option —
+            # shard:K — for the incoming tenant and/or for already-
+            # resident ones (shard beats evict). Apply resident
+            # conversions first so their freed bytes exist before the
+            # new build lands.
+            from mpi_pytorch_tpu.serve.sharding import parse_residency
+
+            for other in resident:
+                entry = plan.entry(other)
+                if entry is not None and (
+                    entry.residency != self.pool.residency(other)
+                ):
+                    self._convert_locked(
+                        other, entry.residency,
+                        reason=f"pack for {model}", plan=plan,
+                    )
+            entry = plan.entry(model)
+            want = parse_residency(entry.residency if entry else None)
+            sets = self.pool.ensure(model, residency=want)  # load + warm-probe
             tenant_cfg = self.registry.tenant_cfg(model)
             srv = InferenceServer(
                 tenant_cfg, executables=sets, metrics=self._metrics,
@@ -211,13 +234,115 @@ class ZooServer:
                     "zoo[%s]: cold swap-in of %s complete (resident %s)\n%s",
                     self.name, model, resident_now, plan.explain(),
                 )
-                self._metrics.write({
+                record = {
                     "kind": "fleet", "event": event,
                     "host": self.name, "model": model,
                     "resident": resident_now,
                     "compiles_after_warmup": srv.compiles_after_warmup(),
                     "plan": plan.to_record(),
-                })
+                }
+                res = self.pool.residency(model)
+                if res != "replicated":
+                    # A sharded swap-in crossed topologies on the way in:
+                    # say so, with the bytes the reshard actually moved
+                    # (schema v13).
+                    record["residency"] = res
+                    record["shard_degree"] = srv.shard_degree
+                    record["reshard_bytes"] = sum(
+                        int(e.reshard_stats.bytes_moved)
+                        for e in sets.values()
+                        if getattr(e, "reshard_stats", None) is not None
+                    )
+                self._metrics.write(record)
+
+    def _convert_locked(
+        self, model: str, residency, reason: str, plan=None,
+    ) -> None:
+        """Live residency conversion (``_swap_lock`` held): reshard the
+        pool sets through the bounded per-leaf path, stand a NEW tenant
+        server over the rebuilt executables, swap it in atomically, then
+        drain the old one — in-flight requests on the old server finish,
+        and a submit racing the swap retries once (``submit``). A failed
+        conversion (``ColdSwapError``) propagates with the OLD sets still
+        live and zero-compile."""
+        from mpi_pytorch_tpu.serve.server import InferenceServer
+
+        res_str = residency if isinstance(residency, str) else str(residency)
+        if self.pool.residency(model) == res_str:
+            return
+        new_sets, reshard_bytes = self.pool.reshard(model, res_str)
+        tenant_cfg = self.registry.tenant_cfg(model)
+        srv = InferenceServer(
+            tenant_cfg, executables=new_sets, metrics=self._metrics,
+            host_index=self.host_index, model=model, spans=self._spans,
+        )
+        with self._lock:
+            old = self._tenants.get(model)
+            self._tenants[model] = srv
+            self._generation += 1
+        if old is not None:
+            old.close(drain=True)
+        self._logger.info(
+            "zoo[%s]: converted tenant %s to %s (%s; %.1f MB moved)",
+            self.name, model, res_str, reason, reshard_bytes / 1e6,
+        )
+        record = {
+            "kind": "fleet", "event": "retune",
+            "host": self.name, "model": model,
+            "residency": res_str,
+            "shard_degree": srv.shard_degree,
+            "reshard_bytes": int(reshard_bytes),
+            "compiles_after_warmup": srv.compiles_after_warmup(),
+            "detail": reason,
+        }
+        if plan is not None:
+            record["plan"] = plan.to_record()
+        self._metrics.write(record)
+
+    def convert_residency(self, model: str, residency, *,
+                          reason: str = "operator") -> None:
+        """Operator/planner entry point: convert a RESIDENT tenant's
+        weight layout live (replicated↔tp:K↔fsdp:K)."""
+        if self._closed:
+            raise ServeError(f"zoo host {self.name} is shut down")
+        self.registry.spec(model)
+        self.tenant(model)  # ModelNotResidentError for non-residents
+        with self._swap_lock:
+            self._convert_locked(model, residency, reason=reason)
+
+    def set_pack_budget_mb(self, mb: float) -> None:
+        """Live packing-budget squeeze: re-plan the current residents at
+        the new budget and apply what the plan picked — residency
+        conversions FIRST (shard beats evict), LRU eviction only if the
+        plan still cannot fit every resident sharded."""
+        with self._swap_lock:
+            self._budget_bytes = int(float(mb) * 1024 * 1024) or None
+            with self._lock:
+                resident = list(self._tenants)
+            while True:
+                plan = self._plan_with(resident)
+                for m in resident:
+                    entry = plan.entry(m)
+                    if entry is not None and (
+                        entry.residency != self.pool.residency(m)
+                    ):
+                        self._convert_locked(
+                            m, entry.residency,
+                            reason="pack budget", plan=plan,
+                        )
+                if plan.fits or len(resident) <= 1:
+                    # A single over-budget resident stays up: serving
+                    # degraded beats serving nothing (the startup gate
+                    # already rejected truly impossible specs).
+                    break
+                with self._lock:
+                    evictable = sorted(
+                        resident,
+                        key=lambda m: self._last_used.get(m, 0.0),
+                    )
+                victim = evictable[0]
+                self._evict_locked_out(victim, reason="pack budget")
+                resident.remove(victim)
 
     def ensure_model(self, model: str) -> None:
         """Cold swap-in (idempotent): make ``model`` resident here —
@@ -270,16 +395,26 @@ class ZooServer:
                     f"(tenants: {sorted(registered)})"
                 )
             model = registered[0]
-        srv = self.tenant(model)
-        with self._lock:
-            self._last_used[model] = time.monotonic()
-        try:
-            if trace is not None:
-                return srv.submit(image, trace=trace)
-            return srv.submit(image)
-        except QueueFullError as e:
-            e.model = model  # the typed rejection names its tenant
-            raise
+        for attempt in range(2):
+            srv = self.tenant(model)
+            with self._lock:
+                self._last_used[model] = time.monotonic()
+            try:
+                if trace is not None:
+                    return srv.submit(image, trace=trace)
+                return srv.submit(image)
+            except QueueFullError as e:
+                e.model = model  # the typed rejection names its tenant
+                raise
+            except ServerClosedError:
+                # A live residency conversion swapped the tenant server
+                # between our lookup and the enqueue — the new server is
+                # already in the map; retry once. Only a host-level
+                # shutdown re-raises (zero lost requests through a
+                # conversion is the dryrun leg's assertion).
+                if attempt or self._closed:
+                    raise
+        raise AssertionError("unreachable")  # pragma: no cover
 
     def predict_batch(self, images, model: str | None = None,
                       timeout: float | None = None):
@@ -375,6 +510,11 @@ class ZooServer:
             # remote probe's facts cache coherent through swap-ins.
             "models": list(self.models()),
             "registered_models": list(self.registered_models()),
+            # model → residency: a sharded tenant is one logical host
+            # occupying K chips — the router's facts must say so.
+            "residency": {
+                m: self.pool.residency(m) for m in self.models()
+            },
             "facts_generation": self.facts_generation,
             "queue_capacity": self.queue_capacity,
             "max_wait_ms": first.max_wait_ms if first else None,
@@ -492,6 +632,14 @@ class TenantHandle:
     def parity_top1(self):
         return self._server.parity_top1
 
+    @property
+    def residency(self) -> str:
+        return getattr(self._server, "residency", "replicated")
+
+    @property
+    def shard_degree(self) -> int:
+        return int(getattr(self._server, "shard_degree", 1))
+
     def set_max_wait_ms(self, v: float) -> None:
         self._server.set_max_wait_ms(v)
 
@@ -527,6 +675,15 @@ class ZooHost(LocalHost):
 
     def evict_model(self, model: str) -> None:
         self.server.evict_model(model)
+
+    def residency(self, model: str) -> str:
+        """The tenant's weight layout — "replicated" or "tp:K"/"fsdp:K"
+        (a sharded tenant occupies K chips of this host's mesh)."""
+        return self.server.pool.residency(model)
+
+    def convert_residency(self, model: str, residency, *,
+                          reason: str = "operator") -> None:
+        self.server.convert_residency(model, residency, reason=reason)
 
     @property
     def facts_generation(self) -> int:

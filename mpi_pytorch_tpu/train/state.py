@@ -110,27 +110,36 @@ def _replicated_gather_fn(repl):
 _BOUNDED_LEAF_BYTES = 4 * 1024 * 1024
 
 
+def redistribute_to(host_array, sharding):
+    """The bounded-HBM placement core (arXiv 2112.01075's portable
+    redistribution, host-staged): place each device's shard of ``sharding``
+    directly from the host buffer (``make_array_from_single_device_arrays``)
+    — the peak device-side transient is ONE shard, never the full array a
+    plain ``device_put`` of the whole leaf would materialize, and each
+    process places only its addressable shards (multi-host safe). Shared by
+    the ZeRO reshard-on-load path below and the serve-side cross-topology
+    residency reshard (``serve/sharding.py``), so "never a gather of the
+    full tree" is one code path, not two disciplines."""
+    shape = host_array.shape
+    arrays = [
+        jax.device_put(host_array[idx], dev)
+        for dev, idx in sharding.addressable_devices_indices_map(shape).items()
+    ]
+    return jax.make_array_from_single_device_arrays(shape, sharding, arrays)
+
+
 def _row_redistribute(host_leaf, mesh, row_sharded, n_shards: int, chunk: int):
     """Chunked device redistribution of one HOST leaf into the
     ``zero_shard_spec`` ``[P, chunk]`` layout: pad on host, then place each
-    data-axis row directly on the devices that own it
-    (``make_array_from_single_device_arrays``) — no device ever holds more
-    than its own 1/P slice, and each process places only its addressable
-    rows (multi-host safe). This is the bounded-HBM half of the elastic
-    reshard-on-load dataflow (arXiv 2112.01075's portable redistribution,
-    host-staged: the source here is always checkpoint bytes, so the host
-    hop is already paid)."""
+    data-axis row directly on the devices that own it (``redistribute_to``)
+    — no device ever holds more than its own 1/P slice. The source here is
+    always checkpoint bytes, so the host hop is already paid."""
     import numpy as np
 
     flat = np.asarray(host_leaf).reshape(-1)
     padded = np.zeros((n_shards, chunk), flat.dtype)
     padded.reshape(-1)[: flat.size] = flat
-    shape = padded.shape
-    arrays = [
-        jax.device_put(padded[idx], dev)
-        for dev, idx in row_sharded.addressable_devices_indices_map(shape).items()
-    ]
-    return jax.make_array_from_single_device_arrays(shape, row_sharded, arrays)
+    return redistribute_to(padded, row_sharded)
 
 
 def zero_shard_opt_state(opt_state: Any, mesh, bounded_bytes: int | None = None) -> Any:

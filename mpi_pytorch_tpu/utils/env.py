@@ -152,6 +152,13 @@ FAULT_GATES: dict[str, str] = {
         "wave, never a PRNG) on top of MPT_FAULT_WIRE_DELAY_MS — a laggy "
         "wire that wobbles, with a delay schedule that replays exactly"
     ),
+    "MPT_FAULT_RESHARD_N": (
+        "fail the next N serve-side residency reshards (serve/sharding.py) "
+        "mid-tree, after some leaves have already been placed — the "
+        "failed-swap-in drill proving a dead reshard leaves every RESIDENT "
+        "tenant's zero-compile assertion intact (the rebaseline-in-finally "
+        "discipline)"
+    ),
     "MPT_PREEMPT_FILE": (
         "path to a preemption sentinel: when the file exists, the trainer's "
         "watchdog stops at the next safe boundary, saves, and exits 0 "

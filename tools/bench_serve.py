@@ -459,6 +459,11 @@ def main() -> int:
                     "row gains per_phase — the collector-derived "
                     "queue/preprocess/device/wire p50/p99 breakdown for "
                     "that sweep point (ISSUE 13)")
+    ap.add_argument("--serve-shard-degree", type=int, default=1,
+                    help="> 1: single-model MODEL-parallel serving — "
+                    "params fsdp:K-sharded over the model axis of a "
+                    "nested (data, model) serve mesh (ISSUE 17); rows "
+                    "gain shard_degree and key a separate trend line")
     ap.add_argument("--out", default="",
                     help="also write rows to this JSONL file (overwritten)")
     ap.add_argument("--smoke", action="store_true",
@@ -506,6 +511,13 @@ def main() -> int:
         # router — a single bare server has no front door to mint at.
         print("--trace-sample-rate needs --fleet N (the router is the "
               "minting front door)", file=sys.stderr)
+        return 2
+    if args.serve_shard_degree > 1 and (args.fleet > 0 or args.models):
+        # The single-model knob: a fleet's hosts each own the full mesh,
+        # and zoo tenants pick residency per-spec (shard=K) or from the
+        # packing planner instead.
+        print("--serve-shard-degree needs a bare single-model server "
+              "(no --fleet/--models)", file=sys.stderr)
         return 2
     cache_dir = ""
     if args.transport in ("remote", "framed"):
@@ -574,6 +586,7 @@ def main() -> int:
             serve_precision=serve_precision,
             serve_models=args.models,
             serve_pack_budget_mb=args.pack_budget_mb,
+            serve_shard_degree=max(1, args.serve_shard_degree),
             serve_transport="framed" if args.transport == "framed"
             else "http",
             serve_hedge=args.hedge,
@@ -648,6 +661,12 @@ def main() -> int:
                                 # Per-phase spans are not tenant-split:
                                 # attach only to single-model rows.
                                 row["per_phase"] = per_phase
+                            if args.serve_shard_degree > 1:
+                                # Schema-v13: the model-parallel axis is
+                                # its own trend-line identity — a sharded
+                                # row must never pair with a replicated
+                                # baseline.
+                                row["shard_degree"] = args.serve_shard_degree
                             if stamp_precision:
                                 row["precision"] = precision
                             if (precision == "int8"

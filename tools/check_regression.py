@@ -120,11 +120,14 @@ def _serve_key(row: dict) -> tuple:
     # regression" against the other's baseline. ``model`` has keyed the
     # identity since v4 — tenant rows never compare cross-model. Old rows
     # (no field) key as None on both sides, so prior-generation baselines
-    # keep comparing unchanged.
+    # keep comparing unchanged. shard_degree joined in v13: a
+    # model-parallel row (params sharded over K chips) is a different
+    # machine shape than the replicated row at the same sweep point.
     return (
         row.get("mode"), row.get("buckets"), row.get("max_wait_ms"),
         row.get("offered_rps"), row.get("model"), row.get("fleet_hosts"),
         row.get("precision"), row.get("transport"), row.get("load_shape"),
+        row.get("shard_degree"),
     )
 
 
