@@ -21,6 +21,14 @@ estimation, counter-reset detection, tail-based trace sampling, and
 schema-v9 ``kind="timeline"`` records (``collector.py``);
 ``tools/trace_report.py`` assembles the end-to-end request waterfalls.
 
+The READ path of that record (ISSUE 18): ``replay.py`` extracts a
+recorded fleet trace into a fingerprinted, replayable workload artifact
+and re-drives its exact arrival process against candidate configs;
+``model.py`` fits an explainable per-(model, bucket, precision,
+residency) device-time + queueing model from the same stream, with a
+stamped predicted-vs-replayed calibration error. ``tools/whatif.py``
+searches configs against both.
+
 Everything here is host-side and backend-agnostic: importing this package
 never initializes jax (the tools import the schema without a device), and
 the tracer/health hooks are inert unless the corresponding config knob is
@@ -46,7 +54,17 @@ from mpi_pytorch_tpu.obs.health import (
 )
 from mpi_pytorch_tpu.obs.heartbeat import Heartbeat, flag_stragglers
 from mpi_pytorch_tpu.obs.metrics import MetricsRegistry, resolve_metric
+from mpi_pytorch_tpu.obs.model import ModelError, PhaseLatencyModel
 from mpi_pytorch_tpu.obs.monitor import SLOMonitor, parse_rules
+from mpi_pytorch_tpu.obs.replay import (
+    Workload,
+    WorkloadError,
+    WorkloadRequest,
+    differential_report,
+    extract_workload,
+    load_workload,
+    replay_workload,
+)
 from mpi_pytorch_tpu.obs.schema import validate_jsonl, validate_record
 from mpi_pytorch_tpu.obs.trace import Tracer
 
@@ -55,12 +73,21 @@ __all__ = [
     "FlightRecorder",
     "Heartbeat",
     "MetricsRegistry",
+    "ModelError",
     "NonFiniteLossError",
+    "PhaseLatencyModel",
     "SLOMonitor",
     "SpanRecorder",
     "StepHealth",
     "TraceContext",
     "Tracer",
+    "Workload",
+    "WorkloadError",
+    "WorkloadRequest",
+    "differential_report",
+    "extract_workload",
+    "load_workload",
+    "replay_workload",
     "compile_count",
     "format_traceparent",
     "mint_trace",

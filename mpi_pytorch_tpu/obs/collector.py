@@ -489,11 +489,38 @@ class FleetCollector:
         for trace, ot in done:
             if self._keep(ot):
                 self.stats["traces_kept"] += 1
+                self._enrich_root(ot)
                 if self._trace_fh is not None:
                     for s in sorted(ot.spans, key=lambda s: s["t0"]):
                         self._trace_fh.write(json.dumps(s) + "\n")
             else:
                 self.stats["traces_dropped"] += 1
+
+    @staticmethod
+    def _enrich_root(ot: _OpenTrace) -> None:
+        """Schema v14: copy ``model``/``bucket``/``rows``/``precision``
+        from the winning ``serve/request`` span onto the ``route/request``
+        ROOT before the trace is written.  The router never knows which
+        bucket served a request — only the host does — so the join happens
+        here, making every recorded root reconstructible into a workload
+        (``obs/replay.py``) without re-walking the span tree."""
+        root = ot.root
+        if root is None:
+            return
+        serve = None
+        for s in ot.spans:
+            if s["name"] != "serve/request":
+                continue
+            serve = s
+            if (s.get("attrs") or {}).get("status") == "ok":
+                break  # prefer the attempt that completed (hedge/failover)
+        if serve is None:
+            return
+        attrs = root.setdefault("attrs", {})
+        src = serve.get("attrs") or {}
+        for k in ("model", "bucket", "rows", "precision"):
+            if k not in attrs and src.get(k) is not None:
+                attrs[k] = src[k]
 
     # ------------------------------------------------------------ timelines
 

@@ -1,0 +1,445 @@
+"""Trace-replay workloads (ISSUE 18): turn a recorded fleet trace into a
+canonical, replayable **workload artifact**.
+
+The fleet trace file (ISSUE 13, ``Config.fleet_trace_file``) is a JSONL
+stream of spans; every request that completed at the front door has a
+``route/request`` root span whose ``t0`` is its arrival wall time and
+whose v14 attrs carry ``model``/``bucket``/``rows``/``precision``.  This
+module extracts those roots into a :class:`Workload` — per-request
+arrival offsets normalized to t=0, tenant/model, bucket row counts,
+precision, and recorded outcomes — stamped with a content fingerprint so
+a tuning claim can cite exactly which load shape it was measured under.
+
+Layering: like the rest of ``obs`` this module never imports jax (or the
+serve package).  The replay driver talks to a server object through its
+``submit()`` surface only and classifies rejections by duck type, so it
+drives ``InferenceServer``, ``FleetServer``, ``ZooServer``, and
+``RemoteFleet`` alike.
+
+Fidelity caveats, documented rather than hidden:
+
+- The trace file is *tail sampled*.  At ``trace_sample_rate=1.0`` every
+  trace is kept and the extracted workload is exact; at lower rates the
+  arrival process is thinned toward kept traces (failed/slow/redispatched
+  requests are over-represented).  Record with sample rate 1.0 when the
+  workload is the point of the recording.
+- Pre-v14 traces lack ``model``/``bucket``/``rows``/``precision`` root
+  attrs.  They replay with documented defaults (``model=None``,
+  ``bucket=None``, ``rows=1``, ``precision=None``) instead of erroring;
+  ``Workload.defaults_applied`` counts how many requests were defaulted.
+- Replay re-drives **every recorded arrival**, including requests the
+  recorded fleet rejected: the arrival process is the workload, admission
+  is the candidate config's decision to make.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import threading
+import time
+from dataclasses import dataclass, field, replace
+
+ROOT_SPAN = "route/request"
+
+#: Defaults applied to pre-v14 root spans (documented, not an error).
+DEFAULT_MODEL = None
+DEFAULT_BUCKET = None
+DEFAULT_ROWS = 1
+DEFAULT_PRECISION = None
+
+_SPAN_REQUIRED = {"name": str, "t0": (int, float), "t1": (int, float)}
+
+
+class WorkloadError(ValueError):
+    """Typed rejection for malformed or truncated fleet-trace input.
+
+    Raised with the offending line number so a clipped recording (process
+    death mid-write) points at exactly where the stream went bad.
+    """
+
+
+@dataclass(frozen=True)
+class WorkloadRequest:
+    """One recorded front-door arrival."""
+
+    offset_s: float           # arrival offset from workload t=0
+    model: str | None         # tenant, None for single-model fleets
+    bucket: int | None        # bucket that served it (None pre-v14/rejected)
+    rows: int                 # rows in the flush that carried it
+    precision: str | None     # executable set that ran it
+    outcome: str              # "ok" | "rejected" | "failed:<Type>"
+
+    def key(self) -> tuple:
+        return (round(self.offset_s, 6), self.model, self.bucket,
+                self.rows, self.precision, self.outcome)
+
+    def to_dict(self) -> dict:
+        return {"offset_s": round(self.offset_s, 6), "model": self.model,
+                "bucket": self.bucket, "rows": self.rows,
+                "precision": self.precision, "outcome": self.outcome}
+
+
+@dataclass
+class Workload:
+    """A canonical replayable workload: the recorded arrival process plus
+    the recorded per-phase latency summary it should be compared against."""
+
+    requests: list[WorkloadRequest]
+    source: str = ""
+    recorded: dict = field(default_factory=dict)
+    defaults_applied: int = 0
+
+    # ------------------------------------------------------------ identity
+
+    @property
+    def fingerprint(self) -> str:
+        """Content fingerprint over the canonical request tuples.  Derived
+        stats (recorded percentiles, source path) are excluded: two
+        recordings of the same arrival process fingerprint identically,
+        and a warp/trim produces a *different* workload identity."""
+        blob = json.dumps([r.key() for r in self.requests],
+                          separators=(",", ":"))
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    # ------------------------------------------------------------- summary
+
+    @property
+    def duration_s(self) -> float:
+        return self.requests[-1].offset_s if self.requests else 0.0
+
+    @property
+    def accepted(self) -> int:
+        return sum(1 for r in self.requests if r.outcome == "ok")
+
+    @property
+    def rejected(self) -> int:
+        return sum(1 for r in self.requests if r.outcome == "rejected")
+
+    @property
+    def offered_rps(self) -> float:
+        if not self.requests:
+            return 0.0
+        return round(len(self.requests) / max(self.duration_s, 1e-6), 3)
+
+    @property
+    def rows_per_s(self) -> float:
+        if not self.requests:
+            return 0.0
+        rows = sum(r.rows for r in self.requests)
+        return round(rows / max(self.duration_s, 1e-6), 3)
+
+    @property
+    def models(self) -> list:
+        return sorted({r.model for r in self.requests if r.model is not None})
+
+    # ------------------------------------------------------------ transforms
+
+    def warp(self, speed: float) -> "Workload":
+        """Time-warp: ``speed=2.0`` replays twice as fast (offsets halved).
+        Returns a new workload with a new fingerprint — warped load is a
+        different load shape and must never share a trend line."""
+        if speed <= 0:
+            raise WorkloadError(f"speed must be > 0, got {speed}")
+        if speed == 1.0:
+            return self
+        reqs = [replace(r, offset_s=round(r.offset_s / speed, 6))
+                for r in self.requests]
+        return Workload(requests=reqs, source=self.source,
+                        recorded=dict(self.recorded),
+                        defaults_applied=self.defaults_applied)
+
+    def trim(self, start_s: float = 0.0,
+             end_s: float = math.inf) -> "Workload":
+        """Window trim to arrivals in ``[start_s, end_s)`` (offsets re-zeroed
+        to the window start)."""
+        if end_s <= start_s:
+            raise WorkloadError(
+                f"empty trim window [{start_s}, {end_s})")
+        kept = [r for r in self.requests if start_s <= r.offset_s < end_s]
+        if not kept:
+            raise WorkloadError(
+                f"trim window [{start_s}, {end_s}) contains no arrivals "
+                f"(workload spans 0..{self.duration_s:.3f}s)")
+        t0 = kept[0].offset_s
+        reqs = [replace(r, offset_s=round(r.offset_s - t0, 6)) for r in kept]
+        return Workload(requests=reqs, source=self.source,
+                        recorded=dict(self.recorded),
+                        defaults_applied=self.defaults_applied)
+
+    # ---------------------------------------------------------- persistence
+
+    def to_record(self) -> dict:
+        return {
+            "kind": "workload",
+            "fingerprint": self.fingerprint,
+            "source": self.source,
+            "requests": [r.to_dict() for r in self.requests],
+            "recorded": self.recorded,
+            "defaults_applied": self.defaults_applied,
+        }
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.to_record(), fh)
+            fh.write("\n")
+
+
+def _percentile(durs: list, q: float) -> float:
+    """Same rank formula as ``FleetCollector.drain_phase_stats`` so the
+    recorded and replayed sides of a differential are comparable."""
+    n = len(durs)
+    return round(durs[max(0, math.ceil(q * n) - 1)], 3)
+
+
+def _parse_span(line: str, lineno: int) -> dict:
+    try:
+        span = json.loads(line)
+    except json.JSONDecodeError as e:
+        raise WorkloadError(
+            f"trace line {lineno}: not valid JSON "
+            f"(truncated recording?): {e}") from None
+    if not isinstance(span, dict):
+        raise WorkloadError(
+            f"trace line {lineno}: span must be an object, "
+            f"got {type(span).__name__}")
+    for k, typ in _SPAN_REQUIRED.items():
+        if k not in span:
+            raise WorkloadError(f"trace line {lineno}: span missing {k!r}")
+        if not isinstance(span[k], typ) or isinstance(span[k], bool):
+            raise WorkloadError(
+                f"trace line {lineno}: span field {k!r} has type "
+                f"{type(span[k]).__name__}")
+    if span["t1"] < span["t0"]:
+        raise WorkloadError(
+            f"trace line {lineno}: span ends before it starts "
+            f"(t1 {span['t1']} < t0 {span['t0']})")
+    return span
+
+
+def extract_workload(path: str) -> Workload:
+    """Extract a :class:`Workload` from a fleet-trace JSONL file.
+
+    Every ``route/request`` root span becomes one arrival; all spans feed
+    the recorded per-phase percentile summary the differential report
+    compares against.  Malformed rows raise :class:`WorkloadError` with
+    the line number — a recording is an artifact, and a silently-skipped
+    row would corrupt the fingerprint.
+    """
+    roots: list[dict] = []
+    phases: dict = {}
+    defaults = 0
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, start=1):
+            if not line.strip():
+                continue
+            span = _parse_span(line, lineno)
+            phases.setdefault(span["name"], []).append(
+                1e3 * (span["t1"] - span["t0"]))
+            if span["name"] == ROOT_SPAN:
+                roots.append(span)
+    if not roots:
+        raise WorkloadError(
+            f"{path}: no {ROOT_SPAN!r} root spans — not a fleet trace "
+            "(or recorded before ISSUE 13 tracing)")
+    roots.sort(key=lambda s: s["t0"])
+    t_zero = roots[0]["t0"]
+    requests = []
+    for span in roots:
+        attrs = span.get("attrs") or {}
+        if not {"bucket", "rows", "precision"} & attrs.keys():
+            defaults += 1  # pre-v14 root: replay with documented defaults
+        requests.append(WorkloadRequest(
+            offset_s=round(span["t0"] - t_zero, 6),
+            model=attrs.get("model", DEFAULT_MODEL),
+            bucket=attrs.get("bucket", DEFAULT_BUCKET),
+            rows=attrs.get("rows", DEFAULT_ROWS),
+            precision=attrs.get("precision", DEFAULT_PRECISION),
+            outcome=str(attrs.get("status", "ok")),
+        ))
+    per_phase = {}
+    for name, durs in sorted(phases.items()):
+        durs.sort()
+        per_phase[name] = {"count": len(durs),
+                           "p50_ms": _percentile(durs, 0.50),
+                           "p99_ms": _percentile(durs, 0.99)}
+    wl = Workload(requests=requests, source=path,
+                  defaults_applied=defaults)
+    wl.recorded = {
+        "per_phase": per_phase,
+        "requests": len(requests),
+        "accepted": wl.accepted,
+        "rejected": wl.rejected,
+        "duration_s": round(wl.duration_s, 3),
+        "offered_rps": wl.offered_rps,
+    }
+    return wl
+
+
+def load_workload(path: str) -> Workload:
+    """Load either a saved workload artifact (``kind: workload`` JSON) or a
+    raw fleet-trace JSONL (auto-extracted)."""
+    with open(path) as fh:
+        head = fh.read(4096)
+    if '"kind"' in head.split("\n", 1)[0] and '"workload"' in head:
+        with open(path) as fh:
+            try:
+                rec = json.load(fh)
+            except json.JSONDecodeError as e:
+                raise WorkloadError(
+                    f"{path}: not a valid workload artifact: {e}") from None
+        if rec.get("kind") != "workload":
+            raise WorkloadError(
+                f"{path}: kind={rec.get('kind')!r}, expected 'workload'")
+        try:
+            reqs = [WorkloadRequest(**r) for r in rec["requests"]]
+        except (KeyError, TypeError) as e:
+            raise WorkloadError(
+                f"{path}: malformed workload request rows: {e}") from None
+        return Workload(requests=reqs, source=rec.get("source", path),
+                        recorded=rec.get("recorded", {}),
+                        defaults_applied=rec.get("defaults_applied", 0))
+    return extract_workload(path)
+
+
+# ---------------------------------------------------------------- replay
+
+
+def replay_workload(submit, workload: Workload, *,
+                    speed: float = 1.0, timeout_s: float = 120.0,
+                    clock=time.monotonic, sleep=time.sleep) -> dict:
+    """Re-drive the recorded arrival process against a candidate server.
+
+    ``submit(index, request)`` is called once per recorded arrival at its
+    recorded offset (warped by ``speed``) and must return a Future (or
+    raise — a raise with a ``retry_after_ms`` attribute or named
+    ``QueueFullError`` counts as an admission rejection, anything else as
+    a failure).  The caller owns image selection and the model kwarg, so
+    one driver serves every transport and the fake-clock tests.
+
+    Latency is measured from the *intended* arrival instant — scheduling
+    jitter counts against the replayed latency exactly as it does in the
+    recorded trace.  Returns the replayed point plus the measured arrival
+    fidelity (max |actual - intended| submit skew).
+    """
+    if speed != 1.0:
+        workload = workload.warp(speed)
+    lat_ms: list[float] = []
+    failures: list[str] = []
+    lock = threading.Lock()
+    pending: list = []
+    rejected = 0
+    max_skew_ms = 0.0
+    t_start = clock()
+    for i, req in enumerate(workload.requests):
+        target = t_start + req.offset_s
+        now = clock()
+        if target > now:
+            sleep(target - now)
+            now = clock()
+        max_skew_ms = max(max_skew_ms, 1e3 * abs(now - target))
+        t_intended = target
+
+        def _done(fut, t0=t_intended):
+            err = fut.exception()
+            with lock:
+                if err is None:
+                    lat_ms.append(1e3 * (clock() - t0))
+                else:
+                    failures.append(type(err).__name__)
+
+        try:
+            fut = submit(i, req)
+        except Exception as e:  # noqa: BLE001 — classify by duck type
+            if (hasattr(e, "retry_after_ms")
+                    or type(e).__name__ == "QueueFullError"):
+                rejected += 1
+            else:
+                failures.append(type(e).__name__)
+            continue
+        fut.add_done_callback(_done)
+        pending.append(fut)
+    deadline = clock() + timeout_s
+    for fut in pending:
+        try:
+            fut.result(timeout=max(0.0, deadline - clock()))
+        except Exception:  # noqa: BLE001 — recorded in the done callback
+            pass
+    wall = max(clock() - t_start, 1e-6)
+    with lock:
+        lat = sorted(lat_ms)
+        failed = len(failures)
+    out = {
+        "submitted": len(workload.requests),
+        "accepted": len(lat),
+        "rejected": rejected,
+        "failed": failed,
+        "wall_s": round(wall, 3),
+        "images_per_sec": round(len(lat) / wall, 2),
+        "max_arrival_skew_ms": round(max_skew_ms, 3),
+        "lat_ms": lat,
+    }
+    if lat:
+        out["p50_ms"] = _percentile(lat, 0.50)
+        out["p95_ms"] = _percentile(lat, 0.95)
+        out["p99_ms"] = _percentile(lat, 0.99)
+    return out
+
+
+# ---------------------------------------------------- differential report
+
+
+def differential_report(workload: Workload, replayed: dict,
+                        replayed_per_phase: dict | None = None) -> dict:
+    """Recorded vs replayed, per phase: where the candidate config moved
+    each phase, plus throughput and reject-rate deltas."""
+    rec = workload.recorded
+    rec_phases = rec.get("per_phase") or {}
+    rep_phases = replayed_per_phase or {}
+    phases = {}
+    for name in sorted(set(rec_phases) | set(rep_phases)):
+        r0, r1 = rec_phases.get(name), rep_phases.get(name)
+        ent = {}
+        if r0:
+            ent["recorded_p50_ms"] = r0["p50_ms"]
+            ent["recorded_p99_ms"] = r0["p99_ms"]
+        if r1:
+            ent["replayed_p50_ms"] = r1["p50_ms"]
+            ent["replayed_p99_ms"] = r1["p99_ms"]
+        if r0 and r1:
+            ent["delta_p99_pct"] = round(
+                100.0 * (r1["p99_ms"] - r0["p99_ms"])
+                / max(r0["p99_ms"], 1e-9), 1)
+        phases[name] = ent
+    rec_n = max(rec.get("requests", 0), 1)
+    rep_n = max(replayed.get("submitted", 0), 1)
+    return {
+        "workload": workload.fingerprint,
+        "phases": phases,
+        "recorded_reject_rate": round(rec.get("rejected", 0) / rec_n, 4),
+        "replayed_reject_rate": round(replayed.get("rejected", 0) / rep_n, 4),
+        "recorded_offered_rps": rec.get("offered_rps", 0.0),
+        "replayed_images_per_sec": replayed.get("images_per_sec", 0.0),
+    }
+
+
+def render_diff(diff: dict) -> list:
+    """Human-readable REPLAY diff lines — shared by bench_serve stderr,
+    ``report_run.py``, and ``summarize_benches.py``."""
+    lines = [
+        f"REPLAY [{diff.get('workload', '?')}] reject rate "
+        f"{diff.get('recorded_reject_rate', 0.0):.2%} recorded -> "
+        f"{diff.get('replayed_reject_rate', 0.0):.2%} replayed"
+    ]
+    for name, ent in sorted((diff.get("phases") or {}).items()):
+        if "recorded_p99_ms" in ent and "replayed_p99_ms" in ent:
+            lines.append(
+                f"  {name}: p99 {ent['recorded_p99_ms']:.1f}ms recorded -> "
+                f"{ent['replayed_p99_ms']:.1f}ms replayed "
+                f"({ent['delta_p99_pct']:+.1f}%)")
+        elif "replayed_p99_ms" in ent:
+            lines.append(
+                f"  {name}: p99 {ent['replayed_p99_ms']:.1f}ms replayed "
+                "(not in recording)")
+    return lines

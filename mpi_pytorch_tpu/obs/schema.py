@@ -170,7 +170,23 @@ from typing import Any, Mapping
 #      (the tenant's weight layout after the event — "replicated" /
 #      "tp:K" / "fsdp:K"), ``reshard_bytes`` (total bytes the bounded
 #      per-leaf cross-topology reshard moved), and ``shard_degree``.
-SCHEMA_VERSION = 13
+#  14: the trace-replay generation (ISSUE 18): fleet-trace ROOT spans
+#      (``route/request``) carry ``model``/``bucket``/``rows``/
+#      ``precision`` attrs (joined from the winning ``serve/request``
+#      span at collector finalize — trace files are spans, not metrics
+#      records, so this is documented here rather than type-checked;
+#      pre-v14 traces replay with documented defaults). ``serve_bench``
+#      rows may carry ``workload`` (the 16-hex content fingerprint of
+#      the replayed workload artifact — check_regression keys it so a
+#      replay row never compares against a synthetic-Poisson baseline),
+#      ``speed`` (the replay time-warp factor, absent at 1.0), and
+#      ``replay_diff`` (the recorded-vs-replayed differential report:
+#      per-phase p50/p99 both sides + reject-rate/throughput deltas).
+#      New ``whatif`` kind — one offline planner run (tools/whatif.py):
+#      the workload fingerprint, the ranked candidate plan, and the
+#      model's stamped calibration error. All absent on non-replay
+#      serving — streams stay byte-identical to v13.
+SCHEMA_VERSION = 14
 
 _NUM = (int, float)
 _INT = (int,)
@@ -222,6 +238,9 @@ REQUIRED: dict[str, dict[str, tuple]] = {
     # v12: one hedged-request race (serve/fleet/router.py): the host
     # whose completion won and the host whose attempt was revoked.
     "hedge": {"winner": (str,), "loser": (str,)},
+    # v14: one offline what-if planner run (tools/whatif.py): which
+    # workload it planned against and the ranked candidate list.
+    "whatif": {"workload": (str,), "ranked": (list,)},
 }
 
 OPTIONAL: dict[str, dict[str, tuple]] = {
@@ -307,6 +326,13 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # different trend line than a replicated one
         # (check_regression keys it).
         "shard_degree": _INT,
+        # v14: trace-replay rows (bench_serve --replay): the workload
+        # artifact's content fingerprint (keyed into the regression
+        # trend-line identity — replayed load never compares against
+        # synthetic Poisson), the time-warp factor (absent at 1.0), and
+        # the recorded-vs-replayed differential report. Absent on
+        # synthetic-load rows — streams stay byte-identical to v13.
+        "workload": (str,), "speed": _NUM, "replay_diff": (dict,),
     },
     "resume": {
         "from_devices": _INT, "from_mesh": (str,), "to_mesh": (str,),
@@ -406,6 +432,16 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
     # that fired the hedge, and the traced request's id.
     "hedge": {
         "cancelled": _INT, "deadline_ms": _NUM, "trace_id": (str,),
+    },
+    # v14: the winning candidate config (first ranked entry, repeated for
+    # direct access), the fitted model summary with its stamped
+    # calibration error, and — when --validate replayed the winner — the
+    # validated row's p99 and whether prediction landed inside the
+    # calibration bound.
+    "whatif": {
+        "winner": (dict,), "model": (dict,), "candidates": _INT,
+        "validated_p99_ms": _NUM, "within_calibration": _INT,
+        "calibration_error_pct": _NUM,
     },
 }
 

@@ -960,12 +960,17 @@ class FleetRouter:
                 status = "rejected"
             else:
                 status = f"failed:{type(error).__name__}"
+            attrs = {"status": status, "redispatches": entry.redispatches}
+            if entry.model is not None:
+                # v14: tenant on the completion root too (the rejection
+                # path already stamps it) so a recorded trace is
+                # reconstructible into a per-model workload.
+                attrs["model"] = entry.model
             self.spans.add(
                 name="route/request", trace=entry.trace.trace_id,
                 span=entry.trace.span_id, t0=entry.t_submit_wall,
                 t1=time.time(), host="router",
-                attrs={"status": status,
-                       "redispatches": entry.redispatches},
+                attrs=attrs,
             )
         if error is not None:
             entry.future.set_exception(error)

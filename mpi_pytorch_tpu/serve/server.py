@@ -910,7 +910,10 @@ class InferenceServer:
 
         for req in traced:
             ctx = req.trace
-            root_attrs = {"bucket": item.bucket, "req": req.req_id,
+            root_attrs = {"bucket": item.bucket,
+                          "rows": len(item.requests),
+                          "precision": item.precision,
+                          "req": req.req_id,
                           "status": "ok"}
             if self.model is not None:
                 root_attrs["model"] = self.model

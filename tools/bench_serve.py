@@ -46,6 +46,18 @@ p99/fill/rejected columns, ``load_shape`` stamped). ``model`` +
 ``load_shape`` key into ``check_regression``'s serve trend-line
 identity, so tenant rows never compare cross-model or cross-shape.
 
+``--replay <trace>`` (ISSUE 18) swaps the synthetic load for a RECORDED
+one: the fleet trace's ``route/request`` roots are extracted into a
+fingerprinted workload artifact (``obs/replay.py``) and their exact
+arrival process is re-driven against the candidate config, over any
+transport. Rows stamp ``mode="replay"``, the workload fingerprint (its
+own regression trend line — never compared against synthetic Poisson),
+and ``replay_diff`` — the recorded-vs-replayed per-phase differential
+report. Record with ``--fleet N --trace-sample-rate 1.0
+--fleet-trace-file t.jsonl``; replay with ``--replay t.jsonl``
+(``--speed``/``--replay-window`` warp and trim, changing the
+fingerprint).
+
 Run: ``python tools/bench_serve.py --smoke [--out docs/serve_bench.json]``
      ``python tools/bench_serve.py --bucket-sets "1,8,32,128;1,32,512" \
         --max-wait-ms 2,5,10 --requests 2000 --rps 0,500,2000``
@@ -386,6 +398,62 @@ def run_point(server, pool, *, mode, requests, concurrency, rps, seed, timeout_s
     return row
 
 
+def run_point_replay(server, pool, workload, *, timeout_s, fleet_hosts=0,
+                     use_models=False):
+    """One trace-replay sweep point (ISSUE 18): re-drive the workload's
+    RECORDED arrival process against the candidate server. Latency is
+    measured from each intended arrival instant (open_loop semantics).
+    Admission rejections are SHED, never deferred — a deferral would
+    distort the recorded arrival process the row claims to have replayed,
+    so the reject count is the candidate config's honest admission answer
+    to this exact load shape."""
+    from mpi_pytorch_tpu.obs.replay import replay_workload
+
+    stats0 = server.stats()
+    snaps0 = server.host_snapshots() if fleet_hosts else None
+
+    def submit(i, req):
+        if use_models and req.model is not None:
+            return server.submit(pool[i % len(pool)], model=req.model)
+        return server.submit(pool[i % len(pool)])
+
+    res = replay_workload(submit, workload, timeout_s=timeout_s)
+    stats1 = server.stats()
+    served = stats1["served"] - stats0["served"]
+    padded = stats1["padded_rows"] - stats0["padded_rows"]
+    fill = served / (served + padded) if served + padded else 0.0
+    if res["failed"]:
+        print(f"WARNING: {res['failed']} replayed request(s) FAILED "
+              "(not admission rejects) — the row undercounts them",
+              file=sys.stderr)
+    row = {
+        "kind": "serve_bench",
+        "ts": time.time(),
+        "mode": "replay",
+        "requests": res["accepted"],
+        "rejected": res["rejected"],
+        "offered_rps": workload.offered_rps,
+        "images_per_sec": res["images_per_sec"],
+        "mean_fill_ratio": round(fill, 4),
+        "compiles_after_warmup": stats1["compiles_after_warmup"],
+        **_percentiles(res["lat_ms"]),
+    }
+    copies = _sum_host_stat(stats1, "input_copies") - _sum_host_stat(
+        stats0, "input_copies"
+    )
+    if served > 0 and copies > 0:
+        row["copies_per_request"] = round(copies / served, 6)
+    hedges1 = stats1.get("router", {}).get("hedges")
+    if hedges1 is not None:
+        row["hedged"] = hedges1 - (stats0.get("router", {}).get("hedges") or 0)
+    if fleet_hosts:
+        row["fleet_hosts"] = fleet_hosts
+        row["per_host"] = _per_host_breakdown(
+            snaps0, server.host_snapshots(), stats0, stats1
+        )
+    return row
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--model", default="resnet18")
@@ -459,6 +527,29 @@ def main() -> int:
                     "row gains per_phase — the collector-derived "
                     "queue/preprocess/device/wire p50/p99 breakdown for "
                     "that sweep point (ISSUE 13)")
+    ap.add_argument("--replay", default="",
+                    help="path to a fleet-trace JSONL (or a saved workload "
+                    "artifact) to REPLAY (ISSUE 18): re-drive the recorded "
+                    "arrival process — not Poisson — against the candidate "
+                    "config over either transport. --rps is ignored; each "
+                    "(bucket set, precision, wait) point yields one "
+                    "mode='replay' row stamped with the workload "
+                    "fingerprint and the recorded-vs-replayed differential "
+                    "report (replay_diff)")
+    ap.add_argument("--speed", type=float, default=1.0,
+                    help="with --replay: time-warp factor (2.0 = replay "
+                    "twice as fast). Warping changes the workload "
+                    "fingerprint — a warped replay is its own trend line; "
+                    "rows also stamp speed")
+    ap.add_argument("--replay-window", default="",
+                    help="with --replay: 'START,END' arrival-offset window "
+                    "in seconds — trim the workload to arrivals in "
+                    "[START, END) before replaying")
+    ap.add_argument("--fleet-trace-file", default="",
+                    help="with --fleet N and --trace-sample-rate > 0: write "
+                    "the kept traces to this JSONL — the RECORD half of "
+                    "the record-and-replay recipe (record at sample rate "
+                    "1.0 for an exact workload)")
     ap.add_argument("--serve-shard-degree", type=int, default=1,
                     help="> 1: single-model MODEL-parallel serving — "
                     "params fsdp:K-sharded over the model axis of a "
@@ -528,10 +619,43 @@ def main() -> int:
 
         cache_dir = tempfile.mkdtemp(prefix="mpt_bench_remote_cache_")
 
+    workload = None
+    if args.replay:
+        from mpi_pytorch_tpu.obs.replay import WorkloadError, load_workload
+
+        try:
+            workload = load_workload(args.replay)
+            if args.replay_window:
+                try:
+                    start_s, end_s = (
+                        float(x) for x in args.replay_window.split(","))
+                except ValueError:
+                    print("--replay-window wants 'START,END' seconds",
+                          file=sys.stderr)
+                    return 2
+                workload = workload.trim(start_s, end_s)
+            if args.speed != 1.0:
+                # Warp HERE so the fingerprint stamped on rows identifies
+                # the arrival process actually replayed.
+                workload = workload.warp(args.speed)
+        except (OSError, WorkloadError) as e:
+            print(f"--replay: {e}", file=sys.stderr)
+            return 2
+        if workload.defaults_applied:
+            print(f"note: {workload.defaults_applied} recorded request(s) "
+                  "predate schema v14 root attrs — replayed with documented "
+                  "defaults (model=None, rows=1)", file=sys.stderr)
+        print(f"replaying workload {workload.fingerprint}: "
+              f"{len(workload.requests)} arrivals over "
+              f"{workload.duration_s:.2f}s ({workload.offered_rps} rps)",
+              file=sys.stderr)
+
     out_rows = []
     pool = _image_pool(32, (args.image, args.image), args.seed)
     waits = [float(w) for w in args.max_wait_ms.split(",") if w.strip()]
     rates = [float(r) for r in args.rps.split(",") if r.strip()]
+    if workload is not None:
+        rates = [0.0]  # one replay point per (set, precision, wait)
     precisions = [p.strip() for p in args.precision.split(",") if p.strip()]
     bad_prec = sorted(set(precisions) - {"bf16", "int8"})
     if not precisions or bad_prec:
@@ -592,6 +716,7 @@ def main() -> int:
             serve_hedge=args.hedge,
             compilation_cache_dir=cache_dir,
             trace_sample_rate=args.trace_sample_rate,
+            fleet_trace_file=args.fleet_trace_file,
             # The collector is what derives the per-phase breakdown; a
             # tight scrape keeps the sweep point's spans inside the point.
             serve_collect_interval_s=0.1 if args.trace_sample_rate > 0
@@ -617,7 +742,17 @@ def main() -> int:
                     server.set_max_wait_ms(wait_ms)
                     for rps in rates:
                         mode = "open" if rps > 0 else "closed"
-                        if tenant_models:
+                        if workload is not None:
+                            row = run_point_replay(
+                                server, pool, workload,
+                                timeout_s=args.timeout_s,
+                                fleet_hosts=max(0, args.fleet),
+                                use_models=bool(tenant_models),
+                            )
+                            if not tenant_models:
+                                row["model"] = args.model
+                            rows = [row]
+                        elif tenant_models:
                             rows = run_point_tenants(
                                 server, pool, tenant_models, tenant_weights,
                                 mode=mode, requests=args.requests,
@@ -661,6 +796,27 @@ def main() -> int:
                                 # Per-phase spans are not tenant-split:
                                 # attach only to single-model rows.
                                 row["per_phase"] = per_phase
+                            if workload is not None:
+                                from mpi_pytorch_tpu.obs.replay import (
+                                    differential_report,
+                                    render_diff,
+                                )
+
+                                row["workload"] = workload.fingerprint
+                                if args.speed != 1.0:
+                                    row["speed"] = args.speed
+                                diff = differential_report(
+                                    workload,
+                                    {"submitted": (row["requests"]
+                                                   + row["rejected"]),
+                                     "rejected": row["rejected"],
+                                     "images_per_sec":
+                                         row["images_per_sec"]},
+                                    per_phase,
+                                )
+                                row["replay_diff"] = diff
+                                for line in render_diff(diff):
+                                    print(line, file=sys.stderr)
                             if args.serve_shard_degree > 1:
                                 # Schema-v13: the model-parallel axis is
                                 # its own trend-line identity — a sharded

@@ -123,11 +123,16 @@ def _serve_key(row: dict) -> tuple:
     # keep comparing unchanged. shard_degree joined in v13: a
     # model-parallel row (params sharded over K chips) is a different
     # machine shape than the replicated row at the same sweep point.
+    # workload joined in v14: a trace-replay row carries the replayed
+    # workload's content fingerprint, so replayed-load trend lines never
+    # compare against synthetic-Poisson baselines (and two replays only
+    # compare when they re-drove the IDENTICAL arrival process);
+    # pre-v14 rows key None on both sides, unchanged.
     return (
         row.get("mode"), row.get("buckets"), row.get("max_wait_ms"),
         row.get("offered_rps"), row.get("model"), row.get("fleet_hosts"),
         row.get("precision"), row.get("transport"), row.get("load_shape"),
-        row.get("shard_degree"),
+        row.get("shard_degree"), row.get("workload"),
     )
 
 

@@ -26,7 +26,11 @@ run recorded that kind:
   metrics, clock-offset estimate, counter resets absorbed) and the
   serve-bench rows' collector-derived per-phase p99 lines — the full
   cross-process waterfalls render via ``tools/trace_report.py`` over the
-  collector's trace file.
+  collector's trace file;
+- trace-replay differentials (ISSUE 18): recorded-vs-replayed per-phase
+  p99 lines for serve-bench rows stamped with a workload fingerprint,
+  and the what-if planner's ranked candidate table with the winner's
+  replay-validation verdict.
 
 Every record is validated against the shared schema
 (``mpi_pytorch_tpu/obs/schema.py``) first: malformed records are listed and
@@ -48,6 +52,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from mpi_pytorch_tpu.obs.replay import render_diff  # noqa: E402
 from mpi_pytorch_tpu.obs.schema import load_records, validate_jsonl  # noqa: E402
 
 
@@ -262,8 +267,19 @@ def summarize(records: list[dict]) -> dict:
                 "rejected", "p50_ms", "p95_ms", "p99_ms", "images_per_sec",
                 "compiles_after_warmup", "fleet_hosts", "precision",
                 "parity_top1", "per_phase", "model", "load_shape",
+                "workload", "speed", "replay_diff",
             )}
             for r in serve_bench
+        ]
+    whatifs = by_kind.get("whatif", [])
+    if whatifs:
+        summary["whatif"] = [
+            {k: w.get(k) for k in (
+                "workload", "candidates", "ranked", "winner",
+                "validated_p99_ms", "within_calibration",
+                "calibration_error_pct",
+            )}
+            for w in whatifs
         ]
     routes = by_kind.get("route", [])
     if routes:
@@ -563,6 +579,46 @@ def render(path: str, records: list[dict], summary: dict) -> str:
             out.append(
                 f"  per-phase [{r['mode']} {r['buckets']} @ "
                 f"{r['max_wait_ms']} ms]: " + ", ".join(parts)
+            )
+        # The v14 trace-replay differential: recorded vs replayed per-phase
+        # p99 for rows that re-drove a fingerprinted workload (mode=replay).
+        for r in rows:
+            diff = r.get("replay_diff")
+            if isinstance(diff, dict):
+                out.append("")
+                out += ["  " + ln for ln in render_diff(diff)]
+                if r.get("speed") is not None:
+                    out.append(f"    (time-warped x{r['speed']})")
+    for w in summary.get("whatif", []):
+        # The v14 what-if plan: model-ranked candidate configs for a
+        # fingerprinted workload, with the winner's replay validation.
+        out += ["", (
+            f"what-if plan [workload {w.get('workload')}]: "
+            f"{w.get('candidates')} candidate(s) ranked"
+        )]
+        ranked = [r for r in (w.get("ranked") or []) if "error" not in r]
+        if ranked:
+            out.append(table(
+                ["rank", "buckets", "precision", "hosts", "wait_ms",
+                 "pred_p99", "rho", "saturated"],
+                [[r.get("rank"), str((r.get("config") or {}).get("buckets")),
+                  (r.get("config") or {}).get("precision"),
+                  (r.get("config") or {}).get("hosts"),
+                  (r.get("config") or {}).get("max_wait_ms"),
+                  r.get("p99_ms"), r.get("rho"),
+                  "yes" if r.get("saturated") else ""]
+                 for r in ranked],
+            ))
+        skipped = len(w.get("ranked") or []) - len(ranked)
+        if skipped:
+            out.append(f"  ({skipped} candidate(s) unmodelable — no fit key)")
+        if w.get("validated_p99_ms") is not None:
+            verdict = ("WITHIN" if w.get("within_calibration")
+                       else "OUTSIDE")
+            out.append(
+                f"  winner replayed: p99 {_fmt(w['validated_p99_ms'])} ms — "
+                f"{verdict} stamped calibration "
+                f"±{_fmt(w.get('calibration_error_pct'), 1)}%"
             )
     if "fleet_routing" in summary:
         fr = summary["fleet_routing"]

@@ -15,6 +15,10 @@ import json
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_pytorch_tpu.obs.replay import render_diff  # noqa: E402
+
 
 def _load(path):
     if not os.path.exists(path):
@@ -162,20 +166,30 @@ def main() -> None:
         # print the same table as before.
         tenants = any(r.get("load_shape") for r in sb)
         tenant_head = "model | shape | " if tenants else ""
+        # The v14 workload column: only rendered when some row replayed a
+        # fingerprinted workload — pre-v14 artifacts print the same table.
+        replays = any(r.get("workload") for r in sb)
+        workload_head = "workload | " if replays else ""
         print(f"| mode | buckets | wait ms | offered rps | {tenant_head}"
+              f"{workload_head}"
               "prec | fleet | p50 ms | p95 ms | p99 ms | img/s | fill | "
               "rejected | compiles |")
-        print("|---" * (13 + (2 if tenants else 0)) + "|")
+        print("|---" * (13 + (2 if tenants else 0) + (1 if replays else 0))
+              + "|")
         for r in sb:
             rps = r.get("offered_rps")
             tenant_cells = (
                 f"{r.get('model') or '—'} | {r.get('load_shape') or '—'} | "
                 if tenants else ""
             )
+            workload_cells = (
+                f"{r.get('workload') or '—'} | " if replays else ""
+            )
             print(
                 f"| {r['mode']} | {_cell(r['buckets'])} | {r['max_wait_ms']} | "
                 f"{'—' if rps is None else rps} | "
                 f"{tenant_cells}"
+                f"{workload_cells}"
                 f"{r.get('precision') or 'bf16'} | "
                 f"{r.get('fleet_hosts') or '—'} | {r['p50_ms']} | "
                 f"{r['p95_ms']} | {r['p99_ms']} | {r['images_per_sec']:,.0f} | "
@@ -211,6 +225,18 @@ def main() -> None:
                 ]
                 print(f"| {r['mode']} | {_cell(r['buckets'])} | "
                       f"{r['max_wait_ms']} | " + " | ".join(cells) + " |")
+        # The v14 replay differential: recorded vs replayed per-phase p99
+        # for rows that re-drove a fingerprinted workload (cite the
+        # fingerprint when quoting these numbers — SERVING.md).
+        diff_rows = [r for r in sb if isinstance(r.get("replay_diff"), dict)]
+        if diff_rows:
+            print("\n### trace-replay differential "
+                  "(tools/bench_serve.py --replay)\n")
+            print("```")
+            for r in diff_rows:
+                for ln in render_diff(r["replay_diff"]):
+                    print(ln)
+            print("```")
         print()
 
     for name in ("roofline_resnet18.txt", "roofline_densenet121.txt",
