@@ -455,6 +455,38 @@ class Config:
     serve_scale_cooldown_s: float = 30.0
     # Front-door rejects/s that trigger a scale-up.
     serve_scale_reject_rate: float = 0.5
+    # --- quality observability (ISSUE 19) ---
+    # serve_canary_probes > 0 arms the golden-set quality canary: that
+    # many seeded probe images per tenant go through the REAL front door
+    # as shadow requests (excluded from SLO/admission/billing counters),
+    # scored against references pinned on the first cycle; the latched
+    # per-tenant verdict gates EVERY fleet mutation (zoo swap-in /
+    # set_precision / convert_residency, controller retunes) — a FAIL
+    # verdict blocks the mutation until the canary recovers. 0 = off.
+    serve_canary_probes: int = 0
+    # Probe-cycle period for the background prober; 0 keeps the canary
+    # armed but passive (drive fleet.prober.probe_once() yourself — the
+    # tests/CI mode).
+    serve_canary_interval_s: float = 0.0
+    # Top-1 agreement below this fails a probe cycle; fail_after
+    # consecutive failing cycles trip the verdict to FAIL, pass_after
+    # passing cycles recover it (hysteresis — one noisy cycle is not an
+    # incident, one good cycle is not a recovery).
+    serve_canary_min_top1: float = 0.95
+    serve_canary_fail_after: int = 2
+    serve_canary_pass_after: int = 2
+    # serve_drift_window > 0 arms prediction-drift detection: per-tenant
+    # top-1 class histograms over windows of this many REAL requests,
+    # compared against a rolling clean baseline by PSI + chi-squared;
+    # breaches write kind="alert" source="drift" records (which pin
+    # traces and auto-dump the flight recorder). The prober's heartbeat
+    # also runs a CUSUM change-point scan over the collector's
+    # per-(host, metric) rings with threshold serve_drift_cusum_h (in
+    # sigma units of the learned reference). 0 = off.
+    serve_drift_window: int = 0
+    serve_drift_psi: float = 0.25
+    serve_drift_chi2: float = 10.0
+    serve_drift_cusum_h: float = 8.0
 
     # --- validation semantics (main.py:104-112 validates on the TRAIN split) ---
     val_on_train: bool = True
@@ -989,6 +1021,86 @@ class Config:
                 raise ValueError(
                     f"serve_scale_reject_rate must be >= 0, got "
                     f"{self.serve_scale_reject_rate}"
+                )
+        if self.serve_canary_probes < 0:
+            raise ValueError(
+                f"serve_canary_probes must be >= 0 (0 disables the quality "
+                f"canary), got {self.serve_canary_probes}"
+            )
+        if not self.serve_canary_probes:
+            # The silently-ignored rule: the canary policy knobs are only
+            # read by CanaryGate/CanaryProber.
+            defaults = {
+                "serve_canary_interval_s": 0.0,
+                "serve_canary_min_top1": 0.95,
+                "serve_canary_fail_after": 2, "serve_canary_pass_after": 2,
+            }
+            for knob, default in defaults.items():
+                if getattr(self, knob) != default:
+                    raise ValueError(
+                        f"{knob} configures the quality canary and needs "
+                        "--serve-canary-probes > 0 (without it the knob "
+                        "would be silently ignored)"
+                    )
+        else:
+            if self.serve_canary_interval_s < 0:
+                raise ValueError(
+                    f"serve_canary_interval_s must be >= 0 (0 = passive, "
+                    f"drive probe_once), got {self.serve_canary_interval_s}"
+                )
+            if not 0.0 < self.serve_canary_min_top1 <= 1.0:
+                raise ValueError(
+                    f"serve_canary_min_top1 must be in (0, 1], got "
+                    f"{self.serve_canary_min_top1}"
+                )
+            if self.serve_canary_fail_after < 1:
+                raise ValueError(
+                    f"serve_canary_fail_after must be >= 1, got "
+                    f"{self.serve_canary_fail_after}"
+                )
+            if self.serve_canary_pass_after < 1:
+                raise ValueError(
+                    f"serve_canary_pass_after must be >= 1, got "
+                    f"{self.serve_canary_pass_after}"
+                )
+        if self.serve_drift_window < 0:
+            raise ValueError(
+                f"serve_drift_window must be >= 0 (0 disables drift "
+                f"detection), got {self.serve_drift_window}"
+            )
+        if not self.serve_drift_window:
+            # Same rule for the drift thresholds: only DriftMonitor reads
+            # them.
+            defaults = {
+                "serve_drift_psi": 0.25, "serve_drift_chi2": 10.0,
+                "serve_drift_cusum_h": 8.0,
+            }
+            for knob, default in defaults.items():
+                if getattr(self, knob) != default:
+                    raise ValueError(
+                        f"{knob} configures drift detection and needs "
+                        "--serve-drift-window > 0 (without it the knob "
+                        "would be silently ignored)"
+                    )
+        else:
+            if self.serve_drift_window < 8:
+                raise ValueError(
+                    f"serve_drift_window must be >= 8 for a meaningful "
+                    f"histogram compare, got {self.serve_drift_window}"
+                )
+            if self.serve_drift_psi <= 0:
+                raise ValueError(
+                    f"serve_drift_psi must be > 0, got {self.serve_drift_psi}"
+                )
+            if self.serve_drift_chi2 <= 0:
+                raise ValueError(
+                    f"serve_drift_chi2 must be > 0, "
+                    f"got {self.serve_drift_chi2}"
+                )
+            if self.serve_drift_cusum_h <= 0:
+                raise ValueError(
+                    f"serve_drift_cusum_h must be > 0, "
+                    f"got {self.serve_drift_cusum_h}"
                 )
         if self.resume_retries < 0:
             raise ValueError(

@@ -21,6 +21,16 @@ estimation, counter-reset detection, tail-based trace sampling, and
 schema-v9 ``kind="timeline"`` records (``collector.py``);
 ``tools/trace_report.py`` assembles the end-to-end request waterfalls.
 
+The QUALITY layer (ISSUE 19): ``canary.py`` — a seeded golden probe set
+per tenant driven through the real front door as shadow requests, scored
+against pinned reference fingerprints, with a latched per-tenant verdict
+(``CanaryGate``) every fleet mutation consults before acting; and
+``drift.py`` — streaming sketches of the live top-1 prediction stream
+compared to a rolling baseline via PSI/chi-squared, plus CUSUM /
+Page-Hinkley change-point detection over the collector's metric rings,
+emitting ``source="drift"`` alerts that pin in-flight traces and
+auto-dump flight evidence.
+
 The READ path of that record (ISSUE 18): ``replay.py`` extracts a
 recorded fleet trace into a fingerprinted, replayable workload artifact
 and re-drives its exact arrival process against candidate configs;
@@ -36,6 +46,13 @@ set — telemetry is opt-in per run, except the NaN sentinel, which defaults
 on (training on a NaN'd loss is never the right outcome).
 """
 
+from mpi_pytorch_tpu.obs.canary import (
+    CanaryBlockedError,
+    CanaryGate,
+    CanaryProber,
+    golden_inputs,
+    score_probes,
+)
 from mpi_pytorch_tpu.obs.collector import FleetCollector
 from mpi_pytorch_tpu.obs.context import (
     SpanRecorder,
@@ -43,6 +60,15 @@ from mpi_pytorch_tpu.obs.context import (
     format_traceparent,
     mint_trace,
     parse_traceparent,
+)
+from mpi_pytorch_tpu.obs.drift import (
+    Cusum,
+    DriftMonitor,
+    PageHinkley,
+    PredictionSketch,
+    chi_squared,
+    entropy_bits,
+    psi,
 )
 from mpi_pytorch_tpu.obs.flight import FlightRecorder
 from mpi_pytorch_tpu.obs.health import (
@@ -69,8 +95,15 @@ from mpi_pytorch_tpu.obs.schema import validate_jsonl, validate_record
 from mpi_pytorch_tpu.obs.trace import Tracer
 
 __all__ = [
+    "CanaryBlockedError",
+    "CanaryGate",
+    "CanaryProber",
+    "Cusum",
+    "DriftMonitor",
     "FleetCollector",
     "FlightRecorder",
+    "PageHinkley",
+    "PredictionSketch",
     "Heartbeat",
     "MetricsRegistry",
     "ModelError",
@@ -88,8 +121,13 @@ __all__ = [
     "extract_workload",
     "load_workload",
     "replay_workload",
+    "chi_squared",
     "compile_count",
+    "entropy_bits",
     "format_traceparent",
+    "golden_inputs",
+    "psi",
+    "score_probes",
     "mint_trace",
     "parse_traceparent",
     "device_bytes_in_use",

@@ -1,0 +1,435 @@
+"""Golden-set quality canary: seeded per-tenant probes through the real
+front door, scored against pinned reference fingerprints, gating every
+fleet mutation (ISSUE 19 tentpole 1+3).
+
+PR 11's ``parity_top1`` is a one-shot startup stamp; ROADMAP item 1's
+live weight rollout needs the continuous version — "is this tenant still
+answering like the weights we registered?" — as a fleet-wide, per-tenant
+signal every mutation path can consult. Three pieces:
+
+- **Golden set** (``golden_inputs``): a small deterministic probe set
+  per tenant, minted with the ``measure_parity_top1`` input idiom
+  (seeded ``default_rng``, uint8 images in the serve path's submit
+  shape) — the seed keys on (run seed, tenant name) via crc32 so every
+  process, every restart, and every re-pin regenerates byte-identical
+  probes.
+- **Gate** (``CanaryGate``): holds each tenant's pinned reference
+  fingerprints (the top-k index vectors the healthy tenant returned at
+  registration) and the latched verdict. ``score()`` compares a probe
+  cycle's answers against the references — top-1 agreement, top-k set
+  agreement, and ``rank_drift`` (mean displacement of the reference
+  top-1 within the probed top-k; the logit-drift stand-in for an
+  index-only prediction contract) — writes a ``kind="canary"`` probe
+  record, and drives the verdict with hysteresis (``fail_after``
+  consecutive failing cycles to trip, ``pass_after`` passing cycles to
+  recover). ``check()`` is the mutation hook: a FAIL verdict writes the
+  refusal record and raises ``CanaryBlockedError``; the zoo's swap-in /
+  ``set_precision`` / ``convert_residency`` and the controller's retunes
+  all consult it, and allowed mutations stamp ``canary_verdict`` on
+  their fleet records.
+- **Prober** (``CanaryProber``): drives the probe cycle through the REAL
+  front door as tagged SHADOW requests (``router.submit(...,
+  shadow=True)``) — they ride real queues, real batches, real executables
+  and appear in traces, but are excluded from SLO/admission/billing
+  counters (a canary must never page the on-call about its own traffic).
+  The first cycle per tenant self-pins the references (``event="pin"``);
+  later cycles score. References survive eviction/re-swap-in — a
+  corrupted re-load is exactly what the pinned fingerprints catch.
+
+Scores land three ways: ``kind="canary"`` records on the fleet stream,
+gauges on an attached ``MetricsRegistry``, and points pushed into the
+collector's per-(host, metric) rings (``canary/<model>/...`` under the
+synthetic host ``"fleet"``) where the CUSUM scanner (``drift.py``)
+watches them like any other series.
+
+jax-free (numpy only): unit-testable against fixture index vectors.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+
+import numpy as np
+
+__all__ = [
+    "CanaryBlockedError",
+    "CanaryGate",
+    "CanaryProber",
+    "golden_inputs",
+    "score_probes",
+]
+
+
+class CanaryBlockedError(RuntimeError):
+    """A fleet mutation was refused because the tenant's canary verdict
+    is FAIL — mutating a tenant that is answering wrong hides the
+    evidence (the mutation becomes the alibi). Clear the fault or wait
+    for the canary to recover, then retry."""
+
+    def __init__(self, message: str, model: str | None = None,
+                 agreement_top1: float | None = None):
+        super().__init__(message)
+        self.model = model
+        self.agreement_top1 = agreement_top1
+
+
+def golden_inputs(
+    n: int, image_size: int, *, model: str = "", seed: int = 0,
+    channels: int = 3,
+) -> list[np.ndarray]:
+    """The tenant's deterministic probe set: ``n`` uint8 images in the
+    front door's submit shape, seeded on (seed, crc32(model)) — NOT
+    ``hash()``, which is salted per process and would mint a different
+    golden set on every restart."""
+    rng = np.random.default_rng([int(seed), zlib.crc32(model.encode())])
+    return [
+        rng.integers(0, 256, size=(image_size, image_size, channels))
+        .astype(np.uint8)
+        for _ in range(max(1, int(n)))
+    ]
+
+
+def score_probes(refs, results) -> dict:
+    """Agreement of one probe cycle's top-k index vectors against the
+    pinned references: ``agreement_top1`` (fraction of probes whose top-1
+    matches), ``agreement_topk`` (mean Jaccard-style overlap of the top-k
+    sets), and ``rank_drift`` (mean displacement of the reference top-1
+    within the probed top-k; a probe that lost the reference top-1
+    entirely counts the full k — the max-logit-drift stand-in when the
+    serve contract carries indices, not scores)."""
+    if len(refs) != len(results):
+        raise ValueError(
+            f"probe cycle returned {len(results)} results for "
+            f"{len(refs)} references"
+        )
+    top1 = topk = drift = 0.0
+    for ref, got in zip(refs, results):
+        ref = np.asarray(ref).reshape(-1)
+        got = np.asarray(got).reshape(-1)
+        k = max(len(ref), 1)
+        top1 += float(ref[0] == got[0]) if len(got) else 0.0
+        topk += len(set(ref.tolist()) & set(got.tolist())) / k
+        where = np.nonzero(got == ref[0])[0]
+        drift += float(where[0]) if len(where) else float(k)
+    n = max(len(refs), 1)
+    return {
+        "agreement_top1": round(top1 / n, 6),
+        "agreement_topk": round(topk / n, 6),
+        "rank_drift": round(drift / n, 6),
+        "probes": len(refs),
+    }
+
+
+class _TenantCanary:
+    __slots__ = ("refs", "verdict", "fail_streak", "pass_streak", "last")
+
+    def __init__(self):
+        self.refs: list[np.ndarray] | None = None
+        self.verdict = "none"  # none -> pass/fail; "none" never blocks
+        self.fail_streak = 0
+        self.pass_streak = 0
+        self.last: dict | None = None
+
+
+class CanaryGate:
+    """Pinned references + latched per-tenant verdicts + the mutation
+    hook. Thread-safe: probers score on their own thread while mutation
+    paths consult verdicts from operator/controller threads."""
+
+    def __init__(
+        self,
+        *,
+        min_top1: float = 0.95,
+        fail_after: int = 2,
+        pass_after: int = 2,
+        metrics=None,
+        registry=None,
+        collector=None,
+        logger=None,
+    ):
+        if not 0.0 < min_top1 <= 1.0:
+            raise ValueError(f"min_top1 must be in (0, 1], got {min_top1}")
+        self.min_top1 = float(min_top1)
+        self.fail_after = max(1, int(fail_after))
+        self.pass_after = max(1, int(pass_after))
+        self._metrics = metrics
+        self._registry = registry
+        self._collector = collector
+        self._logger = logger
+        self._lock = threading.Lock()
+        self._tenants: dict[str, _TenantCanary] = {}
+        self.stats = {"probes": 0, "pins": 0, "trips": 0, "recoveries": 0,
+                      "blocked": 0}
+
+    def _tenant(self, model: str) -> _TenantCanary:
+        st = self._tenants.get(model)
+        if st is None:
+            st = self._tenants[model] = _TenantCanary()
+        return st
+
+    def _write(self, record: dict) -> None:
+        if self._metrics is not None:
+            self._metrics.write(record)
+
+    # ------------------------------------------------------------------ pin
+
+    def pin(self, model: str, results) -> None:
+        """Pin ``results`` (the HEALTHY tenant's top-k answers to its
+        golden set) as the reference fingerprints — normally the prober's
+        first cycle, right after registration/warm-probe. Re-pinning is
+        an explicit ``clear()`` first: an intentional weight push changes
+        the reference; silence never does."""
+        refs = [np.asarray(r).reshape(-1).copy() for r in results]
+        with self._lock:
+            st = self._tenant(model)
+            if st.refs is not None:
+                raise ValueError(
+                    f"canary references for {model!r} already pinned "
+                    "(clear() first — re-pinning must be deliberate)"
+                )
+            st.refs = refs
+            self.stats["pins"] += 1
+        self._write({
+            "kind": "canary", "model": model, "event": "pin",
+            "probes": len(refs),
+        })
+
+    def pinned(self, model: str) -> bool:
+        with self._lock:
+            st = self._tenants.get(model)
+            return st is not None and st.refs is not None
+
+    def clear(self, model: str | None = None) -> None:
+        """Forget references + verdict for ``model`` (all tenants when
+        None) — the deliberate re-pin path after an intentional weight
+        rollout."""
+        with self._lock:
+            if model is None:
+                self._tenants.clear()
+            else:
+                self._tenants.pop(model, None)
+
+    def references(self, model: str) -> list[np.ndarray] | None:
+        with self._lock:
+            st = self._tenants.get(model)
+            return None if st is None or st.refs is None else list(st.refs)
+
+    # ---------------------------------------------------------------- score
+
+    def score(self, model: str, results) -> dict:
+        """Score one probe cycle against the pinned references, advance
+        the latched verdict, and emit the ``kind="canary"`` probe record
+        + gauges/ring points."""
+        with self._lock:
+            st = self._tenants.get(model)
+            if st is None or st.refs is None:
+                raise KeyError(f"no canary references pinned for {model!r}")
+            scores = score_probes(st.refs, results)
+            ok = scores["agreement_top1"] >= self.min_top1
+            if ok:
+                st.pass_streak += 1
+                st.fail_streak = 0
+            else:
+                st.fail_streak += 1
+                st.pass_streak = 0
+            tripped = recovered = False
+            if st.verdict != "fail" and st.fail_streak >= self.fail_after:
+                st.verdict = "fail"
+                tripped = True
+                self.stats["trips"] += 1
+            elif st.verdict == "fail" and st.pass_streak >= self.pass_after:
+                st.verdict = "pass"
+                recovered = True
+                self.stats["recoveries"] += 1
+            elif st.verdict == "none" and ok:
+                st.verdict = "pass"
+            st.last = dict(scores)
+            verdict = st.verdict
+            self.stats["probes"] += 1
+        if tripped and self._logger is not None:
+            self._logger.warning(
+                "canary: tenant %s TRIPPED (top-1 agreement %.3f < %.3f, "
+                "%d consecutive failing cycles)", model,
+                scores["agreement_top1"], self.min_top1, self.fail_after,
+            )
+        if recovered and self._logger is not None:
+            self._logger.info("canary: tenant %s recovered", model)
+        self._write({
+            "kind": "canary", "model": model, "event": "probe",
+            "verdict": verdict, **scores,
+        })
+        if self._registry is not None:
+            self._registry.gauge(
+                f"canary/agreement_top1/{model}"
+            ).set(scores["agreement_top1"])
+            self._registry.gauge(
+                f"canary/verdict_ok/{model}"
+            ).set(0.0 if verdict == "fail" else 1.0)
+        if self._collector is not None:
+            self._collector.ingest_point(
+                "fleet", f"canary/{model}/agreement_top1",
+                scores["agreement_top1"],
+            )
+            self._collector.ingest_point(
+                "fleet", f"canary/{model}/rank_drift", scores["rank_drift"],
+            )
+        return {**scores, "verdict": verdict}
+
+    # -------------------------------------------------------------- verdict
+
+    def verdict(self, model: str) -> str:
+        """"pass" / "fail" / "none" (never probed — a fresh fleet must
+        not be frozen by a canary that has not run yet)."""
+        with self._lock:
+            st = self._tenants.get(model)
+            return "none" if st is None else st.verdict
+
+    def last_scores(self, model: str) -> dict | None:
+        with self._lock:
+            st = self._tenants.get(model)
+            return None if st is None or st.last is None else dict(st.last)
+
+    def check(self, model: str | None, mutation: str) -> str:
+        """The mutation hook: raise ``CanaryBlockedError`` (and write the
+        ``event="blocked"`` refusal record) when ``model``'s verdict is
+        FAIL; otherwise return the verdict for the caller to stamp as
+        ``canary_verdict`` on its fleet record. ``model=None``
+        (untenanted path) always passes."""
+        if model is None:
+            return "none"
+        v = self.verdict(model)
+        if v != "fail":
+            return v
+        last = self.last_scores(model) or {}
+        with self._lock:
+            self.stats["blocked"] += 1
+        self._write({
+            "kind": "canary", "model": model, "event": "blocked",
+            "verdict": "fail", "mutation": mutation,
+            "reason": (
+                f"top-1 agreement {last.get('agreement_top1')} below "
+                f"{self.min_top1}"
+            ),
+            "agreement_top1": last.get("agreement_top1"),
+            "rank_drift": last.get("rank_drift"),
+        })
+        raise CanaryBlockedError(
+            f"canary verdict FAIL for tenant {model!r}: refusing "
+            f"{mutation} (last top-1 agreement "
+            f"{last.get('agreement_top1')}, threshold {self.min_top1})",
+            model=model, agreement_top1=last.get("agreement_top1"),
+        )
+
+
+class CanaryProber:
+    """Background probe driver: every cycle, each tenant's golden set
+    goes through the REAL front door as shadow requests; the first cycle
+    pins, later cycles score. Optionally drives the drift monitor's
+    CUSUM scan (the two quality detectors share a heartbeat)."""
+
+    def __init__(
+        self,
+        submit_fn,
+        models_fn,
+        gate: CanaryGate,
+        *,
+        image_size: int,
+        probes: int = 8,
+        seed: int = 0,
+        interval_s: float = 0.0,
+        timeout_s: float = 60.0,
+        drift=None,
+        collector=None,
+        logger=None,
+    ):
+        self._submit = submit_fn  # (image, model) -> Future[topk indices]
+        self._models_fn = models_fn
+        self._gate = gate
+        self._image_size = int(image_size)
+        self._probes = max(1, int(probes))
+        self._seed = int(seed)
+        self._interval_s = float(interval_s)
+        self._timeout_s = float(timeout_s)
+        self._drift = drift
+        self._collector = collector
+        self._logger = logger
+        self._inputs: dict[str, list[np.ndarray]] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.stats = {"cycles": 0, "probe_errors": 0, "skipped_tenants": 0}
+
+    def _golden(self, model: str) -> list[np.ndarray]:
+        imgs = self._inputs.get(model)
+        if imgs is None:
+            imgs = self._inputs[model] = golden_inputs(
+                self._probes, self._image_size, model=model, seed=self._seed,
+            )
+        return imgs
+
+    def probe_once(self) -> dict[str, dict]:
+        """One full probe cycle over every tenant. A tenant whose probes
+        cannot complete (front door shedding, host down) is SKIPPED, not
+        scored — an unreachable tenant is an availability problem with
+        its own alerts; scoring it would fail the QUALITY canary on
+        missing evidence."""
+        out: dict[str, dict] = {}
+        for model in list(self._models_fn() or ()):
+            imgs = self._golden(model)
+            try:
+                futures = [self._submit(img, model) for img in imgs]
+                results = [f.result(self._timeout_s) for f in futures]
+            except Exception as e:  # noqa: BLE001 — skip, never crash the loop
+                self.stats["probe_errors"] += 1
+                self.stats["skipped_tenants"] += 1
+                if self._logger is not None:
+                    self._logger.warning(
+                        "canary: probe cycle for %s skipped (%s)", model, e,
+                    )
+                continue
+            if not self._gate.pinned(model):
+                self._gate.pin(model, results)
+                out[model] = {"event": "pin", "probes": len(results)}
+            else:
+                out[model] = self._gate.score(model, results)
+        self.stats["cycles"] += 1
+        if self._drift is not None and self._collector is not None:
+            try:
+                self._drift.scan(self._collector)
+            except Exception:  # noqa: BLE001 — scanning must not kill probing
+                pass
+        return out
+
+    # ----------------------------------------------------------- background
+
+    def start(self) -> None:
+        if self._interval_s <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="canary-prober", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            try:
+                self.probe_once()
+            except Exception as e:  # noqa: BLE001
+                if self._logger is not None:
+                    self._logger.warning("canary prober cycle failed: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(self._timeout_s, 10.0))
+            self._thread = None
+
+    def close(self) -> None:
+        self.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -183,9 +183,14 @@ class FleetCollector:
 
     def note_event(self, record: dict) -> None:
         """Pin every open trace: a failover / injected fault / rollback
-        implicates exactly the requests in flight when it landed, and a
-        pinned trace survives tail sampling unconditionally."""
-        if record.get("kind") not in _PIN_KINDS:
+        — or a baseline-relative drift breach (ISSUE 19: ``kind="alert"``
+        with ``source="drift"``; plain threshold SLO alerts keep their
+        v9 behavior) — implicates exactly the requests in flight when it
+        landed, and a pinned trace survives tail sampling
+        unconditionally."""
+        kind = record.get("kind")
+        drift_alert = kind == "alert" and record.get("source") == "drift"
+        if kind not in _PIN_KINDS and not drift_alert:
             return
         with self._lock:
             pinned = [t for t, ot in self._traces.items() if not ot.pinned]
@@ -351,6 +356,23 @@ class FleetCollector:
         horizon = ts - self._retention_s
         while ring and ring[0][0] < horizon:
             ring.popleft()
+
+    def ingest_point(self, host: str, metric: str, value: float) -> None:
+        """Push one externally-measured sample into the per-(host,
+        metric) rings (ISSUE 19: the canary gate lands its per-tenant
+        agreement scores here under the synthetic host ``"fleet"``, so
+        quality series ride the same timeline records — and the same
+        CUSUM scan — as every scraped metric). Lock-guarded: callers run
+        on prober/gate threads, not the collector thread."""
+        with self._lock:
+            self._push_point(host, metric, time.time(), float(value))
+
+    def series_snapshot(self) -> dict[tuple[str, str], list]:
+        """Point-in-time copy of every (host, metric) ring — the drift
+        monitor's CUSUM scan surface (``obs/drift.py``); each scan keeps
+        its own timestamp cursor so retained history is never re-fed."""
+        with self._lock:
+            return {k: list(v) for k, v in self._series.items() if v}
 
     # --------------------------------------------------------------- clocks
 

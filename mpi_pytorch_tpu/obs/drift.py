@@ -1,0 +1,454 @@
+"""Prediction-drift detection: streaming sketches of live traffic vs a
+rolling baseline, plus change-point detectors over metric time series
+(ISSUE 19 tentpole 2).
+
+The SLO monitor (``monitor.py``) expresses THRESHOLD rules — "p99 over
+120 ms for 3 windows". Quality regressions rarely trip a threshold you
+wrote in advance: a bad weight push shifts *which classes* the model
+predicts, a lossy precision switch nudges a metric's *level* without
+crossing any line. Both are baseline-relative questions, and this module
+answers them with the two classic machineries:
+
+- **Distributional drift** (``PredictionSketch`` + ``DriftMonitor``):
+  each tenant's live top-1 predictions accumulate into a bounded
+  windowed class histogram; full windows compare against a rolling
+  baseline of recent clean windows via PSI (population stability index)
+  and a smoothed Pearson chi-squared. A breach writes a ``kind="alert"``
+  record with ``source="drift"`` (the collector pins in-flight traces on
+  it, the flight recorder auto-dumps), latches until a clean window
+  recovers, and — critically — the breaching window is DISCARDED, never
+  folded into the baseline, so the baseline cannot chase the drift it
+  just flagged.
+- **Change-point detection** (``Cusum`` / ``PageHinkley`` +
+  ``DriftMonitor.scan``): standardized two-sided CUSUM over the
+  collector's per-(host, metric) rings. The detector learns its
+  reference level from a warmup prefix, accumulates standardized
+  excursions, fires ONCE at a sustained step change, then re-arms by
+  re-learning the post-change level — a persistent shift is one alarm,
+  not an alarm per sample, and stationary noise stays silent.
+
+The serve path's prediction contract is top-k *indices* only (the fused
+head streams argmax without materializing logits — ``evaluate.py``), so
+the sketch is over the class-id stream; distribution entropy stands in
+for the confidence stats a logit-returning head would add.
+
+Deliberately dependency-free (no jax, no numpy): unit-testable on any
+host, importable by the tools without a backend.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+from typing import Iterable, Mapping
+
+__all__ = [
+    "Cusum",
+    "DriftMonitor",
+    "PageHinkley",
+    "PredictionSketch",
+    "chi_squared",
+    "entropy_bits",
+    "psi",
+]
+
+
+def _dist(counts: Mapping, keys: Iterable, eps: float) -> dict:
+    total = float(sum(counts.get(k, 0) for k in keys)) or 1.0
+    return {k: max(counts.get(k, 0) / total, eps) for k in keys}
+
+
+def psi(baseline: Mapping, window: Mapping, *, eps: float = 1e-4) -> float:
+    """Population stability index between two count histograms (any
+    hashable keys). 0 = identical; common operating bands: < 0.1 stable,
+    0.1–0.25 moderate shift, > 0.25 actionable drift. ``eps`` floors both
+    distributions so a class seen on only one side contributes a large
+    finite term, never an infinity."""
+    keys = set(baseline) | set(window)
+    if not keys:
+        return 0.0
+    b = _dist(baseline, keys, eps)
+    w = _dist(window, keys, eps)
+    return sum((w[k] - b[k]) * math.log(w[k] / b[k]) for k in keys)
+
+
+def chi_squared(
+    baseline: Mapping, window: Mapping, *, smooth: float = 0.5,
+) -> tuple[float, int]:
+    """Pearson chi-squared statistic (and degrees of freedom) of the
+    window counts against the baseline-derived expectation, with additive
+    smoothing so a baseline-unseen class costs a large finite term. The
+    caller thresholds ``stat / dof`` (the reduced statistic), which is
+    roughly scale-free in window size."""
+    keys = sorted(set(baseline) | set(window))
+    if not keys:
+        return 0.0, 1
+    nb = float(sum(baseline.get(k, 0) + smooth for k in keys))
+    nw = float(sum(window.get(k, 0) for k in keys)) or 1.0
+    stat = 0.0
+    for k in keys:
+        expected = nw * (baseline.get(k, 0) + smooth) / nb
+        observed = float(window.get(k, 0))
+        stat += (observed - expected) ** 2 / expected
+    return stat, max(len(keys) - 1, 1)
+
+
+def entropy_bits(counts: Mapping) -> float:
+    """Shannon entropy (bits) of a count histogram — the confidence-shape
+    stand-in for an index-only prediction contract: a model collapsing
+    onto few classes (or spraying uniformly) moves this even when no
+    single class crosses a share threshold."""
+    total = float(sum(counts.values())) or 1.0
+    return -sum(
+        (c / total) * math.log2(c / total) for c in counts.values() if c
+    )
+
+
+class Cusum:
+    """Two-sided standardized CUSUM with fire-once-then-re-arm semantics.
+
+    The reference level (mean/std) is learned from the first ``warmup``
+    samples; each later sample's standardized excursion ``z`` drives the
+    classic pair ``g+ = max(0, g+ + z - k)`` / ``g- = max(0, g- - z - k)``.
+    Crossing ``h`` fires the alarm and RESETS the detector to re-learn
+    its reference from post-change data — a sustained step is exactly one
+    alarm, and a second step (in either direction) fires again after the
+    new warmup. ``k`` (the slack, in std units) is what keeps stationary
+    noise silent: drift must persistently exceed ``k`` sigma to
+    accumulate."""
+
+    def __init__(
+        self, *, k: float = 0.5, h: float = 8.0, warmup: int = 16,
+        min_std: float = 1e-9,
+    ):
+        if warmup < 2:
+            raise ValueError(f"warmup must be >= 2, got {warmup}")
+        self.k = float(k)
+        self.h = float(h)
+        self.warmup = int(warmup)
+        self.min_std = float(min_std)
+        self.fires = 0
+        self._rearm()
+
+    def _rearm(self) -> None:
+        self._n = 0
+        self._mean = 0.0
+        self._m2 = 0.0
+        self._gp = 0.0
+        self._gn = 0.0
+
+    @property
+    def armed(self) -> bool:
+        """True once the warmup reference is learned (alarms possible)."""
+        return self._n >= self.warmup
+
+    def update(self, x: float) -> bool:
+        """Feed one sample; True exactly when an alarm fires."""
+        x = float(x)
+        if self._n < self.warmup:
+            # Welford accumulation of the reference level.
+            self._n += 1
+            d = x - self._mean
+            self._mean += d / self._n
+            self._m2 += d * (x - self._mean)
+            return False
+        std = max(math.sqrt(self._m2 / self._n), self.min_std)
+        z = (x - self._mean) / std
+        self._gp = max(0.0, self._gp + z - self.k)
+        self._gn = max(0.0, self._gn - z - self.k)
+        if self._gp > self.h or self._gn > self.h:
+            self.fires += 1
+            self._rearm()
+            return True
+        return False
+
+
+class PageHinkley:
+    """Page-Hinkley test (two-sided), the CUSUM sibling for slow ramps:
+    accumulates deviation from the running mean minus a tolerance
+    ``delta``; fires when the accumulation departs ``lam`` from its
+    historical extremum, then re-arms like ``Cusum``."""
+
+    def __init__(
+        self, *, delta: float = 0.005, lam: float = 50.0, warmup: int = 8,
+    ):
+        self.delta = float(delta)
+        self.lam = float(lam)
+        self.warmup = int(warmup)
+        self.fires = 0
+        self._rearm()
+
+    def _rearm(self) -> None:
+        self._n = 0
+        self._mean = 0.0
+        self._m_up = 0.0
+        self._m_up_min = 0.0
+        self._m_dn = 0.0
+        self._m_dn_max = 0.0
+
+    def update(self, x: float) -> bool:
+        x = float(x)
+        self._n += 1
+        self._mean += (x - self._mean) / self._n
+        self._m_up += x - self._mean - self.delta
+        self._m_dn += x - self._mean + self.delta
+        self._m_up_min = min(self._m_up_min, self._m_up)
+        self._m_dn_max = max(self._m_dn_max, self._m_dn)
+        if self._n <= self.warmup:
+            return False
+        if (
+            self._m_up - self._m_up_min > self.lam
+            or self._m_dn_max - self._m_dn > self.lam
+        ):
+            self.fires += 1
+            self._rearm()
+            return True
+        return False
+
+
+class PredictionSketch:
+    """Bounded per-tenant sketch of the live top-1 class stream: a
+    current window histogram plus a rolling baseline of the most recent
+    ``baseline_windows`` CLEAN windows (the monitor folds a window into
+    the baseline only when it compared clean — a breaching window is
+    evidence, not baseline)."""
+
+    def __init__(self, *, window: int = 256, baseline_windows: int = 4):
+        if window < 8:
+            raise ValueError(f"window must be >= 8, got {window}")
+        self.window = int(window)
+        self._counts: dict = {}
+        self._n = 0
+        self._baseline: deque = deque(maxlen=max(1, int(baseline_windows)))
+
+    def observe(self, top1) -> None:
+        self._counts[top1] = self._counts.get(top1, 0) + 1
+        self._n += 1
+
+    @property
+    def window_n(self) -> int:
+        return self._n
+
+    def full(self) -> bool:
+        return self._n >= self.window
+
+    def baseline_counts(self) -> dict:
+        merged: dict = {}
+        for counts in self._baseline:
+            for k, v in counts.items():
+                merged[k] = merged.get(k, 0) + v
+        return merged
+
+    def compare(self) -> dict | None:
+        """PSI / reduced-chi2 / entropies of the current window against
+        the rolling baseline; None while no baseline exists yet (the
+        first window IS the baseline)."""
+        base = self.baseline_counts()
+        if not base or not self._counts:
+            return None
+        stat, dof = chi_squared(base, self._counts)
+        return {
+            "psi": round(psi(base, self._counts), 6),
+            "chi2": round(stat, 3),
+            "chi2_per_dof": round(stat / dof, 4),
+            "window_n": self._n,
+            "baseline_n": sum(base.values()),
+            "entropy_window": round(entropy_bits(self._counts), 4),
+            "entropy_baseline": round(entropy_bits(base), 4),
+        }
+
+    def roll(self) -> None:
+        """Fold the (clean) current window into the baseline ring."""
+        if self._counts:
+            self._baseline.append(self._counts)
+        self._counts, self._n = {}, 0
+
+    def discard(self) -> None:
+        """Drop the current window WITHOUT folding it into the baseline
+        (the breach path — the baseline must not chase the drift)."""
+        self._counts, self._n = {}, 0
+
+
+class DriftMonitor:
+    """Per-tenant drift detection over the live prediction stream plus
+    CUSUM change-point scanning over collector metric rings.
+
+    ``observe(model, top1)`` is the hot-path hook (the serve completion
+    loop calls it per REAL request — shadow canary probes are excluded,
+    they are synthetic traffic); it self-evaluates whenever a window
+    fills, so no periodic driver is needed for the distributional half.
+    ``scan(collector)`` walks the collector's per-(host, metric) series
+    with one ``Cusum`` per key (cursor-tracked, each point fed once).
+
+    Breaches write schema-v15 ``kind="alert"`` records with
+    ``source="drift"`` through ``metrics`` (the fleet's tapped writer —
+    so the collector pins in-flight traces and the flight recorder
+    auto-dumps evidence), latch per tenant until a clean window, and
+    count into ``stats``."""
+
+    def __init__(
+        self,
+        *,
+        window: int = 256,
+        baseline_windows: int = 4,
+        psi_threshold: float = 0.25,
+        chi2_threshold: float = 10.0,
+        cusum_k: float = 0.5,
+        cusum_h: float = 8.0,
+        cusum_warmup: int = 16,
+        metrics=None,
+        logger=None,
+    ):
+        self._window = int(window)
+        self._baseline_windows = int(baseline_windows)
+        self.psi_threshold = float(psi_threshold)
+        self.chi2_threshold = float(chi2_threshold)
+        self._cusum_k = float(cusum_k)
+        self._cusum_h = float(cusum_h)
+        self._cusum_warmup = int(cusum_warmup)
+        self._metrics = metrics
+        self._logger = logger
+        self._lock = threading.Lock()
+        self._sketch: dict[str, PredictionSketch] = {}
+        self._breached: dict[str, bool] = {}
+        self._last: dict[str, dict] = {}
+        self._cusum: dict[tuple, Cusum] = {}
+        self._cursor: dict[tuple, float] = {}
+        self.stats = {
+            "windows": 0, "alerts": 0, "recoveries": 0, "cusum_alerts": 0,
+        }
+
+    # ------------------------------------------------------------- live feed
+
+    def observe(self, model: str, top1: int) -> None:
+        """One real served prediction for ``model``; evaluates the window
+        in-line when it fills (bounded work: one histogram compare per
+        ``window`` requests)."""
+        alert = None
+        with self._lock:
+            sk = self._sketch.get(model)
+            if sk is None:
+                sk = self._sketch[model] = PredictionSketch(
+                    window=self._window,
+                    baseline_windows=self._baseline_windows,
+                )
+            sk.observe(top1)
+            if sk.full():
+                alert = self._evaluate_locked(model, sk)
+        if alert is not None and self._metrics is not None:
+            self._metrics.write(alert)
+
+    def _evaluate_locked(self, model: str, sk: PredictionSketch):
+        cmp = sk.compare()
+        self.stats["windows"] += 1
+        if cmp is None:
+            sk.roll()  # the first window seeds the baseline
+            return None
+        self._last[model] = cmp
+        breach = (
+            cmp["psi"] > self.psi_threshold
+            or cmp["chi2_per_dof"] > self.chi2_threshold
+        )
+        if breach:
+            sk.discard()
+            if self._breached.get(model):
+                return None  # latched — one alert per excursion
+            self._breached[model] = True
+            self.stats["alerts"] += 1
+            if self._logger is not None:
+                self._logger.warning(
+                    "drift: tenant %s top-1 distribution departed baseline "
+                    "(psi %.3f, chi2/dof %.2f)", model, cmp["psi"],
+                    cmp["chi2_per_dof"],
+                )
+            return {
+                "kind": "alert",
+                "rule": f"drift:top1:{model}",
+                "severity": "page",
+                "metric": "serve/top1_psi",
+                "value": cmp["psi"],
+                "threshold": self.psi_threshold,
+                "action": "drift_breach",
+                "model": model,
+                "source": "drift",
+                "psi": cmp["psi"],
+                "chi2": cmp["chi2_per_dof"],
+                "window_n": cmp["window_n"],
+                "baseline_n": cmp["baseline_n"],
+                "detail": (
+                    f"entropy {cmp['entropy_baseline']} -> "
+                    f"{cmp['entropy_window']} bits"
+                ),
+            }
+        sk.roll()
+        if not self._breached.get(model):
+            return None
+        self._breached[model] = False
+        self.stats["recoveries"] += 1
+        return {
+            "kind": "alert",
+            "rule": f"drift:top1:{model}",
+            "severity": "info",
+            "metric": "serve/top1_psi",
+            "value": cmp["psi"],
+            "threshold": self.psi_threshold,
+            "action": "recovered",
+            "model": model,
+            "source": "drift",
+            "psi": cmp["psi"],
+            "chi2": cmp["chi2_per_dof"],
+            "window_n": cmp["window_n"],
+            "baseline_n": cmp["baseline_n"],
+        }
+
+    def breached(self, model: str) -> bool:
+        with self._lock:
+            return bool(self._breached.get(model))
+
+    def last_comparison(self, model: str) -> dict | None:
+        with self._lock:
+            return dict(self._last[model]) if model in self._last else None
+
+    # ------------------------------------------------------- ring scanning
+
+    def scan(self, collector) -> int:
+        """CUSUM pass over the collector's per-(host, metric) rings: one
+        detector per series, a timestamp cursor so each point is fed
+        exactly once (the rings retain history; re-feeding would
+        double-count). Returns how many change-point alerts fired."""
+        series = collector.series_snapshot()
+        fired = 0
+        records = []
+        with self._lock:
+            for key, points in sorted(series.items()):
+                det = self._cusum.get(key)
+                if det is None:
+                    det = self._cusum[key] = Cusum(
+                        k=self._cusum_k, h=self._cusum_h,
+                        warmup=self._cusum_warmup,
+                    )
+                cursor = self._cursor.get(key, -math.inf)
+                for ts, v in points:
+                    if ts <= cursor:
+                        continue
+                    cursor = ts
+                    if det.update(v):
+                        fired += 1
+                        self.stats["cusum_alerts"] += 1
+                        host, metric = key
+                        records.append({
+                            "kind": "alert",
+                            "rule": f"cusum:{metric}",
+                            "severity": "warn",
+                            "metric": metric,
+                            "value": round(float(v), 6),
+                            "action": "change_point",
+                            "host": host,
+                            "source": "drift",
+                        })
+                self._cursor[key] = cursor
+        if self._metrics is not None:
+            for rec in records:
+                self._metrics.write(rec)
+        return fired

@@ -179,11 +179,19 @@ class MetricsRegistry:
     should resolve their metric ONCE and hold the object (the serve
     request path pre-binds its counters in ``server.__init__``)."""
 
-    def __init__(self):
+    def __init__(self, labels: Mapping[str, str] | None = None):
         self._lock = threading.Lock()
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
         self._histograms: dict[str, Histogram] = {}
+        # Constant Prometheus labels stamped on every series this registry
+        # exposes (ISSUE 19): a tenant-owned registry carries
+        # ``{"model": <tenant>}`` so a fleet ``/metrics`` scrape
+        # distinguishes tenants instead of collapsing them into one
+        # unlabeled series. Exposition-only — snapshot()/merged() names
+        # are unchanged, so the monitor/controller read path and the
+        # cross-host merge layout are label-blind.
+        self._labels = dict(labels) if labels else {}
 
     def _get(self, table: dict, name: str, cls):
         with self._lock:
@@ -307,7 +315,12 @@ class MetricsRegistry:
 
         Counters gain the conventional ``_total`` suffix; histograms emit
         the standard cumulative ``_bucket{le=...}`` series (only buckets
-        with observations, plus ``+Inf``), ``_sum`` and ``_count``."""
+        with observations, plus ``+Inf``), ``_sum`` and ``_count``.
+        Registry-level constant labels (a tenant registry's ``model``)
+        appear on every sample line, merged with ``le`` on histogram
+        buckets — the v15 fix: a multi-tenant scrape used to collapse
+        every tenant into one indistinguishable unlabeled series."""
+        base = self._label_text()
         lines: list[str] = []
         snap_lock = self._lock
         with snap_lock:
@@ -316,12 +329,12 @@ class MetricsRegistry:
             histograms = sorted(self._histograms.items())
         for name, c in counters:
             p = prom_name(name) + "_total"
-            lines += [f"# TYPE {p} counter", f"{p} {_fnum(c.value)}"]
+            lines += [f"# TYPE {p} counter", f"{p}{_braced(base)} {_fnum(c.value)}"]
         for name, g in gauges:
             if g.value is None:
                 continue
             p = prom_name(name)
-            lines += [f"# TYPE {p} gauge", f"{p} {_fnum(g.value)}"]
+            lines += [f"# TYPE {p} gauge", f"{p}{_braced(base)} {_fnum(g.value)}"]
         for name, h in histograms:
             p = prom_name(name)
             lines.append(f"# TYPE {p} histogram")
@@ -332,11 +345,33 @@ class MetricsRegistry:
                 if c:
                     cum += c
                     le = _fnum(_bucket_upper(i))
-                    lines.append(f'{p}_bucket{{le="{le}"}} {cum}')
-            lines.append(f'{p}_bucket{{le="+Inf"}} {n}')
-            lines.append(f"{p}_sum {_fnum(total)}")
-            lines.append(f"{p}_count {n}")
+                    pairs = f'{base},le="{le}"' if base else f'le="{le}"'
+                    lines.append(f"{p}_bucket{{{pairs}}} {cum}")
+            pairs = f'{base},le="+Inf"' if base else 'le="+Inf"'
+            lines.append(f"{p}_bucket{{{pairs}}} {n}")
+            lines.append(f"{p}_sum{_braced(base)} {_fnum(total)}")
+            lines.append(f"{p}_count{_braced(base)} {n}")
         return "\n".join(lines) + "\n"
+
+    def _label_text(self) -> str:
+        """The registry's constant labels as ``k="v"`` pairs (escaped per
+        the exposition format), or '' when unlabeled."""
+        return ",".join(
+            f'{k}="{_label_escape(v)}"' for k, v in sorted(self._labels.items())
+        )
+
+
+def _braced(pairs: str) -> str:
+    """'' → '' ; 'model="x"' → '{model="x"}' — the label block of a
+    sample line with no per-sample labels of its own."""
+    return f"{{{pairs}}}" if pairs else ""
+
+
+def _label_escape(v: str) -> str:
+    """Label-value escaping per the Prometheus text exposition format."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
 
 
 def _fnum(v: float) -> str:

@@ -31,6 +31,7 @@ Record kinds (every record also carries ``ts``, the epoch-seconds stamp
 | fleet     | event                                               | host, detail, redispatched, spare, max_wait_ms_from/to, buckets_from/to, p99_ms, target_p99_ms, compiles_after_warmup, hosts_from/to, reason, reject_rate, queue_depth, restarts, transport, model, resident, plan |
 | timeline  | host, metric, points                                | window_s, clock_offset_ms, resets |
 | hedge     | winner, loser                                       | cancelled, deadline_ms, trace_id |
+| canary    | model, event                                        | agreement_top1, agreement_topk, rank_drift, probes, verdict, mutation, reason, detail |
 
 ``serve`` is the per-flush record the online inference server writes
 (serve/server.py: one coalesced batch dispatched to a bucket executable);
@@ -186,7 +187,29 @@ from typing import Any, Mapping
 #      the workload fingerprint, the ranked candidate plan, and the
 #      model's stamped calibration error. All absent on non-replay
 #      serving — streams stay byte-identical to v13.
-SCHEMA_VERSION = 14
+#  15: the quality-observability generation (ISSUE 19): the ``canary``
+#      kind — one golden-set canary event per tenant (``obs/canary.py``:
+#      ``event`` is "pin" — references pinned from the healthy tenant's
+#      answers, "probe" — one shadow probe cycle scored against them
+#      (top-1/top-k agreement, ``rank_drift`` — the max-logit-drift
+#      stand-in for an index-only prediction contract), or "blocked" —
+#      a fleet mutation refused on a FAIL verdict, naming the mutation);
+#      ``alert`` records may carry ``source`` ("drift" = a
+#      baseline-relative breach from ``obs/drift.py``, with its
+#      ``psi``/``chi2`` evidence, window/baseline sizes, and — for
+#      CUSUM change-points over collector rings — the ``host``);
+#      ``fleet`` swap_in/retune records may carry ``canary_verdict``
+#      (the gate's verdict stamped on every ALLOWED mutation);
+#      ``serve`` flushes may carry ``shadow_requests`` (how many of the
+#      flush's requests were tagged canary probes — excluded from the
+#      served/requests counters, so billing stays honest); and
+#      ``serve_bench`` rows may carry ``agreement_top1`` (the canary
+#      agreement measured during the sweep point — trends like img/s in
+#      check_regression, a >2-point absolute drop fails) and
+#      ``residency`` (keyed into the trend-line identity alongside
+#      precision). All absent when the canary/drift knobs are off —
+#      streams stay byte-identical to v14.
+SCHEMA_VERSION = 15
 
 _NUM = (int, float)
 _INT = (int,)
@@ -241,6 +264,9 @@ REQUIRED: dict[str, dict[str, tuple]] = {
     # v14: one offline what-if planner run (tools/whatif.py): which
     # workload it planned against and the ranked candidate list.
     "whatif": {"workload": (str,), "ranked": (list,)},
+    # v15: one golden-set canary event per tenant (obs/canary.py):
+    # references pinned, a probe cycle scored, or a mutation blocked.
+    "canary": {"model": (str,), "event": (str,)},
 }
 
 OPTIONAL: dict[str, dict[str, tuple]] = {
@@ -290,6 +316,11 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # v13: chips one copy of the params spans (model-parallel
         # tenants only — absent on replicated serving).
         "shard_degree": _INT,
+        # v15: how many of the flush's requests were tagged canary
+        # shadow probes (obs/canary.py) — they ride the batch but are
+        # excluded from the served/requests counters; absent on flushes
+        # that carried none, so canary-off streams stay byte-identical.
+        "shadow_requests": _INT,
     },
     "serve_bench": {
         "model": (str,), "offered_rps": _NUM, "rejected": _INT,
@@ -333,6 +364,12 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # the recorded-vs-replayed differential report. Absent on
         # synthetic-load rows — streams stay byte-identical to v13.
         "workload": (str,), "speed": _NUM, "replay_diff": (dict,),
+        # v15: the quality axes — the canary top-1 agreement measured
+        # during this sweep point (trends like img/s: a >2-point
+        # absolute drop fails check_regression), and the tenant's weight
+        # residency, keyed into the trend-line identity so a sharded/
+        # int8 row never compares against a replicated/bf16 baseline.
+        "agreement_top1": _NUM, "residency": (str,),
     },
     "resume": {
         "from_devices": _INT, "from_mesh": (str,), "to_mesh": (str,),
@@ -397,6 +434,12 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # the bytes the bounded cross-topology reshard moved getting
         # there, and the chip span (absent on replicated events).
         "residency": (str,), "reshard_bytes": _INT, "shard_degree": _INT,
+        # v15: the canary gate's verdict stamped on every ALLOWED
+        # mutation (swap_in / retune / conversion) when a gate is
+        # present — "pass", or "none" for a tenant never probed. Absent
+        # on canary-off fleets (streams stay byte-identical to v14);
+        # refused mutations write kind="canary" event="blocked" instead.
+        "canary_verdict": (str,),
     },
     # v6: which step the rollback triggered at, what it restored (the
     # checkpoint's filed epoch + path), how many rollbacks this run has
@@ -416,6 +459,13 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # v10: the SLO monitor's tenant label (a zoo tenant's rules fire
         # with its model stamped) — absent on untenanted monitors.
         "model": (str,),
+        # v15: baseline-relative drift alerts (obs/drift.py) carry
+        # source="drift" (the collector pins in-flight traces on them),
+        # the PSI / reduced-chi2 evidence with window/baseline sizes,
+        # and — for CUSUM change-points over collector rings — which
+        # host's series moved. Absent on threshold-DSL SLO alerts.
+        "source": (str,), "psi": _NUM, "chi2": _NUM,
+        "window_n": _INT, "baseline_n": _INT, "host": (str,),
     },
     # v7: top5_agree is null for fused (argmax-only) contracts.
     "quant_parity": {
@@ -442,6 +492,16 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         "winner": (dict,), "model": (dict,), "candidates": _INT,
         "validated_p99_ms": _NUM, "within_calibration": _INT,
         "calibration_error_pct": _NUM,
+    },
+    # v15: probe-cycle scores (event="probe"), the pinned set size
+    # (event="pin"), the latched verdict, and — on event="blocked" —
+    # which mutation the FAIL verdict refused and why. rank_drift is the
+    # mean displacement of the reference top-1 within the probed top-k
+    # (the logit-drift stand-in for an index-only serve contract).
+    "canary": {
+        "agreement_top1": _NUM, "agreement_topk": _NUM, "rank_drift": _NUM,
+        "probes": _INT, "verdict": (str,), "mutation": (str,),
+        "reason": (str,), "detail": (str,),
     },
 }
 

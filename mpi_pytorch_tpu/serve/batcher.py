@@ -136,6 +136,13 @@ class PendingRequest:
     # header (ISSUE 13). None = untraced — the default, and the request
     # then costs nothing on any tracing seam.
     trace: Any = None
+    # Canary shadow probe (ISSUE 19): the request rides real queues,
+    # batches, and executables — but is excluded from the SLO/admission/
+    # billing counters (requests/served/rejected/failed and the latency
+    # histogram). Synthetic traffic must never page the on-call or bill
+    # a tenant; it still appears in traces and flush records
+    # (``shadow_requests``) so its path stays observable.
+    shadow: bool = False
 
 
 class DynamicBatcher:

@@ -66,6 +66,7 @@ class FleetController:
         fill_low_pct: float = 50.0,
         latency_metric: str = "serve/request_latency_ms",
         logger=None,
+        canary=None,
     ):
         if target_p99_ms <= 0:
             raise ValueError(
@@ -84,8 +85,16 @@ class FleetController:
         self._fill_low_pct = float(fill_low_pct)
         self._latency_metric = latency_metric
         self._logger = logger or run_logger()
+        # Quality gate (ISSUE 19): an ``obs.CanaryGate`` every retune
+        # consults BEFORE touching any knob — a tenant whose canary
+        # verdict is FAIL must not be retuned (the retune would hide the
+        # quality evidence behind a knob change). Checked here, not in
+        # the zoo, because per-tenant controller retunes act through
+        # ``TenantHandle`` directly on the tenant server.
+        self._canary = canary
         self._seen_counts: dict[str, int] = {}
         self.retunes = 0
+        self.canary_blocked = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -196,6 +205,26 @@ class FleetController:
         ):
             return False
 
+        canary_verdict = None
+        if self._canary is not None:
+            from mpi_pytorch_tpu.obs.canary import CanaryBlockedError
+
+            try:
+                canary_verdict = self._canary.check(
+                    getattr(host, "model", None),
+                    mutation=f"retune:{host.name}",
+                )
+            except CanaryBlockedError as e:
+                # The gate already wrote the event="blocked" refusal
+                # record; the unit keeps its current knobs until the
+                # canary recovers.
+                self.canary_blocked += 1
+                self._logger.warning(
+                    "fleet controller: retune of %s refused by canary "
+                    "gate (%s)", host.name, e,
+                )
+                return False
+
         if wait_to != wait_from:
             host.set_max_wait_ms(wait_to)
         if active_to != active_from:
@@ -241,6 +270,10 @@ class FleetController:
                 # model-labelled knob axis (absent on untenanted hosts,
                 # records byte-identical to v9).
                 record["model"] = model
+            if canary_verdict is not None:
+                # Schema-v15: the quality verdict this retune passed
+                # under (absent without a gate — v14 streams unchanged).
+                record["canary_verdict"] = canary_verdict
             res = getattr(host, "residency", None)
             if res and res != "replicated":
                 # Schema-v13: a sharded tenant is one logical host over K

@@ -142,6 +142,12 @@ class _Flight:
     # model stamped on its spans. None = untenanted (single-model) fleet.
     model: str | None = None
     redispatches: int = 0
+    # Canary shadow probe (ISSUE 19): holds NO admission token (global or
+    # tenant), never counts in window_requests/window_models or the
+    # rejection counters — synthetic traffic must not charge a tenant's
+    # budget or skew the routing/SLO record. It still occupies
+    # ``outstanding`` (it IS load on the host it rides).
+    shadow: bool = False
     # Cross-process trace context minted at admission (None = untraced):
     # the trace id every dispatch attempt, wire hop, and host-side span
     # of this request carries (ISSUE 13).
@@ -182,7 +188,7 @@ class LocalHost:
         self.index = server.host_index
 
     # -- request path -------------------------------------------------
-    def submit(self, image, trace=None, model=None) -> Future:
+    def submit(self, image, trace=None, model=None, shadow=False) -> Future:
         if model is not None:
             # Only the zoo twin (serve/zoo/ZooHost) serves tenants; the
             # router never routes a tenant here (models() is None), so
@@ -190,8 +196,8 @@ class LocalHost:
             raise ServeError(
                 f"host {self.name} is not multi-tenant (model={model!r})"
             )
-        if trace is not None:
-            return self.server.submit(image, trace=trace)
+        if trace is not None or shadow:
+            return self.server.submit(image, trace=trace, shadow=shadow)
         return self.server.submit(image)
 
     def models(self):
@@ -405,7 +411,8 @@ class FleetRouter:
 
     # ------------------------------------------------------------ admission
 
-    def submit(self, image, model: str | None = None) -> Future:
+    def submit(self, image, model: str | None = None,
+               shadow: bool = False) -> Future:
         """Admit one request fleet-wide, or reject at the front door.
 
         ``model`` names the tenant on a multi-model fleet (ISSUE 14):
@@ -413,6 +420,11 @@ class FleetRouter:
         exhausts its own tokens and is rejected — the typed error names
         it — while other tenants keep admitting), then the global one;
         dispatch is then per-(host, model).
+
+        ``shadow=True`` (ISSUE 19) marks a canary probe: it rides the
+        real dispatch path but holds no admission token and never counts
+        in rejection/billing/routing-window counters — the probe must
+        measure the fleet, not perturb its accounting.
 
         Raises ``QueueFullError`` (with ``retry_after_ms``) when either
         budget is exhausted — one hot host's backpressure becomes a
@@ -426,12 +438,12 @@ class FleetRouter:
 
             trace = mint_trace()
         with self._lock:
-            tenant_bound = (
+            tenant_bound = not shadow and (
                 model is not None
                 and model in self._tenant_tokens
                 and self._tenant_tokens[model] <= 0
             )
-            if tenant_bound or self._tokens <= 0:
+            if tenant_bound or (not shadow and self._tokens <= 0):
                 self.front_door_rejections += 1
                 if model is not None:
                     self.rejections_by_model[model] = (
@@ -463,11 +475,12 @@ class FleetRouter:
                     "in flight); retry later",
                     retry_after_ms=hint, model=model,
                 )
-            self._tokens -= 1
-            if model is not None and model in self._tenant_tokens:
-                self._tenant_tokens[model] -= 1
+            if not shadow:
+                self._tokens -= 1
+                if model is not None and model in self._tenant_tokens:
+                    self._tenant_tokens[model] -= 1
             entry = _Flight(
-                next(self._ids), image, Future(), model=model,
+                next(self._ids), image, Future(), model=model, shadow=shadow,
                 trace=trace, t_submit_wall=time.time() if trace else 0.0,
             )
             self._inflight[entry.fid] = entry
@@ -485,13 +498,15 @@ class FleetRouter:
                 if not entry.finished:
                     entry.finished = True
                     self._inflight.pop(entry.fid, None)
-                    self._tokens += 1
-                    self._release_tenant_token(entry)
+                    if not entry.shadow:
+                        self._tokens += 1
+                        self._release_tenant_token(entry)
             raise
         return entry.future
 
     def _release_tenant_token(self, entry: _Flight) -> None:
-        """Return the entry's per-tenant admission token (lock held)."""
+        """Return the entry's per-tenant admission token (lock held;
+        shadow entries never held one — the caller guards)."""
         if entry.model is not None and entry.model in self._tenant_tokens:
             self._tenant_tokens[entry.model] += 1
 
@@ -558,11 +573,16 @@ class FleetRouter:
                 st = self._state[host.name]
                 st.outstanding += 1
                 st.dispatched_total += 1
-                st.window_requests += 1
-                if entry.model is not None:
-                    st.window_models[entry.model] = (
-                        st.window_models.get(entry.model, 0) + 1
-                    )
+                if not entry.shadow:
+                    # Shadow probes are real load (outstanding above) but
+                    # not routed TRAFFIC — the route-record windows and
+                    # per-tenant dispatch shares must reflect what
+                    # tenants actually sent (ISSUE 19).
+                    st.window_requests += 1
+                    if entry.model is not None:
+                        st.window_models[entry.model] = (
+                            st.window_models.get(entry.model, 0) + 1
+                        )
                 dispatched_total = st.dispatched_total
                 if entry.trace is not None and len(st.window_traces) < 32:
                     st.window_traces.append(entry.trace.trace_id)
@@ -582,6 +602,8 @@ class FleetRouter:
                     kwargs["trace"] = d_ctx
                 if entry.model is not None:
                     kwargs["model"] = entry.model
+                if entry.shadow:
+                    kwargs["shadow"] = True
                 hfut = host.submit(entry.payload, **kwargs)
             except BaseException as e:  # noqa: BLE001 — per-host trouble
                 with self._lock:
@@ -858,6 +880,8 @@ class FleetRouter:
                 kwargs["trace"] = entry.trace.child()
             if entry.model is not None:
                 kwargs["model"] = entry.model
+            if entry.shadow:
+                kwargs["shadow"] = True
             hfut = host.submit(entry.payload, **kwargs)
         except BaseException:  # noqa: BLE001 — the primary still owns it
             with self._lock:
@@ -938,8 +962,9 @@ class FleetRouter:
             entry.finished = True
             timer, entry.hedge_timer = entry.hedge_timer, None
             self._inflight.pop(entry.fid, None)
-            self._tokens += 1
-            self._release_tenant_token(entry)
+            if not entry.shadow:
+                self._tokens += 1
+                self._release_tenant_token(entry)
             now = time.monotonic()
             if self._done_t is not None:
                 inst = 1.0 / max(now - self._done_t, 1e-6)
@@ -966,6 +991,11 @@ class FleetRouter:
                 # path already stamps it) so a recorded trace is
                 # reconstructible into a per-model workload.
                 attrs["model"] = entry.model
+            if entry.shadow:
+                # v15: canary probes stay visible in traces — a workload
+                # extractor must be able to drop them (replaying shadow
+                # traffic as tenant traffic would skew the arrival model).
+                attrs["shadow"] = True
             self.spans.add(
                 name="route/request", trace=entry.trace.trace_id,
                 span=entry.trace.span_id, t0=entry.t_submit_wall,

@@ -134,6 +134,37 @@ class FleetServer:
             cfg, self._raw_metrics,
             lambda: self.router.active_hosts(), logger=self._logger,
         )
+        # Quality observability (ISSUE 19): one fleet-wide canary gate +
+        # drift monitor, built BEFORE the hosts so every server feeds the
+        # same detectors and every mutation path consults one verdict
+        # surface. Both write through the TAPPED stream — a drift alert
+        # pins in-flight traces and auto-dumps the flight recorder like
+        # any other fleet alert.
+        self.canary = None
+        self.drift = None
+        self.prober = None
+        if cfg.serve_drift_window > 0:
+            from mpi_pytorch_tpu.obs.drift import DriftMonitor
+
+            self.drift = DriftMonitor(
+                window=cfg.serve_drift_window,
+                psi_threshold=cfg.serve_drift_psi,
+                chi2_threshold=cfg.serve_drift_chi2,
+                cusum_h=cfg.serve_drift_cusum_h,
+                metrics=self._metrics,
+                logger=self._logger,
+            )
+        if cfg.serve_canary_probes > 0:
+            from mpi_pytorch_tpu.obs.canary import CanaryGate
+
+            self.canary = CanaryGate(
+                min_top1=cfg.serve_canary_min_top1,
+                fail_after=cfg.serve_canary_fail_after,
+                pass_after=cfg.serve_canary_pass_after,
+                metrics=self._metrics,
+                collector=self.collector,
+                logger=self._logger,
+            )
         total = n + (1 if want_spare else 0)
         servers = []
         try:
@@ -145,11 +176,12 @@ class FleetServer:
                         cfg, registry=self.zoo_registry,
                         pool=self._zoo_pool, metrics=self._metrics,
                         host_index=i, logger=self._logger,
+                        canary=self.canary, drift=self.drift,
                     ))
                 else:
                     servers.append(InferenceServer(
                         cfg, executables=executables, metrics=self._metrics,
-                        host_index=i,
+                        host_index=i, drift=self.drift,
                     ))
         except BaseException:
             for s in servers:
@@ -195,6 +227,39 @@ class FleetServer:
         )
         if self.collector is not None:
             self.collector.start()
+        if self.canary is not None:
+            # The prober's probes ride the REAL front door as shadow
+            # requests (real queues, real batches, real executables —
+            # excluded from SLO/admission/billing counters). First cycle
+            # pins the healthy references; later cycles score, and each
+            # cycle drives the drift monitor's CUSUM scan.
+            from mpi_pytorch_tpu.obs.canary import CanaryProber
+
+            if self.zoo_registry is not None:
+                models_fn = self.zoo_registry.models
+
+                def _probe_submit(img, m):
+                    return self.router.submit(img, model=m, shadow=True)
+            else:
+                single = getattr(cfg, "model_name", "") or "default"
+
+                def models_fn():
+                    return (single,)
+
+                def _probe_submit(img, _m):
+                    return self.router.submit(img, shadow=True)
+
+            self.prober = CanaryProber(
+                _probe_submit, models_fn, self.canary,
+                image_size=cfg.image_size[0],
+                probes=cfg.serve_canary_probes,
+                seed=cfg.seed,
+                interval_s=cfg.serve_canary_interval_s,
+                drift=self.drift,
+                collector=self.collector,
+                logger=self._logger,
+            )
+            self.prober.start()  # no-op at interval 0: drive probe_once()
         self.controller = None
         if cfg.serve_target_p99_ms > 0:
             self.controller = FleetController(
@@ -206,6 +271,7 @@ class FleetServer:
                     cfg.serve_max_wait_ms * 4.0, cfg.serve_max_wait_ms + 1.0
                 ),
                 logger=self._logger,
+                canary=self.canary,
             )
             self.controller.start()
         self.autoscaler = None
@@ -228,12 +294,13 @@ class FleetServer:
                         cfg, registry=self.zoo_registry,
                         pool=self._zoo_pool, metrics=self._metrics,
                         host_index=next(host_seq), logger=self._logger,
+                        canary=self.canary, drift=self.drift,
                     )
                     self._servers.append(server)
                     return ZooHost(server)
                 server = InferenceServer(
                     cfg, executables=self._exe, metrics=self._metrics,
-                    host_index=next(host_seq),
+                    host_index=next(host_seq), drift=self.drift,
                 )
                 self._servers.append(server)
                 return LocalHost(server)
@@ -331,6 +398,14 @@ class FleetServer:
                 default=0,
             ),
         }
+        if self.canary is not None:
+            out["canary"] = dict(self.canary.stats)
+            if self.prober is not None:
+                out["canary"].update(
+                    {f"prober_{k}": v for k, v in self.prober.stats.items()}
+                )
+        if self.drift is not None:
+            out["drift"] = dict(self.drift.stats)
         return out
 
     def tenant_stats(self) -> dict:
@@ -353,6 +428,11 @@ class FleetServer:
         if self._closed:
             return
         self._closed = True
+        # Prober first: it submits through the router, which is about to
+        # drain its hosts — a probe cycle racing the teardown would be
+        # scored against a half-closed fleet.
+        if self.prober is not None:
+            self.prober.stop()
         if self.autoscaler is not None:
             self.autoscaler.stop()
         if self.controller is not None:

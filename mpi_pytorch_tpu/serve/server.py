@@ -160,6 +160,7 @@ class InferenceServer:
         host_index: int | None = None,
         model: str | None = None,
         spans=None,
+        drift=None,
     ):
         import jax
 
@@ -202,6 +203,16 @@ class InferenceServer:
         self.model = model
         if model is not None:
             self.name = f"{self.name}/{model}"
+        # Quality-drift feed (ISSUE 19): a shared obs.DriftMonitor the
+        # completion loop hands every REAL request's top-1 prediction to
+        # (shadow canary probes are synthetic and must not shape the live
+        # traffic baseline). None — the default — costs nothing.
+        self._drift = drift
+        # Injected-quality-fault state (MPT_FAULT_LOGIT_NOISE_PCT): a
+        # deterministic per-server row counter (never a PRNG — the
+        # inject_faults discipline) plus the announce-once latch.
+        self._noise_counter = 0
+        self._noise_announced = False
         if executables is not None:
             # Pre-built (shared) executable set(s): the fleet harness
             # compiles ONE BucketExecutables per precision and hands them
@@ -280,7 +291,11 @@ class InferenceServer:
         # controller retunes bucket sets / max_wait_ms from). Always on:
         # the request path pays one pre-bound counter inc; everything else
         # updates per FLUSH on the completion loop, off the request path.
-        self._registry = MetricsRegistry()
+        # A tenant-owned registry carries its model as a Prometheus label
+        # so a fleet /metrics scrape distinguishes tenants (ISSUE 19).
+        self._registry = MetricsRegistry(
+            labels={"model": model} if model else None
+        )
         self._m_requests = self._registry.counter("serve/requests")
         self._m_rejected = self._registry.counter("serve/rejected")
         self._m_served = self._registry.counter("serve/served")
@@ -465,7 +480,7 @@ class InferenceServer:
 
     # ------------------------------------------------------------ request path
 
-    def submit(self, image, trace=None) -> Future:
+    def submit(self, image, trace=None, shadow=False) -> Future:
         """Enqueue one request; the future resolves to the top-k class
         indices (np.int32, shape [topk]). Raises ``QueueFullError`` under
         backpressure and ``ServerClosedError`` after ``close()``.
@@ -474,12 +489,19 @@ class InferenceServer:
         trace thread: a traced request's queue/preprocess/device phases
         land as spans in this host's ``/tracez`` ring, parented under the
         caller's span (ISSUE 13). ``None`` — the default — records
-        nothing anywhere."""
+        nothing anywhere.
+
+        ``shadow`` (ISSUE 19) marks a canary probe: it rides the real
+        queue/batch/executable path but is EXCLUDED from the SLO and
+        admission counters (requests/served/rejected/failed, the latency
+        histogram) — synthetic traffic must never page the on-call or
+        bill a tenant. It still appears in traces and flush records."""
         if self._batcher.closed:
             raise ServerClosedError("server is shut down")
         fut: Future = Future()
         rid = next(self._req_ids)
-        self._m_requests.inc()
+        if not shadow:
+            self._m_requests.inc()
         if self._tracer.enabled:
             # The enqueue end of the per-request trace thread: the same id
             # reappears in the req_ids args of every batch-phase span this
@@ -489,13 +511,15 @@ class InferenceServer:
         try:
             self._batcher.submit(
                 PendingRequest(
-                    payload=payload, future=fut, req_id=rid, trace=trace
+                    payload=payload, future=fut, req_id=rid, trace=trace,
+                    shadow=shadow,
                 )
             )
         except QueueFullError:
-            with self._lock:
-                self._stats["rejected"] += 1
-            self._m_rejected.inc()
+            if not shadow:
+                with self._lock:
+                    self._stats["rejected"] += 1
+                self._m_rejected.inc()
             self._maybe_evaluate_slo()
             payload.cancel()
             raise
@@ -779,6 +803,49 @@ class InferenceServer:
         if target < 0 or target == self.host_index:
             time.sleep(delay_ms / 1e3)
 
+    def _maybe_logit_noise(self, rows: np.ndarray, item) -> np.ndarray:
+        """The injected QUALITY fault (MPT_FAULT_LOGIT_NOISE_PCT, ISSUE
+        19): rotate a struck request's top-k answer row one position —
+        top-1 changes while the top-k SET is preserved, exactly the
+        silent-wrong-answers failure the canary/drift layer exists to
+        catch. Host-side, after device_get, so the zero-steady-state-
+        compile invariant is untouched. Deterministic: a per-server row
+        counter strikes when ``counter % 100 < pct`` (never a PRNG), and
+        the gate announces itself with a ``kind="fault"`` record on first
+        strike — a gate never fires silently.
+        ``MPT_FAULT_LOGIT_NOISE_MODEL`` restricts the strike to one
+        tenant; applies to real AND shadow rows alike (the canary must
+        see what tenants see)."""
+        from mpi_pytorch_tpu.utils.env import env_int
+
+        pct = env_int("MPT_FAULT_LOGIT_NOISE_PCT", 0)
+        if pct <= 0:
+            return rows
+        target = os.environ.get("MPT_FAULT_LOGIT_NOISE_MODEL", "")
+        if target and target != (self.model or ""):
+            return rows
+        # device_get hands back a read-only view of the device buffer —
+        # strike on a writable copy.
+        rows = np.array(rows)
+        struck = 0
+        for i in range(len(item.requests)):
+            counter = self._noise_counter
+            self._noise_counter += 1
+            if counter % 100 < pct:
+                rows[i] = np.roll(rows[i], 1)
+                struck += 1
+        if struck and not self._noise_announced:
+            self._noise_announced = True
+            self._metrics.write({
+                "kind": "fault",
+                "reason": "injected_logit_noise",
+                "detail": (
+                    f"rotating top-k rows on {self.name} "
+                    f"(pct={pct}, model={self.model or 'any'})"
+                ),
+            })
+        return rows
+
     def _completion_loop(self) -> None:
         import jax
 
@@ -798,18 +865,21 @@ class InferenceServer:
                     rows = np.asarray(jax.device_get(item.preds))
                 t_done = time.monotonic()
                 rows = rows.reshape(rows.shape[0], -1)  # [bucket] -> [bucket, 1]
-                n = len(item.requests)
+                rows = self._maybe_logit_noise(rows, item)
+                n_total = len(item.requests)
+                n_shadow = sum(1 for r in item.requests if r.shadow)
+                n = n_total - n_shadow  # REAL requests only (ISSUE 19)
                 with self._lock:
                     self._stats["served"] += n
                     self._stats["batches"] += 1
                     self._stats["by_bucket"][item.bucket] += 1
-                    self._stats["padded_rows"] += item.bucket - n
+                    self._stats["padded_rows"] += item.bucket - n_total
                 record = {
                     "kind": "serve",
                     "bucket": item.bucket,
                     "requests": n,
                     "queue_depth": self._batcher.qsize(),
-                    "fill_ratio": round(n / item.bucket, 4),
+                    "fill_ratio": round(n_total / item.bucket, 4),
                     "queue_wait_ms": round(item.queue_wait_ms, 3),
                     "preprocess_ms": round(item.preprocess_ms, 3),
                     "device_ms": round(1e3 * (t_done - item.t_dispatch), 3),
@@ -831,6 +901,12 @@ class InferenceServer:
                     # chips one copy of the params spans — replicated
                     # tenants keep their records byte-identical to v12.
                     record["shard_degree"] = self.shard_degree
+                if n_shadow:
+                    # Schema-v15: canary shadow probes riding this flush —
+                    # they fill batch slots but are excluded from the
+                    # requests count above and every SLO/billing counter.
+                    # Flushes with no shadows stay byte-identical to v14.
+                    record["shadow_requests"] = n_shadow
                 if self.model is not None:
                     # Schema-v10: the tenant this (single-tenant, by
                     # construction) flush served — absent on untenanted
@@ -854,8 +930,19 @@ class InferenceServer:
                 self._m_qwait_ms.observe(record["queue_wait_ms"])
                 self._m_dev_ms.observe(record["device_ms"])
                 self._m_fill.observe(100.0 * record["fill_ratio"])
-                for req in item.requests:
+                for i, req in enumerate(item.requests):
+                    if req.shadow:
+                        continue  # synthetic: no SLO latency, no drift feed
                     self._m_req_ms.observe(1e3 * (t_done - req.t_submit))
+                    if self._drift is not None:
+                        # Live-traffic prediction sketch (ISSUE 19): the
+                        # top-1 class of every REAL request feeds the
+                        # tenant's drift window; one dict lookup + deque
+                        # append on the completion loop, off the request
+                        # path.
+                        self._drift.observe(
+                            self.model or "default", int(rows[i][0])
+                        )
                 self._g_qdepth.set(record["queue_depth"])
                 self._g_compiles.set(self.compiles_after_warmup())
                 self._maybe_evaluate_slo(force=True)
@@ -942,9 +1029,11 @@ class InferenceServer:
         return self._spans.export(since)
 
     def _fail(self, requests, exc) -> None:
-        with self._lock:
-            self._stats["failed"] += len(requests)
-        self._m_failed.inc(len(requests))
+        n_real = sum(1 for r in requests if not r.shadow)
+        if n_real:
+            with self._lock:
+                self._stats["failed"] += n_real
+            self._m_failed.inc(n_real)
         now_wall, now_mono = time.time(), time.monotonic()
         for req in requests:
             if req.trace is not None:

@@ -60,12 +60,22 @@ class ZooServer:
         load_checkpoint: bool = True,
         mesh=None,
         logger=None,
+        canary=None,
+        drift=None,
     ):
         from mpi_pytorch_tpu.obs.context import SpanRecorder
         from mpi_pytorch_tpu.utils.logging import MetricsWriter, run_logger
 
         self.cfg = cfg
         self._logger = logger or run_logger()
+        # Quality gate + drift feed (ISSUE 19): the fleet-shared
+        # ``obs.CanaryGate`` every mutation on this host consults (swap-in
+        # after the warm probe, set_precision, convert_residency) and the
+        # shared ``obs.DriftMonitor`` each tenant server's completion loop
+        # feeds top-1 predictions. Both default None — single-host zoo
+        # callers keep v14 behavior exactly.
+        self._canary = canary
+        self._drift = drift
         self.registry = registry or ModelRegistry.from_config(cfg)
         self.pool = pool if pool is not None else ZooExecutablePool(
             cfg, self.registry, mesh=mesh, load_checkpoint=load_checkpoint,
@@ -219,10 +229,23 @@ class ZooServer:
             entry = plan.entry(model)
             want = parse_residency(entry.residency if entry else None)
             sets = self.pool.ensure(model, residency=want)  # load + warm-probe
+            # Mutation-gate order (ISSUE 19): warm probe → canary →
+            # activate. The zero-compile warm probe proved the sets can
+            # serve; the canary verdict says whether the TENANT should —
+            # a FAIL latched before eviction blocks the re-swap-in (the
+            # pinned references outlive residency for exactly this).
+            verdict = None
+            if event is not None and self._canary is not None:
+                try:
+                    verdict = self._canary.check(model, mutation="swap_in")
+                except Exception:
+                    self.pool.release(model)  # no orphaned pool sets
+                    raise
             tenant_cfg = self.registry.tenant_cfg(model)
             srv = InferenceServer(
                 tenant_cfg, executables=sets, metrics=self._metrics,
                 host_index=self.host_index, model=model, spans=self._spans,
+                drift=self._drift,
             )
             with self._lock:
                 self._tenants[model] = srv
@@ -241,6 +264,11 @@ class ZooServer:
                     "compiles_after_warmup": srv.compiles_after_warmup(),
                     "plan": plan.to_record(),
                 }
+                if verdict is not None:
+                    # Schema-v15: the canary verdict this mutation passed
+                    # under — absent without a gate, so v14 streams stay
+                    # byte-identical.
+                    record["canary_verdict"] = verdict
                 res = self.pool.residency(model)
                 if res != "replicated":
                     # A sharded swap-in crossed topologies on the way in:
@@ -275,6 +303,7 @@ class ZooServer:
         srv = InferenceServer(
             tenant_cfg, executables=new_sets, metrics=self._metrics,
             host_index=self.host_index, model=model, spans=self._spans,
+            drift=self._drift,
         )
         with self._lock:
             old = self._tenants.get(model)
@@ -295,6 +324,8 @@ class ZooServer:
             "compiles_after_warmup": srv.compiles_after_warmup(),
             "detail": reason,
         }
+        if self._canary is not None:
+            record["canary_verdict"] = self._canary.verdict(model)
         if plan is not None:
             record["plan"] = plan.to_record()
         self._metrics.write(record)
@@ -307,6 +338,11 @@ class ZooServer:
             raise ServeError(f"zoo host {self.name} is shut down")
         self.registry.spec(model)
         self.tenant(model)  # ModelNotResidentError for non-residents
+        if self._canary is not None:
+            # Gated mutation (ISSUE 19): resharding a tenant that is
+            # answering wrong destroys the evidence — refuse until the
+            # canary recovers (CanaryBlockedError, refusal on the record).
+            self._canary.check(model, mutation=f"convert_residency:{residency}")
         with self._swap_lock:
             self._convert_locked(model, residency, reason=reason)
 
@@ -383,10 +419,13 @@ class ZooServer:
 
     # ---------------------------------------------------------- request path
 
-    def submit(self, image, model: str | None = None, trace=None):
+    def submit(self, image, model: str | None = None, trace=None,
+               shadow: bool = False):
         """Enqueue one request for ``model``. The tenant must be named
         on a multi-tenant host (a single-tenant zoo defaults to its one
-        tenant); rejections carry the tenant on the typed error."""
+        tenant); rejections carry the tenant on the typed error.
+        ``shadow=True`` marks a canary probe (ISSUE 19): real path,
+        excluded from SLO/admission/billing counters."""
         if model is None:
             registered = self.registry.models()
             if len(registered) != 1:
@@ -400,8 +439,8 @@ class ZooServer:
             with self._lock:
                 self._last_used[model] = time.monotonic()
             try:
-                if trace is not None:
-                    return srv.submit(image, trace=trace)
+                if trace is not None or shadow:
+                    return srv.submit(image, trace=trace, shadow=shadow)
                 return srv.submit(image)
             except QueueFullError as e:
                 e.model = model  # the typed rejection names its tenant
@@ -565,6 +604,15 @@ class ZooServer:
         self._fanout(model, lambda s: s.set_active_buckets(buckets))
 
     def set_precision(self, precision: str, model: str | None = None) -> None:
+        if self._canary is not None:
+            # Gated mutation (ISSUE 19): checked per targeted tenant
+            # BEFORE any server switches — a fanout must be all-or-none
+            # (a half-switched precision fleet is its own incident).
+            targets = (
+                [model] if model is not None else sorted(self.tenants())
+            )
+            for m in targets:
+                self._canary.check(m, mutation=f"set_precision:{precision}")
         self._fanout(model, lambda s: s.set_precision(precision))
 
     # ------------------------------------------------------------- lifecycle
@@ -664,8 +712,10 @@ class ZooHost(LocalHost):
         self.name = server.name
         self.index = server.host_index
 
-    def submit(self, image, trace=None, model=None):
-        return self.server.submit(image, model=model, trace=trace)
+    def submit(self, image, trace=None, model=None, shadow=False):
+        return self.server.submit(
+            image, model=model, trace=trace, shadow=shadow
+        )
 
     def models(self):
         return self.server.models()

@@ -152,6 +152,26 @@ FAULT_GATES: dict[str, str] = {
         "wave, never a PRNG) on top of MPT_FAULT_WIRE_DELAY_MS — a laggy "
         "wire that wobbles, with a delay schedule that replays exactly"
     ),
+    "MPT_FAULT_LOGIT_NOISE_PCT": (
+        "poison this percent of served predictions (0-100, continuous "
+        "while set — read per flush like the delay gates): each struck "
+        "request's top-k index vector is rotated one position, so its "
+        "top-1 answer changes deterministically without touching the "
+        "compiled executable (the perturbation is host-side, after "
+        "device fetch — zero-compile invariants hold). The strike "
+        "pattern is a per-server counter (request counter mod 100 < "
+        "pct), never a PRNG, so a drill replays exactly. Announced by a "
+        "kind='fault' record the first time it bites in a server — a "
+        "gate never strikes silently. The quality-canary/drift drill's "
+        "lever (obs/canary.py, obs/drift.py)"
+    ),
+    "MPT_FAULT_LOGIT_NOISE_MODEL": (
+        "restrict MPT_FAULT_LOGIT_NOISE_PCT to this tenant (model name; "
+        "unset = every server) — poison one zoo tenant so its canary "
+        "fails and its drift alert fires while its siblings stay clean, "
+        "which is exactly the per-tenant isolation the gated-mutation "
+        "drill asserts"
+    ),
     "MPT_FAULT_RESHARD_N": (
         "fail the next N serve-side residency reshards (serve/sharding.py) "
         "mid-tree, after some leaves have already been placed — the "

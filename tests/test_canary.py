@@ -1,0 +1,431 @@
+"""Quality observability (ISSUE 19), jax-free: the drift detectors
+(PSI / chi-squared / CUSUM / Page-Hinkley / prediction sketch), the
+golden-set canary gate's verdict hysteresis and mutation blocking, the
+prober's pin-then-score cycle over a fake front door, and schema
+validity of every record the layer emits."""
+
+import numpy as np
+import pytest
+
+from mpi_pytorch_tpu.obs.canary import (
+    CanaryBlockedError,
+    CanaryGate,
+    CanaryProber,
+    golden_inputs,
+    score_probes,
+)
+from mpi_pytorch_tpu.obs.drift import (
+    Cusum,
+    DriftMonitor,
+    PageHinkley,
+    PredictionSketch,
+    chi_squared,
+    entropy_bits,
+    psi,
+)
+from mpi_pytorch_tpu.obs.schema import validate_record
+
+
+class FakeWriter:
+    """Collects records like MetricsWriter; every record must be
+    schema-clean at write time."""
+
+    def __init__(self):
+        self.records = []
+
+    def write(self, record):
+        record = {"ts": 0.0, **record}  # the real writer stamps ts
+        assert validate_record(record) == [], (record, validate_record(record))
+        self.records.append(record)
+
+    def by_kind(self, kind):
+        return [r for r in self.records if r.get("kind") == kind]
+
+
+# ---------------------------------------------------------------------------
+# detectors: psi / chi2 / entropy
+# ---------------------------------------------------------------------------
+
+
+def test_psi_zero_on_identical_large_on_disjoint():
+    base = {0: 50, 1: 30, 2: 20}
+    assert psi(base, dict(base)) == pytest.approx(0.0, abs=1e-9)
+    # A proportional scale of the same shape is also stable.
+    assert psi(base, {0: 500, 1: 300, 2: 200}) == pytest.approx(0.0, abs=1e-9)
+    # Fully disjoint support is far past the 0.25 actionable band.
+    assert psi(base, {7: 60, 8: 40}) > 1.0
+
+
+def test_psi_moderate_shift_lands_between():
+    base = {0: 50, 1: 50}
+    shifted = {0: 65, 1: 35}
+    v = psi(base, shifted)
+    assert 0.0 < v < 0.25  # moderate, below the default threshold
+
+
+def test_chi_squared_scale_free_and_unseen_class_finite():
+    base = {0: 100, 1: 100}
+    stat_same, dof = chi_squared(base, {0: 51, 1: 49})
+    assert dof == 1
+    assert stat_same / dof < 1.0
+    stat_new, dof2 = chi_squared(base, {5: 100})  # baseline-unseen class
+    assert np.isfinite(stat_new)
+    assert stat_new / dof2 > 10.0
+
+
+def test_entropy_bits_collapse_vs_uniform():
+    assert entropy_bits({0: 100}) == pytest.approx(0.0)
+    assert entropy_bits({i: 25 for i in range(4)}) == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# detectors: change points
+# ---------------------------------------------------------------------------
+
+
+def test_cusum_silent_on_stationary_noise():
+    det = Cusum(h=8.0, warmup=16)
+    rng = np.random.default_rng(2)
+    fired = [det.update(v) for v in rng.normal(10.0, 1.0, size=400)]
+    assert not any(fired)
+    assert det.fires == 0
+
+
+def test_cusum_fires_once_then_rearms_on_second_step():
+    det = Cusum(h=8.0, warmup=16)
+    rng = np.random.default_rng(1)
+    for v in rng.normal(10.0, 0.5, size=64):
+        det.update(v)
+    # A sustained 10-sigma step: exactly ONE alarm, not one per sample.
+    fired = [det.update(v) for v in rng.normal(15.0, 0.5, size=64)]
+    assert sum(fired) == 1
+    assert det.fires == 1
+    # Re-armed on post-change data: a second step (back down) fires again.
+    fired2 = [det.update(v) for v in rng.normal(10.0, 0.5, size=64)]
+    assert sum(fired2) == 1
+    assert det.fires == 2
+
+
+def test_page_hinkley_catches_slow_ramp():
+    det = PageHinkley(delta=0.005, lam=5.0, warmup=8)
+    for _ in range(50):
+        assert not det.update(10.0)
+    fired = [det.update(10.0 + 0.05 * i) for i in range(200)]
+    assert any(fired)
+    assert det.fires >= 1
+
+
+# ---------------------------------------------------------------------------
+# prediction sketch
+# ---------------------------------------------------------------------------
+
+
+def test_sketch_first_window_seeds_baseline():
+    sk = PredictionSketch(window=8, baseline_windows=2)
+    for i in range(8):
+        sk.observe(i % 2)
+    assert sk.full()
+    assert sk.compare() is None  # nothing to compare against yet
+    sk.roll()
+    assert sk.window_n == 0
+    assert sk.baseline_counts() == {0: 4, 1: 4}
+
+
+def test_sketch_discard_keeps_baseline_clean():
+    sk = PredictionSketch(window=8, baseline_windows=4)
+    for i in range(8):
+        sk.observe(i % 2)
+    sk.roll()
+    for _ in range(8):
+        sk.observe(7)  # the drifted window
+    cmp = sk.compare()
+    assert cmp is not None and cmp["psi"] > 1.0
+    sk.discard()
+    # The breaching window never entered the baseline.
+    assert sk.baseline_counts() == {0: 4, 1: 4}
+    assert sk.window_n == 0
+
+
+def test_sketch_rejects_tiny_window():
+    with pytest.raises(ValueError):
+        PredictionSketch(window=4)
+
+
+# ---------------------------------------------------------------------------
+# drift monitor
+# ---------------------------------------------------------------------------
+
+
+def _feed(mon, model, values):
+    for v in values:
+        mon.observe(model, v)
+
+
+def test_drift_monitor_alerts_once_latched_then_recovers():
+    w = FakeWriter()
+    mon = DriftMonitor(window=16, psi_threshold=0.25, metrics=w)
+    # Two clean windows: seed + clean compare, no alert.
+    _feed(mon, "m", [i % 4 for i in range(32)])
+    assert not mon.breached("m")
+    assert w.by_kind("alert") == []
+    # Two drifted windows: ONE page alert (latched), not two.
+    _feed(mon, "m", [9] * 32)
+    assert mon.breached("m")
+    pages = [a for a in w.by_kind("alert") if a["severity"] == "page"]
+    assert len(pages) == 1
+    a = pages[0]
+    assert a["source"] == "drift" and a["model"] == "m"
+    assert a["action"] == "drift_breach" and a["psi"] > 0.25
+    # A clean window recovers (info alert) and un-latches.
+    _feed(mon, "m", [i % 4 for i in range(16)])
+    assert not mon.breached("m")
+    recs = [a for a in w.by_kind("alert") if a["action"] == "recovered"]
+    assert len(recs) == 1
+    assert mon.stats["alerts"] == 1 and mon.stats["recoveries"] == 1
+
+
+def test_drift_monitor_tenants_are_independent():
+    w = FakeWriter()
+    mon = DriftMonitor(window=16, metrics=w)
+    _feed(mon, "a", [i % 4 for i in range(32)])
+    _feed(mon, "b", [i % 4 for i in range(32)])
+    _feed(mon, "a", [9] * 16)
+    assert mon.breached("a") and not mon.breached("b")
+    assert {a["model"] for a in w.by_kind("alert")} == {"a"}
+
+
+class FakeCollector:
+    """The slice of the fleet collector the drift scanner consumes."""
+
+    def __init__(self):
+        self.series = {}
+
+    def ingest_point(self, host, metric, value):
+        self.series.setdefault((host, metric), []).append(
+            (float(len(self.series.get((host, metric), []))), float(value))
+        )
+
+    def series_snapshot(self):
+        return {k: list(v) for k, v in self.series.items()}
+
+
+def test_drift_scan_cusum_over_collector_rings_feeds_each_point_once():
+    w = FakeWriter()
+    mon = DriftMonitor(window=16, cusum_h=8.0, metrics=w)
+    col = FakeCollector()
+    rng = np.random.default_rng(2)
+    for v in rng.normal(100.0, 1.0, size=64):
+        col.ingest_point("h0", "serve/p99", v)
+    assert mon.scan(col) == 0
+    # Re-scanning the SAME ring must not re-feed points (cursor).
+    assert mon.scan(col) == 0
+    for v in rng.normal(200.0, 1.0, size=32):
+        col.ingest_point("h0", "serve/p99", v)
+    assert mon.scan(col) == 1  # the step fires exactly once
+    alert = w.by_kind("alert")[-1]
+    assert alert["rule"] == "cusum:serve/p99"
+    assert alert["host"] == "h0" and alert["source"] == "drift"
+    assert mon.stats["cusum_alerts"] == 1
+
+
+# ---------------------------------------------------------------------------
+# golden set + scoring
+# ---------------------------------------------------------------------------
+
+
+def test_golden_inputs_deterministic_and_per_model():
+    a1 = golden_inputs(4, 8, model="resnet18", seed=3)
+    a2 = golden_inputs(4, 8, model="resnet18", seed=3)
+    b = golden_inputs(4, 8, model="mobilenet_v2", seed=3)
+    assert all(np.array_equal(x, y) for x, y in zip(a1, a2))
+    assert not all(np.array_equal(x, y) for x, y in zip(a1, b))
+    assert a1[0].shape == (8, 8, 3) and a1[0].dtype == np.uint8
+
+
+def test_score_probes_perfect_rolled_and_lost():
+    refs = [np.array([3, 1, 4]), np.array([5, 9, 2])]
+    perfect = score_probes(refs, [r.copy() for r in refs])
+    assert perfect["agreement_top1"] == 1.0
+    assert perfect["agreement_topk"] == 1.0
+    assert perfect["rank_drift"] == 0.0
+    # The logit-noise fault's exact shape: rows rolled one position —
+    # top-1 disagrees, the top-k SET survives, reference top-1 at rank 1.
+    rolled = score_probes(refs, [np.roll(r, 1) for r in refs])
+    assert rolled["agreement_top1"] == 0.0
+    assert rolled["agreement_topk"] == 1.0
+    assert rolled["rank_drift"] == 1.0
+    # Reference top-1 gone entirely: drift saturates at k.
+    lost = score_probes(refs, [np.array([7, 8, 6]), np.array([0, 1, 3])])
+    assert lost["agreement_top1"] == 0.0
+    assert lost["rank_drift"] == 3.0
+
+
+def test_score_probes_length_mismatch_raises():
+    with pytest.raises(ValueError):
+        score_probes([np.array([1, 2, 3])], [])
+
+
+# ---------------------------------------------------------------------------
+# canary gate
+# ---------------------------------------------------------------------------
+
+
+def _refs(k=3, n=4):
+    return [np.arange(i, i + k) for i in range(n)]
+
+
+def test_gate_pin_is_deliberate():
+    gate = CanaryGate(metrics=FakeWriter())
+    gate.pin("m", _refs())
+    assert gate.pinned("m")
+    with pytest.raises(ValueError):
+        gate.pin("m", _refs())  # re-pin requires an explicit clear()
+    gate.clear("m")
+    assert not gate.pinned("m")
+    gate.pin("m", _refs())
+
+
+def test_gate_verdict_hysteresis_trip_and_recover():
+    w = FakeWriter()
+    gate = CanaryGate(min_top1=0.95, fail_after=2, pass_after=2, metrics=w)
+    refs = _refs()
+    gate.pin("m", refs)
+    assert gate.verdict("m") == "none"  # never probed: must not block
+    assert gate.check("m", mutation="swap_in") == "none"
+    assert gate.score("m", refs)["verdict"] == "pass"
+    bad = [np.roll(r, 1) for r in refs]
+    # One failing cycle is noise, not an incident.
+    assert gate.score("m", bad)["verdict"] == "pass"
+    assert gate.score("m", bad)["verdict"] == "fail"
+    assert gate.stats["trips"] == 1
+    # One passing cycle is not a recovery either.
+    assert gate.score("m", refs)["verdict"] == "fail"
+    assert gate.score("m", refs)["verdict"] == "pass"
+    assert gate.stats["recoveries"] == 1
+    probes = [r for r in w.by_kind("canary") if r["event"] == "probe"]
+    assert len(probes) == 5
+    assert all("agreement_top1" in r for r in probes)
+
+
+def test_gate_check_blocks_and_writes_refusal_record():
+    w = FakeWriter()
+    gate = CanaryGate(fail_after=1, metrics=w)
+    refs = _refs()
+    gate.pin("m", refs)
+    gate.score("m", [np.roll(r, 1) for r in refs])
+    with pytest.raises(CanaryBlockedError) as ei:
+        gate.check("m", mutation="set_precision:int8")
+    assert ei.value.model == "m"
+    assert ei.value.agreement_top1 == 0.0
+    blocked = [r for r in w.by_kind("canary") if r["event"] == "blocked"]
+    assert len(blocked) == 1
+    assert blocked[0]["mutation"] == "set_precision:int8"
+    assert blocked[0]["verdict"] == "fail"
+    assert gate.stats["blocked"] == 1
+    # The untenanted path never blocks.
+    assert gate.check(None, mutation="retune:h0") == "none"
+    # Other tenants are unaffected.
+    gate.pin("other", refs)
+    gate.score("other", refs)
+    assert gate.check("other", mutation="swap_in") == "pass"
+
+
+def test_gate_references_survive_and_round_trip():
+    gate = CanaryGate(metrics=FakeWriter())
+    refs = _refs()
+    gate.pin("m", refs)
+    got = gate.references("m")
+    assert got is not None
+    assert all(np.array_equal(a, b) for a, b in zip(got, refs))
+    assert gate.references("unknown") is None
+
+
+# ---------------------------------------------------------------------------
+# prober over a fake front door
+# ---------------------------------------------------------------------------
+
+
+class _Fut:
+    def __init__(self, value=None, exc=None):
+        self._value, self._exc = value, exc
+
+    def result(self, timeout=None):
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class FakeFrontDoor:
+    """Answers probes with a fixed per-model mapping; can be poisoned
+    (rolled answers) or made unreachable per model."""
+
+    def __init__(self, k=3):
+        self.k = k
+        self.poisoned = set()
+        self.down = set()
+        self.submits = []
+
+    def submit(self, image, model):
+        self.submits.append(model)
+        if model in self.down:
+            return _Fut(exc=RuntimeError("no live host"))
+        row = np.arange(self.k) + (int(np.asarray(image).sum()) % 97)
+        if model in self.poisoned:
+            row = np.roll(row, 1)
+        return _Fut(value=row)
+
+
+def _prober(door, gate, models=("a", "b"), **kw):
+    return CanaryProber(
+        door.submit, lambda: models, gate, image_size=8, probes=4, seed=0,
+        **kw,
+    )
+
+
+def test_prober_pins_then_scores():
+    w = FakeWriter()
+    gate = CanaryGate(fail_after=1, pass_after=1, metrics=w)
+    door = FakeFrontDoor()
+    prober = _prober(door, gate)
+    first = prober.probe_once()
+    assert first == {
+        "a": {"event": "pin", "probes": 4},
+        "b": {"event": "pin", "probes": 4},
+    }
+    second = prober.probe_once()
+    assert second["a"]["verdict"] == "pass"
+    assert second["b"]["verdict"] == "pass"
+    door.poisoned.add("a")
+    third = prober.probe_once()
+    assert third["a"]["verdict"] == "fail"
+    assert third["b"]["verdict"] == "pass"
+    assert prober.stats["cycles"] == 3
+
+
+def test_prober_skips_unreachable_tenant_instead_of_failing_it():
+    gate = CanaryGate(fail_after=1, metrics=FakeWriter())
+    door = FakeFrontDoor()
+    prober = _prober(door, gate)
+    prober.probe_once()  # pin
+    prober.probe_once()  # score: both pass
+    door.down.add("a")
+    out = prober.probe_once()
+    # Availability is not quality: no score, no verdict movement for "a".
+    assert "a" not in out and gate.verdict("a") == "pass"
+    assert out["b"]["verdict"] == "pass"
+    assert prober.stats["skipped_tenants"] == 1
+
+
+def test_prober_drives_cusum_scan_on_its_heartbeat():
+    w = FakeWriter()
+    col = FakeCollector()
+    gate = CanaryGate(metrics=w, collector=col)
+    mon = DriftMonitor(window=16, metrics=w)
+    door = FakeFrontDoor()
+    prober = _prober(door, gate, drift=mon, collector=col)
+    for _ in range(3):
+        prober.probe_once()
+    # Probe scores landed in the collector rings under the synthetic
+    # "fleet" host, and the scan consumed them without firing.
+    assert ("fleet", "canary/a/agreement_top1") in col.series_snapshot()
+    assert mon.stats["cusum_alerts"] == 0

@@ -550,6 +550,15 @@ def main() -> int:
                     "the kept traces to this JSONL — the RECORD half of "
                     "the record-and-replay recipe (record at sample rate "
                     "1.0 for an exact workload)")
+    ap.add_argument("--canary-probes", type=int, default=0,
+                    help="arm the golden-set quality canary (ISSUE 19): N "
+                         "shadow probes per tenant per swept point through "
+                         "the fleet front door; rows gain agreement_top1 "
+                         "(needs a local --fleet N)")
+    ap.add_argument("--drift-window", type=int, default=0,
+                    help="arm prediction-drift detection: per-tenant top-1 "
+                         "histograms over windows of N real requests "
+                         "(needs a local --fleet N)")
     ap.add_argument("--serve-shard-degree", type=int, default=1,
                     help="> 1: single-model MODEL-parallel serving — "
                     "params fsdp:K-sharded over the model axis of a "
@@ -609,6 +618,14 @@ def main() -> int:
         # packing planner instead.
         print("--serve-shard-degree needs a bare single-model server "
               "(no --fleet/--models)", file=sys.stderr)
+        return 2
+    if (args.canary_probes or args.drift_window) and (
+            args.fleet <= 0 or args.transport != "local"):
+        # The gate/prober live in FleetServer; remote hosts are separate
+        # processes whose fleet object is theirs, not ours.
+        print("--canary-probes/--drift-window need a local --fleet N "
+              "(the canary gate and prober are FleetServer wiring)",
+              file=sys.stderr)
         return 2
     cache_dir = ""
     if args.transport in ("remote", "framed"):
@@ -721,6 +738,8 @@ def main() -> int:
             # tight scrape keeps the sweep point's spans inside the point.
             serve_collect_interval_s=0.1 if args.trace_sample_rate > 0
             else 0.0,
+            serve_canary_probes=max(0, args.canary_probes),
+            serve_drift_window=max(0, args.drift_window),
             metrics_file="", log_file="", eval_log_file="",
         )
         cfg.validate_config()
@@ -734,6 +753,23 @@ def main() -> int:
             server = ZooServer(cfg, load_checkpoint=False)
         else:
             server = InferenceServer(cfg, load_checkpoint=False)
+        if args.canary_probes and getattr(server, "prober", None) is not None:
+            # Pin the healthy references BEFORE the sweep, with the
+            # quality-fault gate disarmed: the bench's references are
+            # ground truth by construction, so a drill fault
+            # (MPT_FAULT_LOGIT_NOISE_*) must surface as sweep-row
+            # disagreement — never silently poison the baseline the
+            # sweep is scored against.
+            _noise_gates = {
+                k: os.environ.pop(k)
+                for k in ("MPT_FAULT_LOGIT_NOISE_PCT",
+                          "MPT_FAULT_LOGIT_NOISE_MODEL")
+                if k in os.environ
+            }
+            try:
+                server.prober.probe_once()
+            finally:
+                os.environ.update(_noise_gates)
         try:
             for precision in precisions:
                 if server.precision != precision:
@@ -771,6 +807,14 @@ def main() -> int:
                             )
                             row["model"] = args.model
                             rows = [row]
+                        canary_scores = None
+                        if (args.canary_probes
+                                and getattr(server, "prober", None)
+                                is not None):
+                            # One probe cycle per swept point: the row's
+                            # quality stamp measures THIS point's config
+                            # (precision/wait/buckets), not a stale one.
+                            canary_scores = server.prober.probe_once()
                         collector = getattr(server, "collector", None)
                         per_phase = None
                         if collector is not None:
@@ -828,6 +872,18 @@ def main() -> int:
                             if (precision == "int8"
                                     and server.parity_top1 is not None):
                                 row["parity_top1"] = server.parity_top1
+                            if canary_scores:
+                                # Schema-v15 quality axis: the canary's
+                                # live top-1 agreement for this row's
+                                # tenant (check_regression fails a >2-pt
+                                # absolute drop vs baseline).
+                                sc = canary_scores.get(
+                                    row.get("model") or ""
+                                )
+                                if sc and "agreement_top1" in sc:
+                                    row["agreement_top1"] = (
+                                        sc["agreement_top1"]
+                                    )
                             print(json.dumps(row), flush=True)
                             out_rows.append(row)
         finally:

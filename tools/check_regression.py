@@ -17,7 +17,12 @@ than ``--tolerance-pct`` against its predecessor:
   mesh change — is a new trend line, not a regression);
 - serve: ``p99_ms`` rose or ``images_per_sec`` dropped for the same sweep
   point (mode × buckets × max_wait × offered_rps × model), compared
-  against a committed baseline snapshot (``--serve-baseline``).
+  against a committed baseline snapshot (``--serve-baseline``); the
+  QUALITY axis (ISSUE 19) — canary ``agreement_top1`` on rows that carry
+  it — trends the same way but on an absolute scale: a drop of more than
+  2 points (0.02) fails regardless of ``--tolerance-pct``, keyed by
+  (model, precision, residency) so int8/sharded rows never compare
+  against bf16/replicated baselines.
 
 Tolerances for history that CANNOT be compared, by design:
 
@@ -127,12 +132,17 @@ def _serve_key(row: dict) -> tuple:
     # workload's content fingerprint, so replayed-load trend lines never
     # compare against synthetic-Poisson baselines (and two replays only
     # compare when they re-drove the IDENTICAL arrival process);
-    # pre-v14 rows key None on both sides, unchanged.
+    # pre-v14 rows key None on both sides, unchanged. residency joined
+    # in v15 alongside shard_degree: a tp/fsdp-resident tenant is a
+    # different machine shape than the replicated one, and the QUALITY
+    # axis (agreement_top1) must never read "int8 agrees less than
+    # bf16" or "fsdp differs from replicated" as a regression — those
+    # are different trend lines by construction.
     return (
         row.get("mode"), row.get("buckets"), row.get("max_wait_ms"),
         row.get("offered_rps"), row.get("model"), row.get("fleet_hosts"),
         row.get("precision"), row.get("transport"), row.get("load_shape"),
-        row.get("shard_degree"), row.get("workload"),
+        row.get("shard_degree"), row.get("workload"), row.get("residency"),
     )
 
 
@@ -180,6 +190,25 @@ def check_serve(new_path: str, baseline_path: str, tol_pct: float) -> list[str]:
             violations.append(
                 f"serve [{point}]: {ips:,.1f} img/s vs baseline {ips_0:,.1f} "
                 f"(-{100.0 * (1 - ips / ips_0):.1f}% > {tol_pct}% tolerance)"
+            )
+        # Schema-v15 quality axis: the canary top-1 agreement trends
+        # like img/s, but on an ABSOLUTE scale — agreement is a
+        # fraction of probes, so "10% relative" would let a 0.99
+        # baseline drift to 0.89 (ten misclassified probes in a
+        # hundred) without failing. A drop of more than 2 absolute
+        # points (0.02) fails; keyed by (model, precision, residency)
+        # via _serve_key, so int8/sharded rows only ever compare
+        # against their own baselines. Pre-v15 rows (no field) skip.
+        agree, agree_0 = row.get("agreement_top1"), prev.get("agreement_top1")
+        if (
+            isinstance(agree, (int, float)) and isinstance(agree_0, (int, float))
+            and agree < agree_0 - 0.02
+        ):
+            violations.append(
+                f"serve [{point}]: canary agreement_top1 {agree:.4f} vs "
+                f"baseline {agree_0:.4f} "
+                f"(-{100.0 * (agree_0 - agree):.1f} points > 2-point "
+                "absolute tolerance)"
             )
         # Schema-v9 per-phase attribution (the collector-derived
         # queue/preprocess/device/wire breakdown): compared only when

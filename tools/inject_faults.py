@@ -11,9 +11,11 @@ not that the fault happened to miss. Two halves:
   backend init for N attempts, fail the first N resume placements, crash
   the first N serve preprocess calls, NaN-poison the Nth train batch
   (the ``--bad-step-policy`` drills), fail the first N image decodes
-  (the quarantine drill), and fake a preemption notice after step N
-  (the exact-step mid-epoch-resume drill). ``fault_env()`` builds the
-  env-var dict a test hands its trainer subprocess.
+  (the quarantine drill), fake a preemption notice after step N
+  (the exact-step mid-epoch-resume drill), and rotate a tenant's served
+  top-k answers (``MPT_FAULT_LOGIT_NOISE_PCT`` + ``_MODEL`` targeting —
+  the quality-canary/drift drill of ISSUE 19). ``fault_env()`` builds
+  the env-var dict a test hands its trainer subprocess.
 
 - **File faults** (this module's actions): corrupt the NEWEST checkpoint
   (truncate / garbage / empty) so the restore fallback path
@@ -169,6 +171,8 @@ def fault_env(
     wire_delay_ms: int | None = None,
     wire_delay_host: int | None = None,
     wire_delay_jitter_ms: int | None = None,
+    logit_noise_pct: int | None = None,
+    logit_noise_model: str | None = None,
     base: dict | None = None,
 ) -> dict:
     """The env-var dict arming the in-process gates — hand it to a trainer
@@ -191,6 +195,8 @@ def fault_env(
         "MPT_FAULT_WIRE_DELAY_MS": wire_delay_ms,
         "MPT_FAULT_WIRE_DELAY_HOST": wire_delay_host,
         "MPT_FAULT_WIRE_DELAY_JITTER_MS": wire_delay_jitter_ms,
+        "MPT_FAULT_LOGIT_NOISE_PCT": logit_noise_pct,
+        "MPT_FAULT_LOGIT_NOISE_MODEL": logit_noise_model,
     }
     env = dict(base) if base else {}
     for name, value in values.items():

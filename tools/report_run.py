@@ -387,9 +387,36 @@ def summarize(records: list[dict]) -> dict:
             {k: a.get(k) for k in (
                 "rule", "severity", "metric", "value", "threshold", "streak",
                 "action", "epoch", "step",
+                # Schema-v15 drift alerts: provenance + the detector's
+                # evidence (absent on SLO alerts).
+                "source", "model", "host", "psi", "chi2",
             )}
             for a in alerts
         ]
+    canaries = by_kind.get("canary", [])
+    if canaries:
+        # Schema-v15 quality canary: per-tenant verdict trajectory. The
+        # LAST probe per tenant carries the standing verdict; blocked
+        # records are the refused mutations the gate enforced.
+        per_model: dict = {}
+        for c in canaries:
+            m = c.get("model", "")
+            st = per_model.setdefault(m, {
+                "pins": 0, "probes": 0, "blocked": 0,
+                "last_verdict": None, "last_agreement_top1": None,
+                "blocked_mutations": [],
+            })
+            ev = c.get("event")
+            if ev == "pin":
+                st["pins"] += 1
+            elif ev == "probe":
+                st["probes"] += 1
+                st["last_verdict"] = c.get("verdict")
+                st["last_agreement_top1"] = c.get("agreement_top1")
+            elif ev == "blocked":
+                st["blocked"] += 1
+                st["blocked_mutations"].append(c.get("mutation"))
+        summary["canary"] = per_model
     snaps = by_kind.get("metrics", [])
     if snaps:
         last = snaps[-1]
@@ -771,7 +798,30 @@ def render(path: str, records: list[dict], summary: dict) -> str:
             f"actions: {a.get('action')})"
             + ("" if a.get("epoch") is None else f" at epoch {a['epoch']}")
             + ("" if a.get("step") is None else f" step {a['step']}")
+            + ("" if not a.get("source") else f" [source {a['source']}]")
+            + ("" if not a.get("model") else f" tenant {a['model']}")
+            + ("" if not a.get("host") else f" host {a['host']}")
+            + ("" if a.get("psi") is None else (
+                f" (psi {_fmt(a['psi'], 3)}, chi2/dof {_fmt(a.get('chi2'), 2)})"
+            ))
         )]
+    if "canary" in summary:
+        out += ["", "quality canary (per tenant):"]
+        canary_rows = [
+            [
+                m or "-", st["pins"], st["probes"],
+                "-" if st["last_agreement_top1"] is None
+                else _fmt(st["last_agreement_top1"], 3),
+                st["last_verdict"] or "-", st["blocked"],
+                ",".join(x for x in st["blocked_mutations"] if x) or "-",
+            ]
+            for m, st in sorted(summary["canary"].items())
+        ]
+        out.append(table(
+            ["tenant", "pins", "probes", "last top-1", "verdict",
+             "blocked", "refused mutations"],
+            canary_rows,
+        ))
     if "metrics_snapshots" in summary:
         ms = summary["metrics_snapshots"]
         out += ["", (
