@@ -96,6 +96,16 @@ class MeshConfig:
                     "names are reserved for the nested hierarchical data "
                     f"axes, got {getattr(self, field)!r}"
                 )
+        # "pipe" is likewise reserved FOR the pipeline axis (ISSUE 20: the
+        # nested (data, pipe) serve mesh and the stage planner key on the
+        # literal name) — the data/model axes may not claim it.
+        for field in ("data_axis", "model_axis"):
+            if getattr(self, field) == "pipe":
+                raise ValueError(
+                    f"mesh {field} may not be named 'pipe' — that name is "
+                    "reserved for the pipeline-stage axis (serve pipe mesh "
+                    "and --pp-stages layouts key on the literal name)"
+                )
 
 
 @dataclass
@@ -368,6 +378,20 @@ class Config:
     # or get it from the packing planner instead; this knob is the
     # single-model and bench_serve surface.
     serve_shard_degree: int = 1
+    # --- pipeline-parallel residency (serve/pipeline.py, ISSUE 20) ---
+    # K > 1 serves this host PIPELINE-PARALLEL over a nested (data, pipe)
+    # mesh: the model splits at registry cut points into K stages (stem /
+    # trunk / fused head), each stage its own per-bucket AOT executable on
+    # a disjoint chip group, and a flush streams serve_pipe_microbatches
+    # micro-batches through the stages. 1 = no pipelining. Zoo tenants
+    # pick it per-spec (shard=pipe:K) or via the planner; this knob is the
+    # single-model and bench_serve surface.
+    serve_pipe_stages: int = 1
+    # Micro-batches per flush (M). Steady state overlaps stages; the
+    # fill/drain bubble fraction is (K-1)/(M+K-1) under equal stage times,
+    # so more micro-batches amortize the bubble. M is clamped down to the
+    # largest divisor of each bucket size (M=1 degenerates to sequential).
+    serve_pipe_microbatches: int = 4
 
     # --- fleet serving (mpi_pytorch_tpu/serve/fleet/, ISSUE 9) ---
     # N > 0 builds an in-process N-host fleet (FleetServer: N InferenceServer
@@ -867,6 +891,28 @@ class Config:
                 "serve_shard_degree is the single-model model-parallel "
                 "knob; zoo tenants pick residency per-spec (shard=K) or "
                 "from the packing planner"
+            )
+        if self.serve_pipe_stages < 1:
+            raise ValueError(
+                f"serve_pipe_stages must be >= 1 (1 = no pipelining), "
+                f"got {self.serve_pipe_stages}"
+            )
+        if self.serve_pipe_microbatches < 1:
+            raise ValueError(
+                f"serve_pipe_microbatches must be >= 1, "
+                f"got {self.serve_pipe_microbatches}"
+            )
+        if self.serve_pipe_stages > 1 and self.serve_models:
+            raise ValueError(
+                "serve_pipe_stages is the single-model pipeline knob; zoo "
+                "tenants pick residency per-spec (shard=pipe:K) or from "
+                "the packing planner"
+            )
+        if self.serve_pipe_stages > 1 and self.serve_shard_degree > 1:
+            raise ValueError(
+                "serve_pipe_stages and serve_shard_degree are mutually "
+                "exclusive residencies — a host serves pipeline-parallel "
+                "OR model-parallel, not both"
             )
         if self.serve_fleet_hosts < 0:
             raise ValueError(

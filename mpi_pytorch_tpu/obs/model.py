@@ -109,8 +109,19 @@ class PhaseLatencyModel:
                                              default_residency))
             fit = self._fits.setdefault(key, _KeyFit())
             root_id = serve_root.get("span")
+            device_id = next(
+                (s.get("span") for s in spans
+                 if s["name"] == "serve/device"
+                 and s.get("parent") == root_id), None)
             for s in spans:
                 if s["name"] in PHASES and s.get("parent") == root_id:
+                    fit.samples.setdefault(s["name"], []).append(
+                        1e3 * (s["t1"] - s["t0"]))
+                elif (s["name"].startswith("serve/stage")
+                      and s.get("parent") == device_id):
+                    # v16 pipeline stage children: per-stage device walls
+                    # under serve/device — what names the bottleneck stage
+                    # and prices pipe configs (stage_pctls).
                     fit.samples.setdefault(s["name"], []).append(
                         1e3 * (s["t1"] - s["t0"]))
             fitted += 1
@@ -175,9 +186,41 @@ class PhaseLatencyModel:
                 f"fitted keys: {self.keys}")
         src = min(near, key=lambda k: abs(k.bucket - bucket))
         base = self._pctl(src, "serve/device", q)
+        if residency and str(residency).startswith("pipe:"):
+            # Pipeline device time is bottleneck-stage bound: extra rows
+            # stretch the slowest stage's steady-state work, while the
+            # fill/drain ramp stays what the fitted bucket paid — scaling
+            # the WHOLE device wall linearly would double-count the ramp.
+            stages = self.stage_pctls(model=src.model, bucket=src.bucket,
+                                      precision=src.precision,
+                                      residency=src.residency, q=q)
+            if stages:
+                bottleneck = max(stages.values())
+                scaled = round(
+                    base + bottleneck * (bucket - src.bucket) / src.bucket,
+                    3)
+                return scaled, (
+                    f"bucket {bucket} unseen (pipe): fitted bucket "
+                    f"{src.bucket} plus its bottleneck stage scaled in rows")
         scaled = round(base * bucket / src.bucket, 3)
         return scaled, (f"bucket {bucket} unseen: scaled from fitted "
                         f"bucket {src.bucket} linearly in rows")
+
+    def stage_pctls(self, *, model, bucket: int, precision,
+                    residency: str, q: float = 0.99) -> dict:
+        """Per-stage device percentiles (``serve/stage{i}`` → ms) for one
+        fitted pipe key — empty for keys fitted without stage spans. The
+        argmax names the bottleneck stage the trace attribution blames."""
+        key = FitKey(model=model, bucket=bucket, precision=precision,
+                     residency=residency)
+        fit = self._fits.get(key)
+        if fit is None:
+            return {}
+        return {
+            name: _percentile(sorted(samples), q)
+            for name, samples in sorted(fit.samples.items())
+            if name.startswith("serve/stage") and samples
+        }
 
     def _host_pctl(self, model, precision, residency, phase: str,
                    q: float) -> float:

@@ -209,7 +209,18 @@ from typing import Any, Mapping
 #      ``residency`` (keyed into the trend-line identity alongside
 #      precision). All absent when the canary/drift knobs are off —
 #      streams stay byte-identical to v14.
-SCHEMA_VERSION = 15
+# v16: pipeline-parallel serving (serve/pipeline.py, ISSUE 20 — additive):
+#      ``serve`` flushes on a ``pipe:K`` tenant carry ``pipe_stages``,
+#      ``bubble_frac`` (the MEASURED fill/drain bubble of that flush's
+#      micro-batch schedule), and ``interstage_bytes`` (the ledger-booked
+#      inter-stage activation traffic the flush moved); ``serve_bench``
+#      rows from ``--serve-pipe-stages`` sweeps carry ``pipe_stages``
+#      (keyed into the trend-line identity) and ``bubble_frac``;
+#      ``fleet`` retune records for conversions TO pipe carry
+#      ``pipe_stages`` + ``interstage_bytes``. Traced pipe requests gain
+#      per-stage ``serve/stage{i}`` child spans under ``serve/device``.
+#      All absent off the pipe path — streams stay byte-identical to v15.
+SCHEMA_VERSION = 16
 
 _NUM = (int, float)
 _INT = (int,)
@@ -321,6 +332,11 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # excluded from the served/requests counters; absent on flushes
         # that carried none, so canary-off streams stay byte-identical.
         "shadow_requests": _INT,
+        # v16: pipeline flush facts (pipe:K tenants only): stage count,
+        # the measured fill/drain bubble fraction of the micro-batch
+        # schedule, and the ledger-booked inter-stage activation bytes
+        # moved. Absent on non-pipeline serving.
+        "pipe_stages": _INT, "bubble_frac": _NUM, "interstage_bytes": _INT,
     },
     "serve_bench": {
         "model": (str,), "offered_rps": _NUM, "rejected": _INT,
@@ -370,6 +386,10 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # residency, keyed into the trend-line identity so a sharded/
         # int8 row never compares against a replicated/bf16 baseline.
         "agreement_top1": _NUM, "residency": (str,),
+        # v16: the --serve-pipe-stages axis — a pipelined row is its own
+        # trend line (check_regression keys pipe_stages) and carries the
+        # mean measured bubble fraction over the sweep point.
+        "pipe_stages": _INT, "bubble_frac": _NUM,
     },
     "resume": {
         "from_devices": _INT, "from_mesh": (str,), "to_mesh": (str,),
@@ -440,6 +460,9 @@ OPTIONAL: dict[str, dict[str, tuple]] = {
         # on canary-off fleets (streams stay byte-identical to v14);
         # refused mutations write kind="canary" event="blocked" instead.
         "canary_verdict": (str,),
+        # v16: a retune converting a tenant TO pipe:K says how it was cut
+        # and the per-flush inter-stage traffic price (absent elsewhere).
+        "pipe_stages": _INT, "interstage_bytes": _INT,
     },
     # v6: which step the rollback triggered at, what it restored (the
     # checkpoint's filed epoch + path), how many rollbacks this run has

@@ -112,6 +112,31 @@ def create_serve_mesh(shard_degree: int, devices: list | None = None) -> Mesh:
     return Mesh(arr, (SERVE_DATA_AXIS, SERVE_MODEL_AXIS))
 
 
+def create_pipe_serve_mesh(stages: int, devices: list | None = None) -> Mesh:
+    """The nested ``(data, pipe)`` SERVE mesh (ISSUE 20): ``pipe`` spans
+    ``stages`` chip groups — stage ``s`` of a pipeline tenant owns column
+    ``s`` (``mesh.devices[:, s]``), ``data`` the ``n // stages`` chips
+    within each stage group (distinct micro-batch rows). Like the serve
+    ``(data, model)`` mesh the axis names are FIXED: residency records and
+    the planner's per-chip byte arithmetic key on the literal ``"pipe"``
+    axis, which is reserved exactly like ``pod``/``ici`` (MeshConfig
+    rejects configurable axes claiming it)."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    k = int(stages)
+    if k < 2:
+        raise ValueError(
+            f"pipeline serve mesh needs >= 2 stages, got {stages}"
+        )
+    if n % k != 0:
+        raise ValueError(
+            f"{n} device(s) not divisible by pipe stage count {k}; each "
+            "stage occupies an equal disjoint chip group"
+        )
+    arr = np.asarray(devices).reshape(n // k, k)
+    return Mesh(arr, (SERVE_DATA_AXIS, SERVE_PIPE_AXIS))
+
+
 # ---------------------------------------------------------------------------
 # Nested (hierarchical) data-axis helpers — the one vocabulary every layer
 # keys the pod/ici factoring on, so "is this mesh hierarchical" can never
@@ -122,6 +147,11 @@ def create_serve_mesh(shard_degree: int, devices: list | None = None) -> Mesh:
 # renameable): residency records, the packing planner's per-chip byte
 # arithmetic, and the reshard path all key on them.
 SERVE_DATA_AXIS, SERVE_MODEL_AXIS = "data", "model"
+
+# The pipeline-stage axis of the nested (data, pipe) serve mesh (ISSUE 20).
+# Reserved: stage chip-group membership, interstage ledger booking, and the
+# planner's stage byte arithmetic all key on the literal name.
+SERVE_PIPE_AXIS = "pipe"
 
 # The nested data-axis names are FIXED (unlike the flat axis, which
 # MeshConfig can rename): the traffic ledger classifies collectives by

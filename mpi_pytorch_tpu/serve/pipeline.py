@@ -1,0 +1,765 @@
+"""Pipeline-parallel serving: stage-split executables with micro-batched
+inter-stage handoff (ISSUE 20, ROADMAP item 2's stretch).
+
+The source paper's second half is a 4-stage MPI inference pipeline — rank 0
+reads, ranks resize/normalize, the rest run model replicas
+(``evaluation_pipeline.py:162-199``). Its modern resurrection puts MODEL
+stages on different chips: the ``pipe:K`` residency splits a zoo CNN at
+registry-derived cut points into K stages (stem / trunk blocks / head — the
+fused head kernel stays the last stage), lowers each stage as its own
+per-bucket AOT executable on a disjoint chip group of the nested
+``(data, pipe)`` serve mesh (``parallel.mesh.create_pipe_serve_mesh``), and
+executes a flush as M micro-batches streamed through the stages: stage i
+runs micro-batch m while stage i+1 runs m−1, so steady-state throughput is
+bounded by the SLOWEST stage rather than the whole model.
+
+Stage derivation is generic, not per-arch tables: a recording flax method
+interceptor (``nn.intercept_methods``) traces the model once under
+``jax.eval_shape`` and names every top-level submodule ``__call__`` in
+execution order — every zoo arch presents a clean once-called chain ending
+in the unit ``"head"``. Cut points balance cumulative param bytes across
+the trunk stages; the head unit is always its own last stage, so the
+64.5k-class logits slab (and the fused head kernel) only ever lives on the
+head stage's chips. ``PIPE_CUT_OVERRIDES`` is the escape hatch for an arch
+whose traced chain ever stops being linear.
+
+Stage executables are carved from the SAME traced forward the single-chip
+oracle runs: stage s's program re-traces the full ``apply_fn`` with an
+inject interceptor replacing the previous stage's boundary unit (its output
+becomes the stage input argument — everything upstream is dead code XLA
+removes) and a capture interceptor returning this stage's boundary output.
+Foreign param leaves are rebuilt as in-trace zeros constants, so each
+compiled stage's argument bytes are exactly its own stage's params —
+verified by compiled-executable arg-byte inspection, with bit-exact parity
+against the unsplit forward.
+
+The fill/drain bubble: with S stages and M micro-batches of equal stage
+time, utilization is M/(M+S−1), i.e. a bubble fraction of (S−1)/(M+S−1)
+(``pipeline_bubble_fraction`` — the GPipe arithmetic, arXiv 1811.06965;
+the measure-then-overlap discipline of arXiv 1810.11112). Each flush stamps
+the MEASURED bubble from per-stage dispatch walls, so a slow stage
+(``MPT_FAULT_STAGE_DELAY_MS``) visibly inflates it, and per-stage tracing
+spans let critical-path attribution name the bottleneck stage.
+
+Inter-stage activation handoff is booked in the PR 15 traffic LEDGER at
+build time (per-bucket, per-hop, one micro-batch's bytes — the book-at-
+trace-time discipline), and every flush stamps the flowed total
+(``interstage_bytes`` = Σ hop bytes × M) on its serve records.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from mpi_pytorch_tpu.serve.batcher import parse_buckets
+
+# Explicit per-arch stage plans: arch name → list of K unit-name lists.
+# EMPTY by design — every current zoo arch derives a clean linear chain
+# from the traced forward (tests pin this); an override only exists so a
+# future non-linear arch fails toward an explicit table instead of a
+# wrong generic cut.
+PIPE_CUT_OVERRIDES: dict[str, list[list[str]]] = {}
+
+
+def pipeline_bubble_fraction(stages: int, microbatches: int) -> float:
+    """The GPipe fill/drain bubble under EQUAL stage times: S−1 of the
+    M+S−1 schedule ticks are ramp, so the idle fraction is
+    (S−1)/(M+S−1). M=1 degenerates to fully sequential (bubble
+    (S−1)/S — each stage idles while the others run); M→∞ amortizes the
+    ramp to zero. The measured per-flush stamp generalizes this to
+    unequal stage times (see ``PipelineExecutables.__call__``)."""
+    s, m = int(stages), int(microbatches)
+    if s < 1 or m < 1:
+        raise ValueError(f"need stages >= 1 and microbatches >= 1, got {stages}/{microbatches}")
+    return (s - 1) / (m + s - 1)
+
+
+def _key_name(entry) -> str | None:
+    """The string key of one tree-path entry (DictKey/GetAttrKey), or None
+    for positional entries (sequences, flattened indices)."""
+    key = getattr(entry, "key", getattr(entry, "name", None))
+    return key if isinstance(key, str) else None
+
+
+def trace_units(apply_fn, variables, img_aval):
+    """Name every top-level submodule in execution order, with its output
+    aval, by abstractly tracing one forward under a recording interceptor.
+
+    The two filters are load-bearing: ``method_name == "__call__"`` drops
+    helper-method invocations (inception's Mixed blocks call branch
+    helpers that would otherwise read as duplicate units), and
+    ``len(path) == 1`` keeps only direct children of the top module. The
+    result is the cut-point vocabulary: each unit's output is a legal
+    stage boundary."""
+    import jax
+    from flax import linen as flax_nn
+
+    units: list[tuple[str, object]] = []
+
+    def record(next_fn, args, kwargs, context):
+        out = next_fn(*args, **kwargs)
+        if (
+            context.method_name == "__call__"
+            and len(context.module.path) == 1
+            and hasattr(out, "shape")
+        ):
+            units.append(
+                (context.module.path[0],
+                 jax.ShapeDtypeStruct(tuple(out.shape), out.dtype))
+            )
+        return out
+
+    def run(v, x):
+        with flax_nn.intercept_methods(record):
+            return apply_fn(v, x, train=False)
+
+    jax.eval_shape(run, variables, img_aval)
+    names = [n for n, _ in units]
+    if len(set(names)) != len(names):
+        raise ValueError(
+            "top-level unit chain is not once-called "
+            f"(duplicates in {names}); add a PIPE_CUT_OVERRIDES entry"
+        )
+    return units
+
+
+def plan_stages(
+    unit_names: list[str], unit_bytes: dict[str, int], stages: int,
+    *, arch: str = "",
+) -> list[list[str]]:
+    """Split the ordered unit chain into ``stages`` contiguous groups.
+
+    The final unit must be ``"head"`` and always becomes the last stage
+    alone — the fused head kernel (and the [B, num_classes] logits slab)
+    lives only on the head stage's chips. The remaining trunk units are
+    balanced into the first K−1 stages by cumulative param bytes (greedy
+    at the mean-bytes boundary, never leaving a later stage empty)."""
+    if arch and arch in PIPE_CUT_OVERRIDES:
+        plan = PIPE_CUT_OVERRIDES[arch]
+        flat = [u for g in plan for u in g]
+        if len(plan) != stages or flat != list(unit_names):
+            raise ValueError(
+                f"PIPE_CUT_OVERRIDES[{arch!r}] does not cover the traced "
+                f"unit chain for {stages} stages"
+            )
+        return [list(g) for g in plan]
+    k = int(stages)
+    if k < 2:
+        raise ValueError(f"a pipeline needs >= 2 stages, got {stages}")
+    if not unit_names or unit_names[-1] != "head":
+        raise ValueError(
+            f"traced unit chain does not end in 'head' ({unit_names[-3:]}); "
+            "add a PIPE_CUT_OVERRIDES entry for this arch"
+        )
+    trunk = list(unit_names[:-1])
+    if len(trunk) < k - 1:
+        raise ValueError(
+            f"{len(unit_names)} top-level unit(s) cannot split into "
+            f"{k} stages (each stage needs at least one unit)"
+        )
+    total = sum(unit_bytes.get(u, 0) for u in trunk) or 1
+    target = total / (k - 1)
+    plan: list[list[str]] = []
+    group: list[str] = []
+    gbytes = 0.0
+    for i, u in enumerate(trunk):
+        group.append(u)
+        gbytes += unit_bytes.get(u, 0)
+        left_units = len(trunk) - i - 1
+        left_groups = (k - 1) - len(plan) - 1
+        if left_groups > 0 and (gbytes >= target or left_units == left_groups):
+            plan.append(group)
+            group, gbytes = [], 0.0
+    plan.append(group)
+    plan.append([unit_names[-1]])
+    return plan
+
+
+def _capture(name: str, box: list):
+    def interceptor(next_fn, args, kwargs, context):
+        out = next_fn(*args, **kwargs)
+        if context.method_name == "__call__" and context.module.path == (name,):
+            box.append(out)
+        return out
+
+    return interceptor
+
+
+def _inject(name: str, value):
+    def interceptor(next_fn, args, kwargs, context):
+        if context.method_name == "__call__" and context.module.path == (name,):
+            # The boundary unit's output IS the stage input; next_fn is
+            # never called, so everything feeding it is dead code.
+            return value
+        return next_fn(*args, **kwargs)
+
+    return interceptor
+
+
+class _BucketPlan:
+    """Everything one bucket's flush needs, AOT-compiled at build time."""
+
+    __slots__ = (
+        "m_eff", "micro_rows", "in_shardings", "stage_exes", "concat",
+        "hop_bytes",
+    )
+
+    def __init__(self):
+        self.stage_exes = []
+        self.in_shardings = []
+        self.hop_bytes = []
+        self.concat = None
+        self.m_eff = 1
+        self.micro_rows = 0
+
+
+class PipelineExecutables:
+    """Per-bucket pipeline-stage AOT executables over a stage-placed state.
+
+    Duck-typed to ``BucketExecutables`` (the server/pool/parity surfaces:
+    ``place``/``__call__``/``warmup``/``host_rows``/``compiles_since_
+    warmup``/``rebaseline``/``reshard_stats``), plus the pipeline-only
+    observability: ``last_flush()`` returns the just-executed flush's
+    ``pipe_stages``/``microbatches``/``bubble_frac``/``interstage_bytes``/
+    per-stage wall windows, and ``set_obs`` wires the metrics writer (the
+    slow-stage fault gate's announce-once record) and the tracer (per-hop
+    handoff instants).
+
+    ``host_rows(bucket) == bucket``: micro-batch rows shard over the
+    stage group's ``data`` chips when divisible and run replicated within
+    the group otherwise — there is no degree padding, because a stage
+    group serves whole micro-batch rows, never column-sharded params."""
+
+    def __init__(
+        self, cfg, state, mesh, *, logger=None, precision: str = "bf16",
+        residency=None, prequantized: bool = False, microbatches=None,
+    ):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from mpi_pytorch_tpu.evaluate import _make_predict_step, _row_sharding
+        from mpi_pytorch_tpu.obs import compile_count, ensure_compile_listener
+        from mpi_pytorch_tpu.ops.quantize import fused_head_gate
+        from mpi_pytorch_tpu.parallel.collectives import LEDGER
+        from mpi_pytorch_tpu.parallel.mesh import SERVE_PIPE_AXIS
+        from mpi_pytorch_tpu.serve import sharding as shd
+
+        if precision not in ("bf16", "int8"):
+            raise ValueError(
+                f"precision must be 'bf16' or 'int8', got {precision!r}"
+            )
+        if SERVE_PIPE_AXIS not in mesh.axis_names:
+            raise ValueError(
+                f"pipeline serving needs the nested (data, pipe) serve mesh "
+                f"(create_pipe_serve_mesh), got axes {mesh.axis_names}"
+            )
+        n_stages = int(mesh.shape[SERVE_PIPE_AXIS])
+        if residency is None:
+            residency = shd.Residency("pipe", n_stages)
+        if residency.kind != "pipe" or residency.degree != n_stages:
+            raise ValueError(
+                f"residency {residency} does not match the mesh pipe axis "
+                f"(pipe={n_stages}); build the mesh with "
+                f"create_pipe_serve_mesh({residency.degree})"
+            )
+
+        self.precision = precision
+        self._mesh = mesh
+        self.stages = n_stages
+        self.residency = residency
+        self.buckets = parse_buckets(cfg.parsed_serve_buckets())
+        self.topk = int(cfg.serve_topk)
+        self.fused_head = fused_head_gate(cfg)
+        if self.fused_head and self.topk > 1:
+            if logger is not None:
+                logger.warning(
+                    "--fused-head-eval streams argmax only: serving top-1 "
+                    "instead of the requested serve_topk=%d", self.topk,
+                )
+            self.topk = 1
+        compute_dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+            cfg.compute_dtype
+        ]
+        if cfg.input_dtype == "bfloat16":
+            import ml_dtypes
+
+            self.image_dtype = np.dtype(ml_dtypes.bfloat16)
+        else:
+            self.image_dtype = np.dtype(cfg.input_dtype)
+        self.microbatches = int(
+            microbatches if microbatches is not None
+            else getattr(cfg, "serve_pipe_microbatches", 4)
+        )
+        if self.microbatches < 1:
+            raise ValueError(
+                f"microbatches must be >= 1, got {self.microbatches}"
+            )
+
+        if precision == "int8" and not prequantized:
+            # Quantize the FULL state before splitting (same scales as the
+            # unsplit int8 set — the shared seeded calibration batch), so
+            # pipe:K int8 and replicated int8 can never disagree.
+            from mpi_pytorch_tpu.ops import quantize as qz
+
+            act_scale = (
+                qz.calibrate_head_act_scale(
+                    state, qz.calibration_batch(cfg), compute_dtype
+                )
+                if self.fused_head else 1.0
+            )
+            state = qz.quantize_state(
+                state, keep_head_int8=self.fused_head, act_scale=act_scale
+            )
+
+        # --- stage submeshes: column s of the (data, pipe) device grid.
+        # Built 2-D as ("data", "model") with model=1 so every mesh helper
+        # (_row_sharding, model_axis_name, _make_predict_step) reads a
+        # stage group exactly like a replicated serve mesh.
+        devs = np.asarray(mesh.devices)
+        from jax.sharding import Mesh
+
+        self._stage_meshes = [
+            Mesh(devs[:, s].reshape(-1, 1), ("data", "model"))
+            for s in range(n_stages)
+        ]
+        group_chips = int(devs.shape[0])
+
+        # --- cut plan, from one abstract trace of the model's own forward.
+        self._image_hw = h, w = cfg.image_size
+        img_probe = jax.ShapeDtypeStruct((1, h, w, 3), compute_dtype)
+        units = trace_units(state.apply_fn, state.variables, img_probe)
+        unit_names = [name for name, _ in units]
+        params = state.variables.get("params", {})
+        bstats = state.variables.get("batch_stats") or {}
+
+        def _tree_bytes(tree) -> int:
+            return sum(
+                int(np.prod(np.shape(leaf))) * np.dtype(
+                    getattr(leaf, "dtype", np.float32)
+                ).itemsize
+                for leaf in jax.tree_util.tree_leaves(tree)
+            )
+
+        unit_bytes = {
+            u: _tree_bytes(params.get(u)) + _tree_bytes(bstats.get(u))
+            for u in unit_names
+        }
+        self.stage_units = plan_stages(
+            unit_names, unit_bytes, n_stages, arch=cfg.model_name
+        )
+        self._boundaries = [g[-1] for g in self.stage_units[:-1]]
+        unit_to_stage = {
+            u: s for s, g in enumerate(self.stage_units) for u in g
+        }
+
+        # --- leaf → stage partition + placement. Params/batch_stats keys
+        # follow their unit's stage; a top-level DIRECT param leaf (e.g.
+        # vit's pos_embed, read by inter-unit glue code whose stage is not
+        # statically knowable) replicates on EVERY stage group; an
+        # UNCALLED submodule subtree (inception's AuxLogits — eval-dead)
+        # and the non-variable leaves (step/rng/opt_state) park on stage 0.
+        leaves_p, treedef = jax.tree_util.tree_flatten_with_path(state)
+        self._treedef = treedef
+
+        def leaf_stage(path):
+            keys = [_key_name(e) for e in path]
+            for j, kname in enumerate(keys):
+                if kname in ("params", "batch_stats"):
+                    rest = keys[j + 1:]
+                    if not rest:
+                        return 0
+                    if rest[0] in unit_to_stage:
+                        return unit_to_stage[rest[0]]
+                    if len(rest) == 1:
+                        return "all"
+                    return 0
+            return 0
+
+        stats = shd.ReshardStats(residency=str(residency))
+        self._leaf_avals = []
+        placed = []
+        stage_arg_idx: list[list[int]] = [[] for _ in range(n_stages)]
+        stage_args: list[list] = [[] for _ in range(n_stages)]
+        is_variable = []
+
+        def _place(leaf, sharding):
+            if isinstance(leaf, jax.Array) and leaf.sharding == sharding:
+                return leaf
+            host = np.asarray(jax.device_get(leaf))
+            stats.bytes_moved += host.nbytes * int(sharding.mesh.devices.size)
+            stats.peak_chunk_bytes = max(stats.peak_chunk_bytes, host.nbytes)
+            return jax.device_put(host, sharding)
+
+        for i, (path, leaf) in enumerate(leaves_p):
+            if not hasattr(leaf, "shape") or not hasattr(leaf, "dtype"):
+                self._leaf_avals.append(leaf)
+                placed.append(leaf)
+                is_variable.append(False)
+                continue
+            self._leaf_avals.append(
+                jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype)
+            )
+            stats.leaves += 1
+            stats.sharded_leaves += 1
+            s = leaf_stage(path)
+            keys = [_key_name(e) for e in path]
+            in_vars = any(k in ("params", "batch_stats") for k in keys)
+            is_variable.append(in_vars)
+            if s == "all":
+                copies = [
+                    _place(leaf, NamedSharding(m, P()))
+                    for m in self._stage_meshes
+                ]
+                placed.append(copies[0])
+                if in_vars:
+                    for t in range(n_stages):
+                        stage_arg_idx[t].append(i)
+                        stage_args[t].append(copies[t])
+            else:
+                arr = _place(
+                    leaf, NamedSharding(self._stage_meshes[s], P())
+                )
+                placed.append(arr)
+                if in_vars:
+                    stage_arg_idx[s].append(i)
+                    stage_args[s].append(arr)
+        self.reshard_stats = stats
+        self._state = jax.tree_util.tree_unflatten(treedef, placed)
+        self._stage_args = stage_args
+        self._stage_arg_idx = stage_arg_idx
+
+        # --- per-bucket stage executables + the preds-assembly concat,
+        # all AOT. One activation trace per distinct micro-row count.
+        int8_head = precision == "int8" and self.fused_head
+        options = cfg.parsed_compiler_options()
+        from flax import linen as flax_nn
+
+        from mpi_pytorch_tpu.train.step import ingest_images
+
+        def make_rebuild(arg_idx):
+            avals = self._leaf_avals
+
+            def rebuild(args):
+                leaves = []
+                it = iter(args)
+                idx = set(arg_idx)
+                for i, a in enumerate(avals):
+                    if i in idx:
+                        leaves.append(next(it))
+                    elif isinstance(a, jax.ShapeDtypeStruct):
+                        # Foreign leaf: an in-trace zeros constant XLA
+                        # dead-code-eliminates — the compiled stage's arg
+                        # bytes are exactly its own stage's params.
+                        leaves.append(jnp.zeros(a.shape, a.dtype))
+                    else:
+                        leaves.append(a)
+                return jax.tree_util.tree_unflatten(treedef, leaves)
+
+            return rebuild
+
+        rebuilds = [make_rebuild(stage_arg_idx[s]) for s in range(n_stages)]
+
+        def act_avals_for(rows: int) -> dict[str, object]:
+            probe = jax.ShapeDtypeStruct((rows, h, w, 3), compute_dtype)
+            traced = trace_units(state.apply_fn, state.variables, probe)
+            return dict(traced)
+
+        def make_stage_fn(s: int, rows: int):
+            rebuild = rebuilds[s]
+            bound_in = self._boundaries[s - 1] if s > 0 else None
+            bound_out = (
+                self._boundaries[s] if s < n_stages - 1 else None
+            )
+            if s == n_stages - 1:
+                predict = _make_predict_step(
+                    self._stage_meshes[s], compute_dtype,
+                    fused_head=self.fused_head, topk=self.topk,
+                    int8_head=int8_head,
+                )
+                # Call the UNWRAPPED predict body. _make_predict_step
+                # returns an @jax.jit function whose inner trace cache is
+                # keyed on (identity, avals) — identical across buckets
+                # with equal micro rows — so calling the wrapper inside
+                # our per-bucket lowering would let bucket N reuse a
+                # jaxpr traced under bucket 1's inject interceptor, with
+                # that bucket's boundary tracer baked in as a constant.
+                predict = getattr(predict, "__wrapped__", predict)
+
+                def fn(args, a_in):
+                    state2 = rebuild(args)
+                    images = jnp.zeros((rows, h, w, 3), self.image_dtype)
+                    labels = jnp.full((rows,), -1, jnp.int32)
+                    with flax_nn.intercept_methods(_inject(bound_in, a_in)):
+                        _, preds = predict(state2, (images, labels))
+                    return preds
+
+                return fn
+            if s == 0:
+
+                def fn(args, images):
+                    state2 = rebuild(args)
+                    x = ingest_images(images, compute_dtype)
+                    box: list = []
+                    with flax_nn.intercept_methods(_capture(bound_out, box)):
+                        state2.apply_fn(state2.variables, x, train=False)
+                    return box[0]
+
+                return fn
+
+            def fn(args, a_in):
+                state2 = rebuild(args)
+                x = jnp.zeros((rows, h, w, 3), compute_dtype)
+                box: list = []
+                with flax_nn.intercept_methods(_inject(bound_in, a_in)):
+                    with flax_nn.intercept_methods(_capture(bound_out, box)):
+                        state2.apply_fn(state2.variables, x, train=False)
+                return box[0]
+
+            return fn
+
+        self._plans: dict[int, _BucketPlan] = {}
+        act_cache: dict[int, dict] = {}
+        for bucket in self.buckets:
+            plan = _BucketPlan()
+            m = max(
+                (d for d in range(1, self.microbatches + 1) if bucket % d == 0),
+                default=1,
+            )
+            plan.m_eff = m
+            rows = plan.micro_rows = bucket // m
+            if rows not in act_cache:
+                act_cache[rows] = act_avals_for(rows)
+            acts = act_cache[rows]
+            for s in range(n_stages):
+                stage_mesh = self._stage_meshes[s]
+                row_sh = _row_sharding(stage_mesh, rows)
+                arg_avals = [
+                    jax.ShapeDtypeStruct(
+                        a.shape, a.dtype,
+                        sharding=NamedSharding(stage_mesh, P()),
+                    )
+                    for a in (self._leaf_avals[i] for i in stage_arg_idx[s])
+                ]
+                if s == 0:
+                    in_aval = jax.ShapeDtypeStruct(
+                        (rows, h, w, 3), self.image_dtype, sharding=row_sh
+                    )
+                else:
+                    b_aval = acts[self._boundaries[s - 1]]
+                    in_aval = jax.ShapeDtypeStruct(
+                        b_aval.shape, b_aval.dtype, sharding=row_sh
+                    )
+                    hop = int(np.prod(b_aval.shape)) * np.dtype(
+                        b_aval.dtype
+                    ).itemsize
+                    if len(plan.hop_bytes) < s:
+                        plan.hop_bytes.append(hop)
+                        # Book the hop at build time (the PR 15 trace-time
+                        # discipline): one micro-batch's activation bytes
+                        # ride the within-pod fabric per handoff.
+                        LEDGER.add("ici", "pipe_handoff", hop)
+                plan.in_shardings.append(in_aval.sharding)
+                fn = make_stage_fn(s, rows)
+                plan.stage_exes.append(
+                    jax.jit(fn)
+                    .lower(arg_avals, in_aval)
+                    .compile(compiler_options=options)
+                )
+            # Preds assembly compiles AT BUILD TIME too (the zero-steady-
+            # state-compile invariant covers the concat): its input avals
+            # carry the head-stage executable's OWN output sharding, so
+            # the compiled concat accepts the stage output verbatim.
+            preds_sh = plan.stage_exes[-1].output_shardings
+            micro_pred = jax.eval_shape(
+                make_stage_fn(n_stages - 1, rows),
+                [self._leaf_avals[i] for i in stage_arg_idx[n_stages - 1]],
+                jax.ShapeDtypeStruct(
+                    acts[self._boundaries[-1]].shape,
+                    acts[self._boundaries[-1]].dtype,
+                ),
+            )
+            concat_avals = [
+                jax.ShapeDtypeStruct(
+                    micro_pred.shape, micro_pred.dtype, sharding=preds_sh
+                )
+            ] * m
+            plan.concat = (
+                jax.jit(lambda xs: jnp.concatenate(xs, axis=0))
+                .lower(concat_avals)
+                .compile(compiler_options=options)
+            )
+            self._plans[bucket] = plan
+        self._group_chips = group_chips
+
+        self._metrics = None
+        self._tracer = None
+        self._fault_announced = False
+        self._last = None
+        ensure_compile_listener()
+        self._compile_count = compile_count
+        self._baseline = compile_count()
+        self._warm = False
+
+    # --- BucketExecutables duck-type surface -------------------------------
+
+    @property
+    def shard_degree(self) -> int:
+        """Chips one copy of this set's params spans — the K stage groups
+        jointly hold one copy, so the pipe degree."""
+        return self.residency.degree
+
+    def host_rows(self, bucket: int) -> int:
+        return bucket
+
+    def interstage_bytes_per_flush(self) -> int:
+        """Worst-case (max over buckets) inter-stage activation bytes one
+        flush moves: Σ hop_bytes × its bucket's micro-batch count — what a
+        retune record quotes as the conversion's steady-state traffic
+        price."""
+        return max(
+            (
+                int(sum(p.hop_bytes)) * p.m_eff
+                for p in self._plans.values()
+            ),
+            default=0,
+        )
+
+    def set_obs(self, *, metrics=None, tracer=None) -> None:
+        """Wire the serve observability surfaces: ``metrics`` receives the
+        slow-stage fault gate's announce-once record, ``tracer`` the
+        per-hop handoff instants."""
+        if metrics is not None:
+            self._metrics = metrics
+        if tracer is not None:
+            self._tracer = tracer
+
+    def place(self, images: np.ndarray, labels: np.ndarray):
+        """Host batch → M micro-batches on the stage-0 group (async
+        device_puts; labels are unused — the predict step runs on
+        constant −1 labels and serving discards the metrics)."""
+        import jax
+
+        plan = self._plans[images.shape[0]]
+        imgs = images.astype(self.image_dtype, copy=False)
+        r = plan.micro_rows
+        return [
+            jax.device_put(imgs[i * r:(i + 1) * r], plan.in_shardings[0])
+            for i in range(plan.m_eff)
+        ]
+
+    def _announce_fault(self, delay_ms: int, stage: int) -> None:
+        if self._fault_announced:
+            return
+        self._fault_announced = True
+        if self._metrics is not None:
+            self._metrics.write({
+                "kind": "fault",
+                "reason": "injected_stage_delay",
+                "detail": (
+                    f"sleeping {delay_ms}ms in pipeline stage {stage}'s "
+                    f"dispatch window every flush "
+                    f"(MPT_FAULT_STAGE_DELAY_MS)"
+                ),
+            })
+
+    def __call__(self, bucket: int, device_batch):
+        """Stream the flush's M micro-batches through the S stages in
+        schedule-tick order — stage s dispatches micro m at tick s+m, all
+        dispatches async, each hop an async ``device_put`` onto the next
+        stage's input sharding. Returns the AOT-concatenated preds array.
+
+        The flush stamp: per-stage dispatch walls t_s feed the measured
+        generalization of the GPipe bubble — T = Σt_s + (M−1)·max t_s,
+        busy = M·Σt_s, bubble = 1 − busy/(S·T) — which reduces exactly to
+        ``pipeline_bubble_fraction`` under equal stage times and grows
+        when one stage lags (the slow-stage drill's observable)."""
+        import jax
+
+        from mpi_pytorch_tpu.utils.env import env_int
+
+        plan = self._plans[bucket]
+        S = self.stages
+        M = plan.m_eff
+        delay_ms = env_int("MPT_FAULT_STAGE_DELAY_MS", 0)
+        target = env_int("MPT_FAULT_STAGE_DELAY_STAGE", -1)
+        if target < 0 or target >= S:
+            target = S - 1
+        delayed = False
+        outs = [[None] * M for _ in range(S)]
+        stage_s = [0.0] * S
+        windows: list[list] = [[None, None] for _ in range(S)]
+        for tick in range(M + S - 1):
+            for s in range(min(tick, S - 1), -1, -1):
+                m = tick - s
+                if m < 0 or m >= M:
+                    continue
+                t0 = time.monotonic()
+                if delay_ms > 0 and s == target and not delayed:
+                    delayed = True
+                    self._announce_fault(delay_ms, s)
+                    time.sleep(delay_ms / 1000.0)
+                inp = device_batch[m] if s == 0 else outs[s - 1][m]
+                out = plan.stage_exes[s](self._stage_args[s], inp)
+                if s < S - 1:
+                    out = jax.device_put(out, plan.in_shardings[s + 1])
+                    if self._tracer is not None:
+                        self._tracer.instant(
+                            "serve/pipe_handoff",
+                            args={
+                                "hop": s, "micro": m,
+                                "bytes": plan.hop_bytes[s],
+                            },
+                        )
+                outs[s][m] = out
+                t1 = time.monotonic()
+                stage_s[s] += t1 - t0
+                if windows[s][0] is None:
+                    windows[s][0] = t0
+                windows[s][1] = t1
+        preds = plan.concat(outs[S - 1])
+        total = sum(stage_s)
+        t_max = max(stage_s)
+        span = total + (M - 1) * t_max
+        bubble = 1.0 - (M * total) / (S * span) if span > 0 else 0.0
+        self._last = {
+            "pipe_stages": S,
+            "microbatches": M,
+            "bubble_frac": round(max(0.0, bubble), 6),
+            "interstage_bytes": int(sum(plan.hop_bytes)) * M,
+            "stage_ms": [round(t * 1000.0, 3) for t in stage_s],
+            "stage_windows": [tuple(wnd) for wnd in windows],
+        }
+        return preds
+
+    def last_flush(self) -> dict | None:
+        """The most recent flush's pipeline stamp (None before traffic):
+        ``pipe_stages``/``microbatches``/``bubble_frac``/
+        ``interstage_bytes`` plus per-stage dispatch-wall windows in
+        ``time.monotonic`` seconds (the server converts them to its span
+        clock for the per-stage trace spans)."""
+        return self._last
+
+    def warmup(self) -> None:
+        import jax
+
+        h, w = self._image_hw
+        for bucket in self.buckets:
+            images = np.zeros((bucket, h, w, 3), self.image_dtype)
+            labels = np.full((bucket,), -1, np.int32)
+            preds = self(bucket, self.place(images, labels))
+            jax.block_until_ready(preds)
+        self._baseline = self._compile_count()
+        self._warm = True
+
+    @property
+    def warm(self) -> bool:
+        return self._warm
+
+    def compiles_since_warmup(self) -> int:
+        return self._compile_count() - self._baseline
+
+    def rebaseline(self) -> None:
+        self._baseline = self._compile_count()

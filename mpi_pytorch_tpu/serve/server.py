@@ -90,6 +90,12 @@ class _InFlight:
     # recycled by the completion loop AFTER device_get — by then the
     # forward is done, so reuse can never race an in-flight H2D read.
     buffer: Any = None
+    # Pipeline flush facts (ISSUE 20): the executable's last_flush()
+    # snapshot — stages, micro-batches, bubble fraction, interstage
+    # bytes, per-stage windows. None on non-pipeline sets, and the
+    # record stamping below is conditional on it, so replicated/TP/FSDP
+    # flushes stay byte-identical.
+    pipe: Any = None
 
 
 class _BucketBufferPool:
@@ -230,6 +236,7 @@ class InferenceServer:
             )
         else:
             shard_k = int(getattr(cfg, "serve_shard_degree", 1) or 1)
+            pipe_k = int(getattr(cfg, "serve_pipe_stages", 1) or 1)
             if mesh is None:
                 if jax.process_count() > 1:
                     raise ServeError(
@@ -237,7 +244,17 @@ class InferenceServer:
                         "mesh=serve.local_replica_mesh() (a global mesh would "
                         "turn every flush into a pod-wide collective)"
                     )
-                if shard_k > 1:
+                if pipe_k > 1:
+                    # The nested (data, pipe) serve mesh (ISSUE 20): the
+                    # model splits into pipe_k stages, each resident on a
+                    # disjoint chip group; flushes stream through as
+                    # micro-batches.
+                    from mpi_pytorch_tpu.parallel.mesh import (
+                        create_pipe_serve_mesh,
+                    )
+
+                    mesh = create_pipe_serve_mesh(pipe_k)
+                elif shard_k > 1:
                     # The nested (data, model) serve mesh (ISSUE 17): this
                     # host's params span shard_k chips TP/FSDP-style, batch
                     # rows shard over the remaining data-slices.
@@ -259,7 +276,11 @@ class InferenceServer:
 
             if state is None:
                 state = self._build_state(cfg, mesh, load_checkpoint)
-            if shard_k > 1:
+            if pipe_k > 1:
+                # Placement is the stage planner's job: each leaf lives
+                # ONLY on its stage's chip group (serve/pipeline.py).
+                build_residency = None
+            elif shard_k > 1:
                 # Placement is deferred to BucketExecutables, which reshards
                 # the (possibly quantized) state through the bounded
                 # per-leaf path under the serve residency.
@@ -311,6 +332,10 @@ class InferenceServer:
         self._m_fill = self._registry.histogram("serve/fill_pct")
         self._g_qdepth = self._registry.gauge("serve/queue_depth")
         self._g_compiles = self._registry.gauge("serve/compiles_after_warmup")
+        # Last flush's inter-stage activation traffic (ISSUE 20): stays 0
+        # on non-pipeline servers — the scrape surface for the ledger-booked
+        # handoff bytes.
+        self._g_interstage = self._registry.gauge("serve/interstage_bytes")
         self._monitor = None
         if cfg.slo_rules:
             self._monitor = SLOMonitor(
@@ -345,13 +370,26 @@ class InferenceServer:
                 # can treat precision as a retune axis — a switch is an
                 # executable-set swap, never a compile.
                 precisions = cfg.parsed_serve_precisions()
-                self._exe_sets = {
-                    p: BucketExecutables(
-                        cfg, state, self.mesh, logger=self._logger,
-                        precision=p, residency=build_residency,
+                if pipe_k > 1:
+                    from mpi_pytorch_tpu.serve.pipeline import (
+                        PipelineExecutables,
                     )
-                    for p in precisions
-                }
+
+                    self._exe_sets = {
+                        p: PipelineExecutables(
+                            cfg, state, self.mesh, logger=self._logger,
+                            precision=p,
+                        )
+                        for p in precisions
+                    }
+                else:
+                    self._exe_sets = {
+                        p: BucketExecutables(
+                            cfg, state, self.mesh, logger=self._logger,
+                            precision=p, residency=build_residency,
+                        )
+                        for p in precisions
+                    }
             # Warm EVERY set before rebaselining ANY: the compile listener
             # is process-global, so set B's warmup compiles would land on
             # set A's counter otherwise.
@@ -364,6 +402,12 @@ class InferenceServer:
                 iter(self._exe_sets)
             )
             self._exe = self._exe_sets[self.precision]
+            for exe in self._exe_sets.values():
+                if hasattr(exe, "set_obs"):
+                    # Pipeline sets announce their slow-stage fault gate
+                    # and per-hop handoff instants through the server's
+                    # own sinks (duck-typed: bucket sets have no obs).
+                    exe.set_obs(metrics=self._metrics, tracer=self._tracer)
             self.buckets = self._exe.buckets
             self.topk = self._exe.topk
             # Startup parity stamp (measured, not assumed): top-1
@@ -764,6 +808,12 @@ class InferenceServer:
                     dispatch_args["req_ids"] = [r.req_id for r in good]
                 with self._tracer.span("serve/dispatch", args=dispatch_args):
                     preds = exe(bucket, exe.place(images, labels))
+                # Pipeline sets expose the flush they just scheduled
+                # (stage walls, bubble, interstage bytes) — snapshot it
+                # HERE, before the next flush overwrites it.
+                pipe_facts = (
+                    exe.last_flush() if hasattr(exe, "last_flush") else None
+                )
                 self._inflight.put(
                     _InFlight(
                         requests=good,
@@ -780,6 +830,7 @@ class InferenceServer:
                         t_flush=t_flush,
                         t_prep=t_prep,
                         buffer=images,
+                        pipe=pipe_facts,
                     )
                 )
             except BaseException as e:  # noqa: BLE001 — keep serving
@@ -901,6 +952,19 @@ class InferenceServer:
                     # chips one copy of the params spans — replicated
                     # tenants keep their records byte-identical to v12.
                     record["shard_degree"] = self.shard_degree
+                if item.pipe is not None:
+                    # Schema-v16: pipeline flush facts — stage count,
+                    # fill/drain bubble, and the ledger-booked inter-stage
+                    # activation bytes this flush actually moved.
+                    # Non-pipeline flushes stay byte-identical to v15.
+                    record["pipe_stages"] = item.pipe["pipe_stages"]
+                    record["bubble_frac"] = round(
+                        float(item.pipe["bubble_frac"]), 4
+                    )
+                    record["interstage_bytes"] = int(
+                        item.pipe["interstage_bytes"]
+                    )
+                    self._g_interstage.set(record["interstage_bytes"])
                 if n_shadow:
                     # Schema-v15: canary shadow probes riding this flush —
                     # they fill batch slots but are excluded from the
@@ -1004,6 +1068,11 @@ class InferenceServer:
                           "status": "ok"}
             if self.model is not None:
                 root_attrs["model"] = self.model
+            if self.residency != "replicated":
+                # Sharded/pipelined layouts name themselves on the root
+                # span (the latency model keys device-time fits on this);
+                # replicated requests keep their spans byte-identical.
+                root_attrs["residency"] = self.residency
             root = self._spans.add(
                 name="serve/request",
                 trace=ctx.trace_id,
@@ -1016,12 +1085,28 @@ class InferenceServer:
             for name, m0, m1 in (
                 ("serve/queue", req.t_submit, item.t_flush),
                 ("serve/preprocess", item.t_flush, item.t_prep),
-                ("serve/device", item.t_dispatch, t_done_mono),
             ):
                 self._spans.add(
                     name=name, trace=ctx.trace_id, parent=root["span"],
                     t0=wall(m0), t1=wall(m1), host=self.name,
                 )
+            device = self._spans.add(
+                name="serve/device", trace=ctx.trace_id,
+                parent=root["span"], t0=wall(item.t_dispatch),
+                t1=wall(t_done_mono), host=self.name,
+            )
+            if item.pipe is not None:
+                # One child span per pipeline stage (ISSUE 20): critical-
+                # path attribution (tools/trace_report.py) names the
+                # bottleneck stage instead of one opaque device block.
+                for s, (m0, m1) in enumerate(
+                    item.pipe.get("stage_windows") or ()
+                ):
+                    self._spans.add(
+                        name=f"serve/stage{s}", trace=ctx.trace_id,
+                        parent=device["span"], t0=wall(m0), t1=wall(m1),
+                        host=self.name,
+                    )
 
     def traces(self, since: int = 0) -> dict:
         """Incremental span export — the ``/tracez`` payload (and the
@@ -1078,8 +1163,9 @@ class InferenceServer:
     @property
     def residency(self) -> str:
         """The tenant's weight layout (``serve/sharding.py`` vocabulary):
-        ``"replicated"``, ``"tp:K"`` or ``"fsdp:K"`` — what swap-in and
-        retune records say about where this model's bytes live."""
+        ``"replicated"``, ``"tp:K"``, ``"fsdp:K"`` or ``"pipe:K"`` — what
+        swap-in and retune records say about where this model's bytes
+        live."""
         res = getattr(self._exe, "residency", None)
         return str(res) if res is not None else "replicated"
 

@@ -40,7 +40,7 @@ from mpi_pytorch_tpu.parallel.mesh import (
     shard_first_divisible,
 )
 
-RESIDENCY_KINDS = ("replicated", "tp", "fsdp")
+RESIDENCY_KINDS = ("replicated", "tp", "fsdp", "pipe")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,10 +85,10 @@ def parse_residency(text: str | None) -> Residency:
     if s.isdigit():
         return Residency("fsdp", int(s))
     kind, sep, deg = s.partition(":")
-    if not sep or kind not in ("tp", "fsdp") or not deg.isdigit():
+    if not sep or kind not in ("tp", "fsdp", "pipe") or not deg.isdigit():
         raise ValueError(
             f"unparseable residency {text!r} (expected 'replicated', "
-            "'tp:K', 'fsdp:K', or bare 'K' for fsdp:K)"
+            "'tp:K', 'fsdp:K', 'pipe:K', or bare 'K' for fsdp:K)"
         )
     return Residency(kind, int(deg))
 
@@ -123,6 +123,14 @@ def serve_param_specs(tree: Any, mesh, residency: Residency) -> Any:
     its data axis is the big one, but a serve tenant's K chips are the
     ``model`` axis, and the ``data`` axis must keep holding independent
     batch rows (and other tenants)."""
+    if residency.kind == "pipe":
+        # Pipeline residency is not a tree-wide spec rule: each leaf lives
+        # ONLY on its stage's chip group, and the stage assignment is the
+        # cut planner's job (serve/pipeline.py places leaves itself).
+        raise ValueError(
+            "pipe residency has no per-leaf PartitionSpec mapping; build "
+            "serve.pipeline.PipelineExecutables instead"
+        )
     model_axis = mesh.axis_names[-1] if len(mesh.axis_names) == 1 else model_axis_name(mesh)
     msize = int(mesh.shape[model_axis])
     if residency.sharded and residency.degree != msize:

@@ -95,7 +95,8 @@ class ZooExecutablePool:
         # on exactly that (registry._plan_entry).
         self._residency: dict[str, str] = {}
         self._mesh = mesh
-        self._serve_meshes: dict[int, object] = {}
+        # degree → (data, model) mesh; ("pipe", K) → (data, pipe) mesh.
+        self._serve_meshes: dict[object, object] = {}
 
     @property
     def mesh(self):
@@ -128,6 +129,20 @@ class ZooExecutablePool:
             devices = list(self.mesh.devices.flatten())
             cached = create_serve_mesh(degree, devices=devices)
             self._serve_meshes[degree] = cached
+        return cached
+
+    def pipe_mesh(self, stages: int):
+        """The nested ``(data, pipe)`` mesh a ``pipe:K`` tenant's stages
+        split over — built from the pool's own device set and cached per
+        stage count, exactly like ``serve_mesh``."""
+        key = ("pipe", stages)
+        cached = self._serve_meshes.get(key)
+        if cached is None:
+            from mpi_pytorch_tpu.parallel.mesh import create_pipe_serve_mesh
+
+            devices = list(self.mesh.devices.flatten())
+            cached = create_pipe_serve_mesh(stages, devices=devices)
+            self._serve_meshes[key] = cached
         return cached
 
     def resident(self) -> tuple[str, ...]:
@@ -179,6 +194,27 @@ class ZooExecutablePool:
         from mpi_pytorch_tpu.serve.server import InferenceServer
         from mpi_pytorch_tpu.train.step import place_state_on_mesh
 
+        if residency.kind == "pipe":
+            # Pipeline build (ISSUE 20): per-stage executables over the
+            # nested (data, pipe) mesh. State is built unplaced — the cut
+            # planner places each leaf on ITS stage's chip group itself.
+            from mpi_pytorch_tpu.serve.pipeline import PipelineExecutables
+
+            mesh = self.pipe_mesh(residency.degree)
+            state = InferenceServer._build_state(
+                tenant_cfg, None, self._load_checkpoint
+            )
+            sets = {
+                p: PipelineExecutables(
+                    tenant_cfg, state, mesh, logger=self._logger,
+                    precision=p, residency=residency,
+                )
+                for p in tenant_cfg.parsed_serve_precisions()
+            }
+            measured = sum(
+                state_resident_bytes(e._state) for e in sets.values()
+            )
+            return sets, measured, str(residency)
         if residency.sharded:
             # Sharded build: compile over the nested (data, model) mesh
             # and let BucketExecutables reshard post-quantization.
@@ -296,15 +332,33 @@ class ZooExecutablePool:
             if self._residency.get(model, "replicated") == str(residency):
                 return old_sets, 0
         tenant_cfg = self.registry.tenant_cfg(model)
-        mesh = self.serve_mesh(residency.degree if residency.sharded else 1)
+        if residency.kind == "pipe":
+            mesh = self.pipe_mesh(residency.degree)
+        else:
+            mesh = self.serve_mesh(
+                residency.degree if residency.sharded else 1
+            )
         try:
             new_sets = {}
             moved = 0
             for p, exe in old_sets.items():
-                ns = BucketExecutables(
-                    tenant_cfg, exe._state, mesh, logger=self._logger,
-                    precision=p, residency=residency, prequantized=True,
-                )
+                if residency.kind == "pipe":
+                    # Conversion TO pipe: the stage planner re-places the
+                    # already-quantized state leaf-by-leaf onto its stage
+                    # groups (prequantized so int8 scales never re-derive).
+                    from mpi_pytorch_tpu.serve.pipeline import (
+                        PipelineExecutables,
+                    )
+
+                    ns = PipelineExecutables(
+                        tenant_cfg, exe._state, mesh, logger=self._logger,
+                        precision=p, residency=residency, prequantized=True,
+                    )
+                else:
+                    ns = BucketExecutables(
+                        tenant_cfg, exe._state, mesh, logger=self._logger,
+                        precision=p, residency=residency, prequantized=True,
+                    )
                 if ns.reshard_stats is not None:
                     moved += ns.reshard_stats.bytes_moved
                 new_sets[p] = ns

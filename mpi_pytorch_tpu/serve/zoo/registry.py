@@ -28,11 +28,11 @@ Keys: ``ckpt`` (checkpoint dir), ``precision`` (bf16|int8|both),
 ``admission`` (per-tenant front-door token budget; 0 = an equal share of
 the fleet budget), ``cold`` (don't build at startup; the first routed
 request cold-swaps the model in from the persistent compilation cache),
-``shard`` (model-parallel residency, ISSUE 17: ``K``/``fsdpK`` = FSDP
-over K chips, ``tpK`` = head-only tensor parallelism — ``:`` can't
-appear inside an option, so the spec syntax is ``shard=fsdp4``, not
-``shard=fsdp:4``). An alias lets two tenants share an architecture
-(A/B checkpoints).
+``shard`` (model-parallel residency, ISSUE 17/20: ``K``/``fsdpK`` = FSDP
+over K chips, ``tpK`` = head-only tensor parallelism, ``pipeK`` =
+pipeline stages over K chip groups — ``:`` can't appear inside an
+option, so the spec syntax is ``shard=fsdp4``, not ``shard=fsdp:4``).
+An alias lets two tenants share an architecture (A/B checkpoints).
 
 The planner itself holds a THIRD residency option beyond
 resident-replicated and evicted: when the resident set is over budget,
@@ -74,7 +74,7 @@ class ModelSpec:
     buckets: str = ""  # "" = the fleet cfg's serve_buckets
     admission: int = 0  # per-tenant front-door tokens; 0 = equal share
     cold: bool = False  # True = not built at startup; swap-in on demand
-    shard: str = ""  # "" = replicated; else "tp:K"/"fsdp:K" (ISSUE 17)
+    shard: str = ""  # "" = replicated; else "tp:K"/"fsdp:K"/"pipe:K"
 
 
 def parse_model_specs(text: str) -> tuple[ModelSpec, ...]:
@@ -111,12 +111,13 @@ def parse_model_specs(text: str) -> tuple[ModelSpec, ...]:
             elif key == "shard":
                 import re
 
-                m = re.fullmatch(r"(tp|fsdp)?(\d+)", value.strip().lower())
+                m = re.fullmatch(r"(tp|fsdp|pipe)?(\d+)", value.strip().lower())
                 if not m or int(m.group(2)) < 2:
                     raise ValueError(
-                        f"tenant {name!r}: shard must be K, tpK or fsdpK "
-                        f"with K >= 2 (got {value!r}); ':' can't appear "
-                        "inside a spec option, so shard=fsdp4 means fsdp:4"
+                        f"tenant {name!r}: shard must be K, tpK, fsdpK or "
+                        f"pipeK with K >= 2 (got {value!r}); ':' can't "
+                        "appear inside a spec option, so shard=fsdp4 means "
+                        "fsdp:4"
                     )
                 kwargs["shard"] = f"{m.group(1) or 'fsdp'}:{m.group(2)}"
             else:
@@ -252,6 +253,91 @@ def estimate_model_bytes(
             f"residency {residency} does not divide {n_devices} device(s)"
         )
     data_degree = max(1, (n_devices or k) // k)
+    if residency.kind == "pipe":
+        # Fourth residency option (ISSUE 20): per-chip bytes under the
+        # stage split = the BOTTLENECK stage's params + its activation
+        # high-water (stage input + output rows), priced from the same
+        # traced cut the builder uses. The 64.5k-class logits slab only
+        # ever lands on the head stage's chips — a pipe split makes a
+        # head-heavy tenant fit where fsdp's all-gather working set won't.
+        from mpi_pytorch_tpu.serve.pipeline import (
+            _key_name, plan_stages, trace_units,
+        )
+
+        units = trace_units(model.apply, shapes, dummy)
+        unit_names = [n for n, _ in units]
+        unit_avals = dict(units)
+        unit_set = set(unit_names)
+
+        def leaf_bytes(shape, p):
+            n = 1
+            for d in shape:
+                n *= int(d)
+            if p == "int8" and len(shape) >= 2:
+                return n + 4 * int(shape[-1])
+            return n * 4
+
+        # Leaf → stage partition, the builder's rule: a leaf under a
+        # traced unit's subtree belongs to that unit; a DIRECT top-level
+        # param leaf replicates on every stage group (its reading stage
+        # is not statically knowable); an uncalled subtree (eval-dead,
+        # e.g. inception's AuxLogits) parks on stage 0.
+        unit_bytes = {u: 0 for u in unit_names}
+        every_stage = stage0_extra = 0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(shapes)[0]:
+            names = [n for n in (_key_name(e) for e in path) if n]
+            b = sum(leaf_bytes(tuple(leaf.shape), p) for p in precisions)
+            if len(names) >= 2 and names[1] in unit_set:
+                unit_bytes[names[1]] += b
+            elif len(names) == 2:
+                every_stage += b
+            else:
+                stage0_extra += b
+        stage_units = plan_stages(unit_names, unit_bytes, k, arch=arch)
+        stage_params = [
+            sum(unit_bytes[u] for u in g) + every_stage for g in stage_units
+        ]
+        stage_params[0] += stage0_extra
+
+        def row_act(s: int) -> int:
+            # One row's stage input + output bytes (f32-traced avals).
+            def unit_row(u):
+                a = unit_avals[u]
+                n = 1
+                for d in a.shape[1:]:
+                    n *= int(d)
+                return n * 4
+
+            inb = (
+                image_size * image_size * 3 * 4 if s == 0
+                else unit_row(stage_units[s - 1][-1])
+            )
+            outb = (
+                num_classes * 4 if s == k - 1
+                else unit_row(stage_units[s][-1])
+            )
+            return inb + outb
+
+        def act(s: int, b: int) -> int:
+            return (-(-int(b) // data_degree)) * row_act(s)
+
+        max_b = max((int(b) for b in buckets), default=1)
+        bottleneck = max(
+            range(k), key=lambda s: stage_params[s] + act(s, max_b)
+        )
+        per_bucket = {int(b): act(bottleneck, b) for b in buckets}
+        out.update(
+            replicated_total_bytes=out["total_bytes"],
+            params_bytes=int(stage_params[bottleneck]),
+            per_bucket_bytes=per_bucket,
+            total_bytes=int(stage_params[bottleneck])
+            + max(per_bucket.values(), default=0),
+            residency=str(residency),
+            data_degree=data_degree,
+            pipe_stages=k,
+            stage_params_bytes=[int(x) for x in stage_params],
+        )
+        return out
     params = scale_overhead = 0
     for p in precisions:
         pb, sb = _sharded_param_bytes(shapes, p, residency)

@@ -324,6 +324,15 @@ class ZooServer:
             "compiles_after_warmup": srv.compiles_after_warmup(),
             "detail": reason,
         }
+        if res_str.startswith("pipe:"):
+            # Schema-v16: a conversion TO pipe says how it was cut and
+            # what each flush will pay in inter-stage traffic (summed
+            # ledger-booked per-hop bytes at full micro-batch count).
+            exe = next(iter(new_sets.values()))
+            record["pipe_stages"] = int(res_str.split(":")[1])
+            record["interstage_bytes"] = int(
+                getattr(exe, "interstage_bytes_per_flush", lambda: 0)()
+            )
         if self._canary is not None:
             record["canary_verdict"] = self._canary.verdict(model)
         if plan is not None:
@@ -333,7 +342,7 @@ class ZooServer:
     def convert_residency(self, model: str, residency, *,
                           reason: str = "operator") -> None:
         """Operator/planner entry point: convert a RESIDENT tenant's
-        weight layout live (replicated↔tp:K↔fsdp:K)."""
+        weight layout live (replicated↔tp:K↔fsdp:K↔pipe:K)."""
         if self._closed:
             raise ServeError(f"zoo host {self.name} is shut down")
         self.registry.spec(model)

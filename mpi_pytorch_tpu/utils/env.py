@@ -172,6 +172,22 @@ FAULT_GATES: dict[str, str] = {
         "which is exactly the per-tenant isolation the gated-mutation "
         "drill asserts"
     ),
+    "MPT_FAULT_STAGE_DELAY_MS": (
+        "fake a slow pipeline stage: pipeline-parallel serving "
+        "(serve/pipeline.py) sleeps this many ms inside the target "
+        "stage's dispatch window on every flush (read per flush like the "
+        "wire delay gates, no countdown) — the stage's measured time "
+        "inflates, the flush's bubble_frac rises, and trace critical-path "
+        "attribution names the injected stage. Scoped with "
+        "MPT_FAULT_STAGE_DELAY_STAGE; announced by a kind='fault' record "
+        "the first time it bites in a server. The slow-stage drill's lever"
+    ),
+    "MPT_FAULT_STAGE_DELAY_STAGE": (
+        "restrict MPT_FAULT_STAGE_DELAY_MS to this pipeline stage index "
+        "(unset/-1 = the last stage) — one laggy stage, so the bubble "
+        "accounting and the bottleneck-stage attribution move "
+        "deterministically"
+    ),
     "MPT_FAULT_RESHARD_N": (
         "fail the next N serve-side residency reshards (serve/sharding.py) "
         "mid-tree, after some leaves have already been placed — the "

@@ -385,3 +385,371 @@ def test_pipeline_rejects_bad_shapes(mesh, stacked):
         pipeline_forward(
             short, _x(), mesh, stage_fn=residual_mlp_stage, num_microbatches=4
         )
+
+
+# ==========================================================================
+# Serving: the pipe:K residency — stage-split per-bucket AOT executables
+# with micro-batched inter-stage handoff (serve/pipeline.py, ISSUE 20).
+# ==========================================================================
+
+
+def _serve_cfg(num_classes=64, buckets="1,4"):
+    from mpi_pytorch_tpu.config import Config
+
+    cfg = Config(
+        model_name="resnet18", num_classes=num_classes, width=32, height=32,
+        synthetic_data=True, compute_dtype="float32",
+        serve_buckets=buckets, serve_topk=3,
+        metrics_file="", log_file="", eval_log_file="",
+    )
+    cfg.validate_config()
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def pipe_serving():
+    """The module's one expensive build: pipe:2 stage-split executables on
+    the nested (data, pipe) CPU mesh, plus the single-chip oracle over the
+    SAME state, plus deterministic inputs and the oracle's predictions at
+    every bucket. The compile listener is process-global, so the pipe set
+    is rebaselined AFTER the oracle's warmup."""
+    from mpi_pytorch_tpu.parallel.collectives import LEDGER
+    from mpi_pytorch_tpu.parallel.mesh import create_pipe_serve_mesh
+    from mpi_pytorch_tpu.serve.executables import BucketExecutables
+    from mpi_pytorch_tpu.serve.pipeline import PipelineExecutables
+    from mpi_pytorch_tpu.serve.server import InferenceServer
+
+    cfg = _serve_cfg()
+    state = InferenceServer._build_state(cfg, None, False)
+    booked_before = LEDGER.snapshot()["ici"]["by_op"].get("pipe_handoff", 0)
+    exe = PipelineExecutables(
+        cfg, state, create_pipe_serve_mesh(2), microbatches=4
+    )
+    booked = (
+        LEDGER.snapshot()["ici"]["by_op"].get("pipe_handoff", 0)
+        - booked_before
+    )
+    exe.warmup()
+    oracle_mesh = Mesh(
+        np.asarray(jax.devices()[:1]).reshape(1, 1), ("data", "model")
+    )
+    oracle = BucketExecutables(cfg, state, oracle_mesh)
+    oracle.warmup()
+    exe.rebaseline()
+
+    rng = np.random.default_rng(11)
+    inputs, want = {}, {}
+    for bucket in (1, 4):
+        imgs = rng.normal(size=(bucket, 32, 32, 3)).astype(np.float32)
+        inputs[bucket] = imgs
+        rows = oracle.host_rows(bucket)
+        oi = np.zeros((rows, 32, 32, 3), np.float32)
+        oi[:bucket] = imgs
+        ol = np.full((rows,), -1, np.int32)
+        preds = np.asarray(jax.device_get(oracle(bucket, oracle.place(oi, ol))))
+        want[bucket] = preds[:bucket]
+    return {
+        "cfg": cfg, "state": state, "exe": exe, "booked": booked,
+        "inputs": inputs, "want": want,
+    }
+
+
+def _pipe_flush(exe, imgs):
+    bucket = imgs.shape[0]
+    labels = np.full((bucket,), -1, np.int32)
+    return np.asarray(jax.device_get(exe(bucket, exe.place(imgs, labels))))
+
+
+def test_pipe_cut_points_every_zoo_arch():
+    """The generic cut derivation holds for EVERY servable architecture:
+    the traced top-level chain is once-called and ends in "head", and
+    plan_stages covers it contiguously in order with the head alone on the
+    last stage — no per-arch table needed (PIPE_CUT_OVERRIDES stays empty,
+    and this test is what turns a future non-linear arch into a loud
+    failure instead of a wrong generic cut)."""
+    from mpi_pytorch_tpu.config import SUPPORTED_MODELS
+    from mpi_pytorch_tpu.models import initialize_model
+    from mpi_pytorch_tpu.serve.pipeline import (
+        PIPE_CUT_OVERRIDES, plan_stages, trace_units,
+    )
+
+    assert PIPE_CUT_OVERRIDES == {}
+    for arch in SUPPORTED_MODELS:
+        size = 299 if arch == "inception_v3" else 32
+        model, _ = initialize_model(arch, 10)
+        dummy = jax.ShapeDtypeStruct((1, size, size, 3), jnp.float32)
+        rngs = {
+            "params": jax.ShapeDtypeStruct((2,), jnp.uint32),
+            "dropout": jax.ShapeDtypeStruct((2,), jnp.uint32),
+        }
+        shapes = jax.eval_shape(
+            lambda r, x, m=model: m.init(r, x, train=True), rngs, dummy
+        )
+        units = trace_units(model.apply, shapes, dummy)
+        names = [n for n, _ in units]
+        assert names[-1] == "head", (arch, names[-3:])
+        assert len(set(names)) == len(names), (arch, names)
+        unit_bytes = {n: 1 for n in names}
+        for k in (2, 3):
+            if len(names) - 1 < k - 1:
+                continue
+            plan = plan_stages(names, unit_bytes, k, arch=arch)
+            assert len(plan) == k, (arch, k, plan)
+            assert [u for g in plan for u in g] == names, (arch, plan)
+            assert plan[-1] == ["head"], (arch, plan)
+            assert all(g for g in plan), (arch, plan)
+
+
+def test_pipe_parity_with_single_chip_oracle(pipe_serving):
+    """The tentpole's correctness core: the stage-split flush reproduces
+    the unsplit single-chip forward bit-exactly at EVERY bucket, with zero
+    compiles after warmup (per-bucket AOT — no steady-state tracing)."""
+    exe = pipe_serving["exe"]
+    for bucket in (1, 4):
+        got = _pipe_flush(exe, pipe_serving["inputs"][bucket])
+        assert np.array_equal(got, pipe_serving["want"][bucket]), bucket
+    assert exe.compiles_since_warmup() == 0
+
+
+def test_pipe_flush_stamp_and_bubble(pipe_serving):
+    """Every flush stamps the measured pipeline facts: S/M as built (M_eff
+    is the largest divisor of the bucket ≤ configured M — bucket 1
+    degenerates to sequential M=1), bubble_frac in [0, 1), interstage
+    bytes = Σ hop bytes × M, and monotonic per-stage wall windows in
+    schedule order."""
+    exe = pipe_serving["exe"]
+    for bucket, m_want in ((1, 1), (4, 4)):
+        _pipe_flush(exe, pipe_serving["inputs"][bucket])
+        lf = exe.last_flush()
+        assert lf["pipe_stages"] == 2
+        assert lf["microbatches"] == m_want
+        assert 0.0 <= lf["bubble_frac"] < 1.0
+        plan = exe._plans[bucket]
+        assert lf["interstage_bytes"] == sum(plan.hop_bytes) * m_want
+        assert len(lf["stage_ms"]) == 2
+        windows = lf["stage_windows"]
+        assert len(windows) == 2
+        for t0, t1 in windows:
+            assert t0 <= t1
+        # stage 1 cannot START before stage 0 dispatched its first micro.
+        assert windows[1][0] >= windows[0][0]
+
+
+def test_pipe_bubble_fraction_arithmetic():
+    """The GPipe fill/drain arithmetic: (S−1)/(M+S−1), with the M=1 fully
+    sequential and M→∞ amortized limits, and loud rejection of degenerate
+    S/M."""
+    from mpi_pytorch_tpu.serve.pipeline import pipeline_bubble_fraction
+
+    assert pipeline_bubble_fraction(2, 4) == pytest.approx(0.2)
+    assert pipeline_bubble_fraction(2, 1) == pytest.approx(0.5)
+    assert pipeline_bubble_fraction(4, 1) == pytest.approx(0.75)
+    assert pipeline_bubble_fraction(1, 8) == 0.0
+    assert pipeline_bubble_fraction(4, 1000) < 0.003
+    with pytest.raises(ValueError, match="stages >= 1"):
+        pipeline_bubble_fraction(0, 4)
+    with pytest.raises(ValueError, match="stages >= 1"):
+        pipeline_bubble_fraction(2, 0)
+
+
+def test_pipe_ledger_books_handoff_at_build(pipe_serving):
+    """Inter-stage handoff is booked in the traffic LEDGER at build time
+    (book-at-trace, PR 15): one micro-batch's boundary bytes per hop per
+    bucket — and the flush-time ``interstage_bytes_per_flush`` quote is
+    the max-bucket flow (Σ hop bytes × its M)."""
+    exe = pipe_serving["exe"]
+    per_hop = {
+        b: sum(exe._plans[b].hop_bytes) for b in (1, 4)
+    }
+    assert all(v > 0 for v in per_hop.values())
+    assert pipe_serving["booked"] == sum(per_hop.values())
+    assert exe.interstage_bytes_per_flush() == max(
+        per_hop[b] * exe._plans[b].m_eff for b in (1, 4)
+    )
+
+
+def test_pipe_microbatch_sweep_parity(pipe_serving):
+    """M is a throughput knob, never a numerics knob: M=1 (fully
+    sequential) and M=3 (non-divisor → M_eff=2) reproduce the oracle
+    exactly at bucket 4, and the non-divisor request visibly degrades to
+    the largest divisor in the flush stamp."""
+    from mpi_pytorch_tpu.parallel.mesh import create_pipe_serve_mesh
+    from mpi_pytorch_tpu.serve.pipeline import PipelineExecutables
+
+    cfg = _serve_cfg(buckets="4")
+    for m, m_eff in ((1, 1), (3, 2)):
+        exe = PipelineExecutables(
+            cfg, pipe_serving["state"], create_pipe_serve_mesh(2),
+            microbatches=m,
+        )
+        exe.warmup()
+        exe.rebaseline()
+        got = _pipe_flush(exe, pipe_serving["inputs"][4])
+        assert np.array_equal(got, pipe_serving["want"][4]), m
+        lf = exe.last_flush()
+        assert lf["microbatches"] == m_eff, (m, lf)
+        assert exe.compiles_since_warmup() == 0
+    # These builds moved the process-global compile counter past the
+    # shared set's baseline — restore its zero-compile invariant.
+    pipe_serving["exe"].rebaseline()
+
+
+def test_pipe_slow_stage_gate_inflates_measured_bubble(pipe_serving):
+    """The slow-stage drill: MPT_FAULT_STAGE_DELAY_MS stalls the target
+    stage's dispatch window, the MEASURED bubble rises above the healthy
+    flush's at the same bucket, the announce-once kind="fault" record is
+    written exactly once, and numerics stay bit-identical."""
+    import os
+
+    exe = pipe_serving["exe"]
+    _pipe_flush(exe, pipe_serving["inputs"][4])
+    healthy = exe.last_flush()["bubble_frac"]
+
+    written = []
+
+    class _Sink:
+        def write(self, record):
+            written.append(record)
+
+    exe.set_obs(metrics=_Sink())
+    os.environ["MPT_FAULT_STAGE_DELAY_MS"] = "30"
+    os.environ["MPT_FAULT_STAGE_DELAY_STAGE"] = "0"
+    try:
+        got = _pipe_flush(exe, pipe_serving["inputs"][4])
+        stalled = exe.last_flush()["bubble_frac"]
+        _pipe_flush(exe, pipe_serving["inputs"][4])
+    finally:
+        del os.environ["MPT_FAULT_STAGE_DELAY_MS"]
+        del os.environ["MPT_FAULT_STAGE_DELAY_STAGE"]
+    assert np.array_equal(got, pipe_serving["want"][4])
+    assert stalled > healthy, (healthy, stalled)
+    faults = [r for r in written if r.get("kind") == "fault"]
+    assert len(faults) == 1, written  # announce-once, two stalled flushes
+    assert faults[0]["reason"] == "injected_stage_delay"
+
+
+def test_pipe_zoo_live_conversion_round_trip(tmp_path):
+    """convert_residency replicated → pipe:2 → replicated on a live
+    tenant: predictions bit-identical at both buckets through BOTH
+    conversions, zero steady-state compiles, and each retune record labels
+    its residency — the pipe one additionally carrying pipe_stages and
+    the flush's interstage-byte price (schema v16)."""
+    from mpi_pytorch_tpu.config import Config
+    from mpi_pytorch_tpu.obs.schema import load_records, validate_jsonl
+    from mpi_pytorch_tpu.serve.zoo import ZooServer
+
+    cfg = Config(
+        model_name="resnet18", num_classes=16, width=32, height=32,
+        synthetic_data=True, compute_dtype="float32",
+        serve_buckets="1,4", serve_max_wait_ms=2.0, serve_topk=3,
+        serve_models="alpha=resnet18",
+        metrics_file=str(tmp_path / "metrics.jsonl"),
+        log_file="", eval_log_file="",
+    )
+    cfg.validate_config()
+    zoo = ZooServer(cfg, load_checkpoint=False)
+    rng = np.random.default_rng(3)
+    images = [rng.random((32, 32, 3)).astype(np.float32) for _ in range(4)]
+    base4 = np.asarray(zoo.predict_batch(images, model="alpha"))
+    base1 = np.asarray(zoo.predict_batch(images[:1], model="alpha"))
+
+    zoo.convert_residency("alpha", "pipe:2", reason="test")
+    assert zoo.pool.residency("alpha") == "pipe:2"
+    assert np.array_equal(
+        np.asarray(zoo.predict_batch(images, model="alpha")), base4
+    )
+    assert np.array_equal(
+        np.asarray(zoo.predict_batch(images[:1], model="alpha")), base1
+    )
+    zoo.convert_residency("alpha", "replicated", reason="test")
+    assert zoo.pool.residency("alpha") == "replicated"
+    assert np.array_equal(
+        np.asarray(zoo.predict_batch(images, model="alpha")), base4
+    )
+    assert zoo.compiles_after_warmup() == 0
+    zoo.close()
+
+    assert validate_jsonl(cfg.metrics_file) == []
+    retunes = [
+        r for r in load_records(cfg.metrics_file)
+        if r["kind"] == "fleet" and r.get("event") == "retune"
+        and r.get("residency")
+    ]
+    assert [r["residency"] for r in retunes] == ["pipe:2", "replicated"]
+    pipe_rec = retunes[0]
+    assert pipe_rec["pipe_stages"] == 2
+    assert pipe_rec["interstage_bytes"] > 0
+    assert pipe_rec["reshard_bytes"] > 0
+    assert pipe_rec["compiles_after_warmup"] == 0
+    assert "pipe_stages" not in retunes[1]
+
+
+def test_pipe_planner_prices_fourth_residency():
+    """estimate_model_bytes under pipe:K: per-chip bytes = the BOTTLENECK
+    stage (params + activation high-water), the 64.5k-class logits slab
+    lands ONLY on the head stage, and the pipe estimate undercuts the
+    replicated one — the planner's reason to ever pick the fourth
+    option."""
+    from mpi_pytorch_tpu.serve.sharding import parse_residency
+    from mpi_pytorch_tpu.serve.zoo.registry import estimate_model_bytes
+
+    est = estimate_model_bytes(
+        "resnet18", 64500, 32, (1, 4), "bf16",
+        residency=parse_residency("pipe:2"), n_devices=8,
+    )
+    assert est["residency"] == "pipe:2"
+    assert est["pipe_stages"] == 2
+    assert est["data_degree"] == 4
+    stage_params = est["stage_params_bytes"]
+    assert len(stage_params) == 2
+    # At 64.5k classes the head stage (logits slab) dominates the trunk.
+    assert stage_params[1] > stage_params[0]
+    assert est["params_bytes"] == max(stage_params)
+    assert est["total_bytes"] == est["params_bytes"] + max(
+        est["per_bucket_bytes"].values()
+    )
+    assert est["total_bytes"] < est["replicated_total_bytes"]
+    # Indivisible chip counts are a loud error, not a silent round-down.
+    with pytest.raises(ValueError, match="does not divide"):
+        estimate_model_bytes(
+            "resnet18", 64500, 32, (1, 4), "bf16",
+            residency=parse_residency("pipe:3"), n_devices=8,
+        )
+
+
+def test_pipe_config_and_mesh_validation():
+    """The pipe knobs fail loudly: degenerate stage/micro counts, the
+    zoo/shard mutual exclusions, the reserved "pipe" axis name, the
+    indivisible serve mesh, and the no-PartitionSpec rule for pipe
+    residency."""
+    from mpi_pytorch_tpu.config import Config, MeshConfig
+    from mpi_pytorch_tpu.parallel.mesh import create_pipe_serve_mesh
+    from mpi_pytorch_tpu.serve.sharding import (
+        parse_residency, serve_param_specs,
+    )
+
+    with pytest.raises(ValueError, match="serve_pipe_stages must be >= 1"):
+        Config(serve_pipe_stages=0).validate_config()
+    with pytest.raises(ValueError, match="serve_pipe_microbatches"):
+        Config(serve_pipe_microbatches=0).validate_config()
+    with pytest.raises(ValueError, match="single-model pipeline knob"):
+        Config(
+            serve_pipe_stages=2, serve_models="a=resnet18"
+        ).validate_config()
+    with pytest.raises(ValueError, match="mutually"):
+        Config(
+            serve_pipe_stages=2, serve_shard_degree=2
+        ).validate_config()
+    with pytest.raises(ValueError, match="reserved for the pipeline-stage"):
+        MeshConfig(data_axis="pipe").validate()
+    with pytest.raises(ValueError, match="not divisible by pipe stage"):
+        create_pipe_serve_mesh(3)  # 8 CPU devices
+    with pytest.raises(ValueError, match=">= 2 stages"):
+        create_pipe_serve_mesh(1)
+
+    res = parse_residency("pipe:2")
+    assert (res.kind, res.degree, str(res)) == ("pipe", 2, "pipe:2")
+    with pytest.raises(ValueError, match="degree >= 2"):
+        parse_residency("pipe:1")
+    with pytest.raises(ValueError, match="PipelineExecutables instead"):
+        serve_param_specs({}, None, res)

@@ -564,6 +564,12 @@ def main() -> int:
                     "params fsdp:K-sharded over the model axis of a "
                     "nested (data, model) serve mesh (ISSUE 17); rows "
                     "gain shard_degree and key a separate trend line")
+    ap.add_argument("--serve-pipe-stages", type=int, default=1,
+                    help="> 1: single-model PIPELINE-parallel serving — "
+                    "the model stage-split over K chip groups of a nested "
+                    "(data, pipe) serve mesh, flushes streamed through as "
+                    "micro-batches (ISSUE 20); rows gain pipe_stages + "
+                    "bubble_frac and key a separate trend line")
     ap.add_argument("--out", default="",
                     help="also write rows to this JSONL file (overwritten)")
     ap.add_argument("--smoke", action="store_true",
@@ -618,6 +624,13 @@ def main() -> int:
         # packing planner instead.
         print("--serve-shard-degree needs a bare single-model server "
               "(no --fleet/--models)", file=sys.stderr)
+        return 2
+    if args.serve_pipe_stages > 1 and (
+            args.fleet > 0 or args.models or args.serve_shard_degree > 1):
+        # Same single-model scoping as the shard knob, and pipe/fsdp are
+        # rival layouts of the same chips (config.validate_config agrees).
+        print("--serve-pipe-stages needs a bare single-model server "
+              "(no --fleet/--models/--serve-shard-degree)", file=sys.stderr)
         return 2
     if (args.canary_probes or args.drift_window) and (
             args.fleet <= 0 or args.transport != "local"):
@@ -728,6 +741,7 @@ def main() -> int:
             serve_models=args.models,
             serve_pack_budget_mb=args.pack_budget_mb,
             serve_shard_degree=max(1, args.serve_shard_degree),
+            serve_pipe_stages=max(1, args.serve_pipe_stages),
             serve_transport="framed" if args.transport == "framed"
             else "http",
             serve_hedge=args.hedge,
@@ -756,8 +770,8 @@ def main() -> int:
         if args.canary_probes and getattr(server, "prober", None) is not None:
             # Pin the healthy references BEFORE the sweep, with the
             # quality-fault gate disarmed: the bench's references are
-            # ground truth by construction, so a drill fault
-            # (MPT_FAULT_LOGIT_NOISE_*) must surface as sweep-row
+            # ground truth by construction, so a drill fault (the
+            # logit-noise gate pair below) must surface as sweep-row
             # disagreement — never silently poison the baseline the
             # sweep is scored against.
             _noise_gates = {
@@ -867,6 +881,20 @@ def main() -> int:
                                 # row must never pair with a replicated
                                 # baseline.
                                 row["shard_degree"] = args.serve_shard_degree
+                            if args.serve_pipe_stages > 1:
+                                # Schema-v16: the pipeline axis — its own
+                                # trend line, with the last flush's
+                                # measured fill/drain bubble as evidence.
+                                row["pipe_stages"] = args.serve_pipe_stages
+                                exe = getattr(server, "_exe", None)
+                                lf = (
+                                    exe.last_flush()
+                                    if hasattr(exe, "last_flush") else None
+                                )
+                                if lf:
+                                    row["bubble_frac"] = round(
+                                        float(lf["bubble_frac"]), 4
+                                    )
                             if stamp_precision:
                                 row["precision"] = precision
                             if (precision == "int8"

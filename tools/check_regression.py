@@ -137,12 +137,16 @@ def _serve_key(row: dict) -> tuple:
     # different machine shape than the replicated one, and the QUALITY
     # axis (agreement_top1) must never read "int8 agrees less than
     # bf16" or "fsdp differs from replicated" as a regression — those
-    # are different trend lines by construction.
+    # are different trend lines by construction. pipe_stages joined in
+    # v16: a pipeline-split row pays a fill/drain bubble by design, so it
+    # must never read as a regression against the unsplit row at the same
+    # sweep point (pre-v16 rows key None on both sides, unchanged).
     return (
         row.get("mode"), row.get("buckets"), row.get("max_wait_ms"),
         row.get("offered_rps"), row.get("model"), row.get("fleet_hosts"),
         row.get("precision"), row.get("transport"), row.get("load_shape"),
         row.get("shard_degree"), row.get("workload"), row.get("residency"),
+        row.get("pipe_stages"),
     )
 
 

@@ -8,8 +8,9 @@ The closed loop ROADMAP item 4b asks for, first cut:
    fit the per-(model, bucket, precision, residency) device-time +
    queueing model from the same spans (``obs/model.py``).
 2. Enumerate candidates over (bucket sets x precision x host count x
-   pack budget x max_wait) and rank them by model-predicted total p99
-   (ties break toward fewer hosts — the cheaper fleet).
+   pack budget x max_wait x residency — incl. ``pipe:K``) and rank them
+   by model-predicted total p99 (ties break toward fewer hosts — the
+   cheaper fleet). Unpriceable residencies are reported, never dropped.
 3. ``--validate``: stamp the model's calibration error by replaying on a
    holdout window (the second half of the workload), then replay the
    WINNER on the full workload and check its prediction lands within the
@@ -36,16 +37,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def rank_candidates(model, workload, *, bucket_sets, precisions, hosts,
-                    waits, budgets):
+                    waits, budgets, residencies=("replicated",)):
     """Every candidate config scored by the fitted model; returns the
     ranked list (best first). Saturated candidates carry the end-of-burst
     backlog-drain queue term, so they still rank against each other
-    (more hosts -> smaller backlog) instead of tying on a sentinel."""
+    (more hosts -> smaller backlog) instead of tying on a sentinel.
+    ``residencies`` is the ISSUE 20 axis: "replicated"/"tp:K"/"fsdp:K"/
+    "pipe:K" candidates price against their OWN fitted trend (pipe keys
+    fit from per-stage spans) — one the model never saw is reported
+    unpriceable, never silently dropped."""
     from mpi_pytorch_tpu.obs.model import ModelError
 
     ranked = []
-    for bs, prec, h, wait, budget in itertools.product(
-            bucket_sets, precisions, hosts, waits, budgets):
+    for bs, prec, h, wait, budget, res in itertools.product(
+            bucket_sets, precisions, hosts, waits, budgets, residencies):
         config = {
             "buckets": [int(b) for b in bs.split(",") if b.strip()],
             "max_wait_ms": wait,
@@ -53,11 +58,14 @@ def rank_candidates(model, workload, *, bucket_sets, precisions, hosts,
             "precision": prec,
             "pack_budget_mb": budget,
         }
+        if res and res != "replicated":
+            config["residency"] = res
         try:
             pred = model.predict(config, workload)
         except ModelError as e:
             # A candidate the model cannot price (nothing fitted for its
-            # precision, say) is reported, not silently dropped.
+            # precision or residency, say) is reported, not silently
+            # dropped.
             ranked.append({"config": config, "error": str(e)})
             continue
         ranked.append({"config": config, "predicted": pred})
@@ -87,7 +95,9 @@ def explain_plan(ranked, workload, model) -> list:
                 f" precision={cfg['precision'] or '-'} hosts={cfg['hosts']}"
                 f" wait={cfg['max_wait_ms']:g}ms"
                 + (f" budget={cfg['pack_budget_mb']:g}MB"
-                   if cfg.get("pack_budget_mb") else ""))
+                   if cfg.get("pack_budget_mb") else "")
+                + (f" residency={cfg['residency']}"
+                   if cfg.get("residency") else ""))
         if "error" in c:
             lines.append(base + f" -> UNPRICEABLE ({c['error']})")
             continue
@@ -163,6 +173,10 @@ def main() -> int:
                     help="comma list of candidate host counts")
     ap.add_argument("--max-wait-ms", default="2,8",
                     help="comma list of candidate batching windows")
+    ap.add_argument("--residencies", default="",
+                    help="comma list of candidate weight residencies "
+                    "(replicated, tp:K, fsdp:K, pipe:K; default: every "
+                    "residency the fitted trace carries)")
     ap.add_argument("--pack-budgets", default="0",
                     help="comma list of candidate per-host packing budgets "
                     "in MB (0 = unbounded)")
@@ -228,6 +242,12 @@ def main() -> int:
     hosts = [int(h) for h in args.hosts.split(",") if h.strip()]
     waits = [float(w) for w in args.max_wait_ms.split(",") if w.strip()]
     budgets = [float(b) for b in args.pack_budgets.split(",") if b.strip()]
+    if args.residencies:
+        residencies = [r.strip() or "replicated"
+                       for r in args.residencies.split(",")]
+    else:
+        residencies = sorted(
+            {k.residency for k in model.keys}) or ["replicated"]
 
     record = {"kind": "whatif", "ts": time.time(),
               "workload": workload.fingerprint}
@@ -273,7 +293,7 @@ def main() -> int:
 
     ranked = rank_candidates(
         model, workload, bucket_sets=bucket_sets, precisions=precisions,
-        hosts=hosts, waits=waits, budgets=budgets)
+        hosts=hosts, waits=waits, budgets=budgets, residencies=residencies)
     shown = ranked[:args.top] if args.top else ranked
     for line in explain_plan(shown, workload, model):
         print(line)
